@@ -1,0 +1,38 @@
+"""tpu-tree-search: a TPU-native distributed Branch-and-Bound tree-search framework.
+
+Built from scratch in JAX/XLA/Pallas with the capabilities of the reference
+C+CUDA+OpenMP+MPI engine `ivantag13/dist-GPU-accelerated-tree-search`
+(see SURVEY.md for the structural map). Node pools live in HBM, bound
+evaluation is vectorized/Pallas kernels over node batches, the
+pop->bound->prune->branch cycle is a compiled `lax.while_loop`, and the
+reference's OpenMP work stealing + MPI load balancing collapse into
+`jax.lax` collectives over the device mesh.
+
+Layout
+------
+problems/  problem definitions: Taillard PFSP instances, N-Queens
+           (reference: pfsp/lib/c_taillard.c, pfsp/lib/PFSP_node.h,
+            nqueens/lib/NQueens_node.h)
+ops/       lower-bound kernels LB1 / LB1_d / LB2, numpy oracle + batched JAX
+           (+ Pallas) versions (reference: pfsp/lib/c_bound_simple.c,
+            c_bound_johnson.c, bounds_gpu.cu)
+engine/    device-resident pool + search loops: sequential oracle,
+           single-device, multi-device (reference: Pool_atom.c, pfsp_c.c,
+            pfsp_multigpu_cuda.c, pfsp_dist_multigpu_cuda.c)
+parallel/  mesh construction, load-balance collectives, termination
+           (reference: the MPI layer of pfsp_dist_multigpu_cuda.c:56-137)
+utils/     statistics, CSV writers, config (reference: common/util.c,
+           pfsp/lib/PFSP_statistic.c)
+native/    C++ host runtime (fast sequential oracle / host drain), bound
+           via ctypes (the TPU-native analogue of the reference's C core)
+"""
+
+import jax
+
+# Tree/solution counters overflow int32 on large instances (the reference
+# uses unsigned long long, pfsp/lib/PFSP_lib.c:8). Enable 64-bit mode so
+# device-side counters can be int64; all hot-path arrays declare explicit
+# narrow dtypes (int16/int32) so this only affects the scalar counters.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from . import sequential
+
+__all__ = ["sequential"]

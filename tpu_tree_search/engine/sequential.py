@@ -1,0 +1,124 @@
+"""Sequential B&B oracle engines (host-side, exact reference semantics).
+
+These are the correctness oracles: slow, simple, and byte-exact in their
+counting semantics with the reference's sequential programs
+(reference: pfsp/pfsp_c.c:26-73, nqueens/nqueens_c.c:99-148). The TPU
+engines are validated against the `(explored_tree, explored_sol, best)`
+triple these produce. With `ub=opt` the PFSP tree is exploration-order
+independent (the incumbent never improves), so the counts here must match
+the device engines exactly; with `ub=inf` only the final optimum must match.
+
+Counting semantics (reference: PFSP_lib.c:7-129):
+- `explored_tree` += 1 for every non-leaf child whose bound beats the
+  incumbent (i.e. every node *pushed*); the root is pushed but not counted.
+- `explored_sol`  += 1 for every leaf child evaluated (feasible or not).
+- a leaf child with bound < best improves the incumbent and is not pushed.
+N-Queens differs (reference: nqueens_c.c:99-117): all safe children are
+pushed (including complete boards), and a popped node at depth N counts as
+a solution.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..ops import reference as ref
+from ..problems import nqueens as nq
+from ..problems.pfsp import PFSPInstance
+
+INT_MAX = 2**31 - 1
+
+LB1_D = 0  # incremental all-children one-machine bound ("lb1_d")
+LB1 = 1    # full one-machine bound
+LB2 = 2    # two-machine Johnson bound
+
+
+@dataclasses.dataclass
+class SearchResult:
+    explored_tree: int
+    explored_sol: int
+    best: int
+
+
+def pfsp_search(instance: PFSPInstance, lb: int = LB1,
+                init_ub: int | None = None,
+                max_nodes: int | None = None) -> SearchResult:
+    """Depth-first B&B over one PFSP instance (reference: pfsp_c.c:26-73).
+
+    `init_ub=None` means an infinite initial incumbent (`-u 0`); pass the
+    known optimum for the `-u 1` mode. `max_nodes` caps popped nodes for
+    truncated-search tests (None = run to completion).
+    """
+    jobs, machines = instance.jobs, instance.machines
+    lb1 = ref.make_lb1_data(instance.p_times)
+    lb2 = ref.make_lb2_data(lb1) if lb == LB2 else None
+
+    best = INT_MAX if init_ub is None else int(init_ub)
+    tree = 0
+    sol = 0
+
+    # stack of (prmu int16[jobs], depth); root = identity at depth 0
+    stack: list[tuple[np.ndarray, int]] = [
+        (np.arange(jobs, dtype=np.int16), 0)
+    ]
+    popped = 0
+
+    while stack:
+        if max_nodes is not None and popped >= max_nodes:
+            break
+        prmu, depth = stack.pop()
+        popped += 1
+        limit1 = depth - 1  # forward branching invariant
+
+        if lb == LB1_D:
+            lb_begin = ref.lb1_children_bounds(lb1, prmu, limit1, jobs)
+
+        for i in range(depth, jobs):
+            child = prmu.copy()
+            child[depth], child[i] = child[i], child[depth]
+            if lb == LB1:
+                bound = ref.lb1_bound(lb1, child, limit1 + 1, jobs)
+            elif lb == LB1_D:
+                bound = int(lb_begin[int(prmu[i])])
+            else:
+                bound = ref.lb2_bound(lb1, lb2, child, limit1 + 1, jobs, best)
+
+            if depth + 1 == jobs:           # leaf: complete schedule
+                sol += 1
+                if bound < best:
+                    best = bound
+            elif bound < best:              # feasible internal node
+                stack.append((child, depth + 1))
+                tree += 1
+
+    return SearchResult(explored_tree=tree, explored_sol=sol, best=best)
+
+
+def nqueens_search(n: int, g: int = 1,
+                   max_nodes: int | None = None) -> SearchResult:
+    """Depth-first N-Queens backtracking (reference: nqueens_c.c:119-148)."""
+    tree = 0
+    sol = 0
+    stack: list[tuple[np.ndarray, int]] = [(np.arange(n, dtype=np.int16), 0)]
+    popped = 0
+
+    while stack:
+        if max_nodes is not None and popped >= max_nodes:
+            break
+        board, depth = stack.pop()
+        popped += 1
+        if depth == n:
+            sol += 1
+        for j in range(depth, n):
+            if nq.is_safe(board, depth, int(board[j])):
+                child = board.copy()
+                child[depth], child[j] = child[j], child[depth]
+                stack.append((child, depth + 1))
+                tree += 1
+
+    # `g` only scales the safety-check work in the reference; results are
+    # independent of it, so the oracle ignores it.
+    del g
+    return SearchResult(explored_tree=tree, explored_sol=sol, best=sol)

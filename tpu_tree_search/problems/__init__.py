@@ -1,0 +1,3 @@
+from . import taillard, pfsp, nqueens
+
+__all__ = ["taillard", "pfsp", "nqueens"]

@@ -1,0 +1,39 @@
+"""N-Queens problem definition (permutation-based backtracking).
+
+The reference's proof-of-concept workload (reference: nqueens/lib/
+NQueens_node.h:11-17, nqueens/nqueens_c.c:80-117). A node is a permutation
+`board` of column->row assignments plus a `depth`: queens `0..depth-1` are
+placed (one per column, rows given by `board`), the rest are candidate rows.
+Branching swaps `board[depth] <-> board[j]` for each `j in depth..N-1`
+whose row is diagonal-safe against the placed prefix; the permutation
+scheme makes row-conflicts impossible by construction so only diagonals
+are checked. A node at depth N is a solution.
+
+`g` replicates the safety check g times to scale arithmetic intensity for
+benchmarking (reference: nqueens_c.c:80-96); it does not change results.
+
+Known solution counts (OEIS A000170) are the correctness oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Total solutions of N-Queens for N = 0..17 (OEIS A000170).
+SOLUTION_COUNTS = (
+    1, 1, 0, 0, 2, 10, 4, 40, 92, 352, 724, 2680, 14200, 73712,
+    365596, 2279184, 14772512, 95815104,
+)
+
+
+def root_node(n: int) -> tuple[np.ndarray, int]:
+    """Root = identity board at depth 0 (reference: NQueens_node.c:7-13)."""
+    return np.arange(n, dtype=np.int16), 0
+
+
+def is_safe(board: np.ndarray, depth: int, row: int) -> bool:
+    """Diagonal-safety of placing `row` in column `depth` against the prefix
+    (reference: nqueens_c.c:80-96)."""
+    placed = np.asarray(board[:depth], dtype=np.int64)
+    dist = depth - np.arange(depth, dtype=np.int64)
+    return bool(np.all((placed != row - dist) & (placed != row + dist)))

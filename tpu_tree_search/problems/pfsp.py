@@ -1,0 +1,88 @@
+"""PFSP problem definition: node layout and branching scheme.
+
+A B&B node for the Permutation Flowshop Scheduling Problem is a partial
+permutation: jobs at positions `0..depth-1` of `prmu` are the fixed prefix
+(already scheduled), the rest are unscheduled. The reference stores
+`(int16 depth, int16 limit1, int16 prmu[MAX_JOBS])`
+(reference: pfsp/lib/PFSP_node.h:15-20); with the forward-only branching
+rule every engine uses (`child.limit1 = parent.limit1 + 1`,
+PFSP_lib.c:13-16), `limit1 == depth - 1` is an invariant, so the TPU node
+is just `(depth, prmu)` and `limit1` is derived.
+
+Branching ("decompose", reference: PFSP_lib.c:7-42): the children of a node
+at depth `d` are obtained by swapping `prmu[d] <-> prmu[i]` for each
+`i in d..jobs-1`, fixing one more job at the front. A child with
+`depth == jobs` is a complete schedule (leaf).
+
+Device layout is struct-of-arrays: a pool of N nodes is
+`prmu: int16[N, jobs]`, `depth: int16[N]` resident in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from . import taillard
+
+
+@dataclasses.dataclass(frozen=True)
+class PFSPInstance:
+    """A PFSP instance plus the static shape info engines specialize on.
+
+    The reference hardcodes MAX_JOBS/MAX_MACHINES at compile time
+    (pfsp/lib/macro.h:9-11); here the concrete (jobs, machines) are static
+    arguments baked into `jit`, chosen per instance.
+    """
+
+    inst_id: int            # Taillard instance id (1..120), 0 for synthetic
+    jobs: int
+    machines: int
+    p_times: np.ndarray     # (machines, jobs) int32
+
+    @staticmethod
+    def from_taillard(inst: int) -> "PFSPInstance":
+        p, n, m = taillard.instance(inst)
+        return PFSPInstance(inst_id=inst, jobs=n, machines=m, p_times=p)
+
+    @staticmethod
+    def synthetic(jobs: int, machines: int, seed: int = 0,
+                  low: int = 1, high: int = 99) -> "PFSPInstance":
+        """Random instance for tests (brute-forceable at small `jobs`)."""
+        rng = np.random.default_rng(seed)
+        p = rng.integers(low, high + 1, size=(machines, jobs), dtype=np.int32)
+        return PFSPInstance(inst_id=0, jobs=jobs, machines=machines, p_times=p)
+
+    @property
+    def optimum(self) -> int | None:
+        return taillard.optimal_makespan(self.inst_id) if self.inst_id else None
+
+    def makespan(self, permutation: np.ndarray) -> int:
+        """Cmax of a complete permutation (reference: c_bound_simple.c:92-106)."""
+        perm = np.asarray(permutation)
+        completion = np.zeros(self.machines, dtype=np.int64)
+        for job in perm:
+            completion[0] += self.p_times[0, job]
+            for mach in range(1, self.machines):
+                completion[mach] = max(completion[mach - 1], completion[mach]) \
+                    + self.p_times[mach, job]
+        return int(completion[-1])
+
+    def brute_force_optimum(self) -> int:
+        """Exhaustive optimum for tiny instances (test oracle only)."""
+        import itertools
+
+        assert self.jobs <= 9, "brute force only for tiny instances"
+        best = np.inf
+        for perm in itertools.permutations(range(self.jobs)):
+            best = min(best, self.makespan(np.array(perm)))
+        return int(best)
+
+
+def root_node(jobs: int) -> tuple[np.ndarray, int]:
+    """Root = identity permutation at depth 0 (reference: PFSP_node.c:7-14)."""
+    return np.arange(jobs, dtype=np.int16), 0
+
+
+ROOT_DEPTH = 0
