@@ -1,0 +1,26 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; the collective logic is
+validated on host-platform virtual devices instead — the "fake backend"
+the reference never had (SURVEY.md §4).
+
+Note: the environment preloads jax via sitecustomize and pins
+JAX_PLATFORMS to the TPU plugin, so flipping the platform must go through
+`jax.config.update` (env vars alone are read too early/late).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert jax.device_count() == 8, jax.devices()

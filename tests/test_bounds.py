@@ -1,0 +1,116 @@
+"""Batched JAX bound kernels vs the scalar numpy oracle.
+
+Property checked on randomized partial permutations: for every real child
+slot, the batched (B, J) kernels reproduce the scalar reference bound
+exactly (these are integer algorithms — equality, not closeness).
+"""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.ops import batched, reference as ref
+from tpu_tree_search.problems import taillard
+from tpu_tree_search.problems.pfsp import PFSPInstance
+
+
+def random_parents(jobs: int, batch: int, rng) -> tuple[np.ndarray, np.ndarray]:
+    """Random nodes: a random permutation with a random prefix depth."""
+    prmu = np.stack([rng.permutation(jobs) for _ in range(batch)]).astype(np.int16)
+    depth = rng.integers(0, jobs, size=batch).astype(np.int32)
+    return prmu, depth
+
+
+def scalar_child_bounds(lb1, lb2, prmu, depth, lb_kind, jobs):
+    """Dense (J,) child bounds of one parent via the scalar oracle."""
+    out = np.full(jobs, 2**31 - 1, dtype=np.int64)
+    limit1 = depth - 1
+    if lb_kind == 0:
+        lb_begin = ref.lb1_children_bounds(lb1, prmu, limit1, jobs)
+        for i in range(depth, jobs):
+            out[i] = lb_begin[int(prmu[i])]
+        return out
+    for i in range(depth, jobs):
+        child = prmu.copy()
+        child[depth], child[i] = child[i], child[depth]
+        if lb_kind == 1:
+            out[i] = ref.lb1_bound(lb1, child, limit1 + 1, jobs)
+        else:
+            # best=I32_MAX disables the early exit -> full max over pairs,
+            # which is what the batched kernel computes
+            out[i] = ref.lb2_bound(lb1, lb2, child, limit1 + 1, jobs, 2**31 - 1)
+    return out
+
+
+@pytest.mark.parametrize("jobs,machines,seed", [(8, 4, 0), (12, 6, 1), (20, 5, 2)])
+@pytest.mark.parametrize("lb_kind", [0, 1, 2])
+def test_batched_matches_scalar_synthetic(jobs, machines, seed, lb_kind):
+    rng = np.random.default_rng(seed)
+    inst = PFSPInstance.synthetic(jobs=jobs, machines=machines, seed=seed)
+    lb1 = ref.make_lb1_data(inst.p_times)
+    lb2 = ref.make_lb2_data(lb1)
+    tables = batched.make_tables(inst.p_times)
+
+    B = 16
+    prmu, depth = random_parents(jobs, B, rng)
+    valid = np.ones(B, dtype=bool)
+    got = np.asarray(
+        batched.children_bounds(lb_kind)(tables, prmu, depth, valid)
+    )
+    for b in range(B):
+        want = scalar_child_bounds(lb1, lb2, prmu[b], int(depth[b]), lb_kind, jobs)
+        np.testing.assert_array_equal(got[b], want, err_msg=f"parent {b}")
+
+
+@pytest.mark.parametrize("lb_kind", [0, 1, 2])
+def test_batched_matches_scalar_ta014(lb_kind):
+    """Real instance shape (20x10)."""
+    rng = np.random.default_rng(14)
+    inst = PFSPInstance.from_taillard(14)
+    lb1 = ref.make_lb1_data(inst.p_times)
+    lb2 = ref.make_lb2_data(lb1)
+    tables = batched.make_tables(inst.p_times)
+
+    B = 8
+    prmu, depth = random_parents(inst.jobs, B, rng)
+    valid = np.ones(B, dtype=bool)
+    got = np.asarray(
+        batched.children_bounds(lb_kind)(tables, prmu, depth, valid)
+    )
+    for b in range(B):
+        want = scalar_child_bounds(lb1, lb2, prmu[b], int(depth[b]), lb_kind,
+                                   inst.jobs)
+        np.testing.assert_array_equal(got[b], want, err_msg=f"parent {b}")
+
+
+def test_invalid_parents_masked():
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=5)
+    tables = batched.make_tables(inst.p_times)
+    rng = np.random.default_rng(5)
+    prmu, depth = random_parents(8, 4, rng)
+    valid = np.array([True, False, True, False])
+    got = np.asarray(batched.lb1_children(tables, prmu, depth, valid))
+    assert (got[1] == 2**31 - 1).all()
+    assert (got[3] == 2**31 - 1).all()
+
+
+def test_leaf_child_bound_is_makespan():
+    """At depth J-1 the single child is a complete schedule; its LB1 bound
+    must equal the true makespan (reference: eval_solution semantics)."""
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=7)
+    tables = batched.make_tables(inst.p_times)
+    rng = np.random.default_rng(7)
+    prmu = np.stack([rng.permutation(8) for _ in range(4)]).astype(np.int16)
+    depth = np.full(4, 7, dtype=np.int32)
+    valid = np.ones(4, dtype=bool)
+    got = np.asarray(batched.lb1_children(tables, prmu, depth, valid))
+    for b in range(4):
+        assert got[b, 7] == inst.makespan(prmu[b])
+
+
+def test_taillard_oracle_table_spotchecks():
+    assert taillard.optimal_makespan(14) == 1377
+    assert taillard.optimal_makespan(21) == 2297
+    assert taillard.optimal_makespan(31) == 2724
+    assert taillard.optimal_makespan(56) == 3679
+    assert taillard.nb_jobs(14) == 20 and taillard.nb_machines(14) == 10
+    assert taillard.nb_jobs(56) == 50 and taillard.nb_machines(56) == 20

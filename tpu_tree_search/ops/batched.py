@@ -1,0 +1,254 @@
+"""Batched JAX bound kernels: (B,) parent nodes -> (B, J) child bounds.
+
+This is the TPU replacement for the reference's CUDA bound kernels
+(reference: pfsp/lib/bounds_gpu.cu, pfsp/lib/PFSP_gpu_lib.cu:43-127).
+Where the GPU code launches one thread per (parent, child) with ragged
+`nodeIndex`/`sumOffSets` maps, the TPU version evaluates a *dense*
+`(batch, jobs)` grid of candidate children — slot `i` of parent `b` is the
+child created by swapping `prmu[b, depth] <-> prmu[b, i]` — and masks the
+slots `i < depth` that do not correspond to real children. Wasted lanes are
+the price of static shapes; they vanish as depth grows.
+
+Key algebraic fact used throughout: a child's scheduled prefix is its
+parent's prefix plus one appended job, so the child's machine-completion
+vector (`front`) is one O(machines) `add_forward` chain away from the
+parent's — no per-child O(jobs * machines) DP is needed. The machine-axis
+max-plus chains are unrolled Python loops over `machines <= 20`, which XLA
+fuses into a handful of vector ops over the (B, J) lanes.
+
+All engines branch forward-only, so the suffix is empty, `limit2 == jobs`,
+and `back == min_tails` (reference: c_bound_simple.c:78-81).
+
+Dtypes: permutations int16, bound arithmetic int32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import reference as ref
+
+I32_MAX = jnp.int32(2**31 - 1)
+
+
+class BoundTables(NamedTuple):
+    """Device-resident precomputed tables for all three bounds.
+
+    The LB1 part mirrors `lb1_bound_data` (reference: c_bound_simple.h:21-27);
+    the LB2 part mirrors `lb2_bound_data` (c_bound_johnson.h:32-40) but with
+    the Johnson schedules pre-gathered into contiguous per-pair arrays so the
+    device never chases job-id indirection for processing times.
+    """
+
+    p: jax.Array          # (M, J) int32 processing times
+    p_t: jax.Array        # (J, M) int32 transpose (gather-friendly)
+    min_tails: jax.Array  # (M,) int32
+    total_work: jax.Array  # (M,) int32 = p.sum(axis=1)
+    # LB2 tables, one row per machine pair (P = M*(M-1)/2):
+    ma0: jax.Array        # (P,) int32 first machine of pair
+    ma1: jax.Array        # (P,) int32 second machine
+    js: jax.Array         # (P, J) int32 job ids in Johnson order
+    ptm0_js: jax.Array    # (P, J) int32 p[ma0, js] in Johnson order
+    ptm1_js: jax.Array    # (P, J) int32 p[ma1, js]
+    lag_js: jax.Array     # (P, J) int32 lags[pair, js]
+
+
+def make_tables(p_times: np.ndarray) -> BoundTables:
+    """Host-side precompute; the analogue of `lb1_alloc_gpu`/`lb2_alloc_gpu`
+    (reference: PFSP_gpu_lib.cu:154-200)."""
+    lb1 = ref.make_lb1_data(p_times)
+    lb2 = ref.make_lb2_data(lb1)
+    p = np.asarray(p_times, dtype=np.int32)
+    rows = np.arange(len(lb2.pairs_m1))[:, None]
+    return BoundTables(
+        p=jnp.asarray(p),
+        p_t=jnp.asarray(p.T.copy()),
+        min_tails=jnp.asarray(lb1.min_tails, dtype=jnp.int32),
+        total_work=jnp.asarray(p.sum(axis=1), dtype=jnp.int32),
+        ma0=jnp.asarray(lb2.pairs_m1, dtype=jnp.int32),
+        ma1=jnp.asarray(lb2.pairs_m2, dtype=jnp.int32),
+        js=jnp.asarray(lb2.johnson_schedules, dtype=jnp.int32),
+        ptm0_js=jnp.asarray(p[lb2.pairs_m1[:, None],
+                              lb2.johnson_schedules], dtype=jnp.int32),
+        ptm1_js=jnp.asarray(p[lb2.pairs_m2[:, None],
+                              lb2.johnson_schedules], dtype=jnp.int32),
+        lag_js=jnp.asarray(np.take_along_axis(lb2.lags,
+                                              lb2.johnson_schedules, axis=1),
+                           dtype=jnp.int32),
+    )
+
+
+def parent_tables(t: BoundTables, prmu: jax.Array, depth: jax.Array):
+    """front/remain of each parent's prefix, one `lax.scan` over positions.
+
+    Equivalent of `schedule_front` + `sum_unscheduled`
+    (reference: c_bound_simple.c:51-69, 108-124) for a whole batch: scan
+    positions j = 0..J-1; a position participates only while j < depth(b).
+
+    Returns front (B, M) and remain (B, M), both int32.
+    """
+    prmu = jnp.asarray(prmu)
+    depth = jnp.asarray(depth)
+    B, J = prmu.shape
+    M = t.p.shape[0]
+
+    def body(carry, j):
+        front, sched_sum = carry
+        job = prmu[:, j].astype(jnp.int32)          # (B,)
+        pj = t.p_t[job]                              # (B, M)
+        active = (j < depth)[:, None]                # (B, 1)
+
+        # add_forward chain over machines (unrolled, M small)
+        chain = front[:, 0] + pj[:, 0]
+        cols = [chain]
+        for k in range(1, M):
+            chain = jnp.maximum(chain, front[:, k]) + pj[:, k]
+            cols.append(chain)
+        new_front = jnp.stack(cols, axis=1)
+
+        front = jnp.where(active, new_front, front)
+        sched_sum = sched_sum + jnp.where(active, pj, 0)
+        return (front, sched_sum), None
+
+    init = (jnp.zeros((B, M), jnp.int32), jnp.zeros((B, M), jnp.int32))
+    (front, sched_sum), _ = jax.lax.scan(body, init, jnp.arange(J))
+    remain = t.total_work[None, :] - sched_sum
+    return front, remain
+
+
+def _child_fronts(t: BoundTables, prmu, front):
+    """front of every dense child: append job prmu[b, i] to parent b's prefix
+    (one add_forward chain, c_bound_simple.c:31-38, on (B, J) lanes).
+
+    Returns (child_front [(B, J, M)], child_p [(B, J, M)] the per-machine
+    processing times of the appended job)."""
+    jobs = prmu.astype(jnp.int32)                    # (B, J) appended job ids
+    child_p = t.p_t[jobs]                            # (B, J, M)
+    chain = front[:, None, 0] + child_p[..., 0]
+    cols = [chain]
+    M = t.p.shape[0]
+    for k in range(1, M):
+        chain = jnp.maximum(chain, front[:, None, k]) + child_p[..., k]
+        cols.append(chain)
+    return jnp.stack(cols, axis=-1), child_p
+
+
+def child_mask(prmu: jax.Array, depth: jax.Array, valid: jax.Array):
+    """(B, J) mask of real children: slot i exists iff depth <= i < J."""
+    B, J = prmu.shape
+    depth = jnp.asarray(depth)
+    valid = jnp.asarray(valid)
+    return (jnp.arange(J)[None, :] >= depth[:, None]) & valid[:, None]
+
+
+def lb1_children(t: BoundTables, prmu, depth, valid):
+    """LB1 bound of every child (reference semantics: lb1_bound of the child
+    permutation, c_bound_simple.c:143-158, as launched per-child by
+    evaluate_gpu_lb1, PFSP_gpu_lib.cu:43-65).
+
+    Returns (B, J) int32; masked slots hold I32_MAX (always pruned).
+    """
+    front, remain = parent_tables(t, prmu, depth)
+    child_front, child_p = _child_fronts(t, prmu, front)
+    child_remain = remain[:, None, :] - child_p       # job leaves 'remain'
+
+    # machine_bound_from_parts chain (c_bound_simple.c:126-141)
+    M = t.p.shape[0]
+    back = t.min_tails
+    tmp0 = child_front[..., 0] + child_remain[..., 0]
+    lb = tmp0 + back[0]
+    for k in range(1, M):
+        tmp1 = jnp.maximum(tmp0, child_front[..., k] + child_remain[..., k])
+        lb = jnp.maximum(lb, tmp1 + back[k])
+        tmp0 = tmp1
+    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+
+
+def lb1d_children(t: BoundTables, prmu, depth, valid):
+    """LB1_d incremental bound of every child (`add_front_and_bound`,
+    reference: c_bound_simple.c:218-244, as launched per-parent by
+    evaluate_gpu_lb1_d, PFSP_gpu_lib.cu:73-102).
+
+    Returns (B, J) int32; masked slots hold I32_MAX.
+    """
+    front, remain = parent_tables(t, prmu, depth)
+    _, child_p = _child_fronts(t, prmu, front)        # only needs p of the job
+    back = t.min_tails
+    M = t.p.shape[0]
+
+    lb = (front[:, None, 0] + remain[:, None, 0] + back[0]) \
+        * jnp.ones_like(child_p[..., 0])
+    tmp0 = front[:, None, 0] + child_p[..., 0]
+    for k in range(1, M):
+        tmp1 = jnp.maximum(tmp0, front[:, None, k])
+        lb = jnp.maximum(lb, tmp1 + remain[:, None, k] + back[k])
+        tmp0 = tmp1 + child_p[..., k]
+    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+
+
+def lb2_children(t: BoundTables, prmu, depth, valid):
+    """LB2 Johnson bound of every child (reference: lb2_bound,
+    c_bound_johnson.c:239-254, per-child as evaluate_gpu_lb2,
+    PFSP_gpu_lib.cu:105-127).
+
+    The reference's data-dependent early exit over machine pairs
+    (c_bound_johnson.c:231-233) is replaced by a full masked max over all
+    pairs — the exit can only fire when the bound already exceeds the
+    incumbent, in which case the child is pruned either way, so search
+    behavior is identical (and the vector unit stays busy).
+
+    Returns (B, J) int32; masked slots hold I32_MAX.
+    """
+    prmu = jnp.asarray(prmu)
+    depth = jnp.asarray(depth)
+    B, J = prmu.shape
+    P = t.ma0.shape[0]
+    front, _ = parent_tables(t, prmu, depth)
+    child_front, _ = _child_fronts(t, prmu, front)    # (B, J, M)
+
+    # inverse permutation: slot_of_job[b, job] = position of job in prmu[b]
+    slot_of_job = jnp.zeros((B, J), jnp.int32).at[
+        jnp.arange(B)[:, None], prmu.astype(jnp.int32)
+    ].set(jnp.arange(J, dtype=jnp.int32)[None, :])
+
+    # tmp0/tmp1 start at the child's front on each pair's two machines
+    tmp0 = jnp.take(child_front, t.ma0, axis=-1)      # (B, J, P)
+    tmp1 = jnp.take(child_front, t.ma1, axis=-1)
+
+    depth_b = depth[:, None, None]                    # (B, 1, 1)
+
+    def body(carry, j):
+        tmp0, tmp1 = carry
+        jsj = t.js[:, j]                              # (P,) job id per pair
+        # child-unscheduled test: job's slot >= depth and it is not the
+        # appended job (which sits at slot i of the dense child grid)
+        slot = jnp.take(slot_of_job, jsj, axis=1)     # (B, P)
+        is_appended = slot[:, None, :] == jnp.arange(J)[None, :, None]
+        active = (slot[:, None, :] >= depth_b) & ~is_appended    # (B, J, P)
+
+        pt0 = t.ptm0_js[:, j]                         # (P,)
+        pt1 = t.ptm1_js[:, j]
+        lag = t.lag_js[:, j]
+        new0 = tmp0 + pt0
+        new1 = jnp.maximum(tmp1, new0 + lag) + pt1
+        tmp0 = jnp.where(active, new0, tmp0)
+        tmp1 = jnp.where(active, new1, tmp1)
+        return (tmp0, tmp1), None
+
+    (tmp0, tmp1), _ = jax.lax.scan(body, (tmp0, tmp1), jnp.arange(J))
+
+    back0 = jnp.take(t.min_tails, t.ma0)              # (P,)
+    back1 = jnp.take(t.min_tails, t.ma1)
+    per_pair = jnp.maximum(tmp1 + back1, tmp0 + back0)
+    lb = per_pair.max(axis=-1)                        # (B, J)
+    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+
+
+def children_bounds(lb_kind: int):
+    """Dispatch like the reference's `decompose`/`evaluate_gpu`
+    (PFSP_lib.h:30-48, PFSP_gpu_lib.cu:129-152): 0=LB1_d, 1=LB1, 2=LB2."""
+    return {0: lb1d_children, 1: lb1_children, 2: lb2_children}[lb_kind]
