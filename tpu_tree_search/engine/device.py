@@ -1,0 +1,205 @@
+"""Single-device PFSP B&B engine: HBM-resident pool + compiled search loop.
+
+This replaces the reference's host-managed architecture — CPU deque
+(Pool_atom.c), chunked H2D/D2H offload with `-m/-M` thresholds, CUDA bound
+kernel, host-side prune+branch (`generate_children`, PFSP_lib.h:51-95) —
+with a design where the node pool never leaves the device: the whole
+pop -> bound -> prune -> branch cycle is one `lax.while_loop` inside `jit`
+(reference hot loop: pfsp_multigpu_cuda.c:221-320 round-trips the host
+every iteration; here the host only sees the final counters).
+
+Pool layout (struct-of-arrays in HBM, replacing the reference's
+array-of-struct deque, Pool_atom.h:23-30):
+    prmu  int16[capacity, jobs]   permutations
+    depth int16[capacity]         scheduled-prefix length
+    size  int32                   stack cursor (rows [0, size) are live)
+
+Each step pops a chunk of up to `chunk` parents off the top of the stack
+(deepest-first => depth-first, preserving the pruning locality the
+reference gets from popBackBulk, Pool_atom.c:154-178), evaluates the dense
+(chunk, jobs) grid of child bounds with the batched kernels, and pushes
+surviving children back with a masked compacting scatter — the on-device
+equivalent of `generate_children` + `pushBackBulk`.
+
+Unlike the reference's growable deque (realloc-on-push, Pool_atom.c:47-51),
+the pool has static capacity; an `overflow` flag aborts the search cleanly
+if it would be exceeded (callers then retry with a larger pool). DFS order
+keeps the live size near (tree depth x branching x chunk), far below
+capacity in practice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import batched
+from ..ops.batched import BoundTables
+
+I32_MAX = jnp.int32(2**31 - 1)
+
+
+class SearchState(NamedTuple):
+    """Carried through the `lax.while_loop`; all arrays device-resident."""
+
+    prmu: jax.Array      # (capacity, jobs) int16
+    depth: jax.Array     # (capacity,) int16
+    size: jax.Array      # int32 live-row cursor
+    best: jax.Array      # int32 incumbent makespan
+    tree: jax.Array      # int64 explored (= pushed) internal nodes
+    sol: jax.Array       # int64 evaluated leaf children
+    iters: jax.Array     # int64 loop iterations (stats)
+    overflow: jax.Array  # bool: capacity would have been exceeded
+
+
+def init_state(jobs: int, capacity: int, init_ub: int | None,
+               prmu0: np.ndarray | None = None,
+               depth0: np.ndarray | None = None) -> SearchState:
+    """Pool with the given seed nodes (default: the root at depth 0)."""
+    if prmu0 is None:
+        prmu0 = np.arange(jobs, dtype=np.int16)[None, :]
+        depth0 = np.zeros(1, dtype=np.int16)
+    prmu0 = np.asarray(prmu0, dtype=np.int16).reshape(-1, jobs)
+    depth0 = np.asarray(depth0, dtype=np.int16).reshape(-1)
+    n = prmu0.shape[0]
+    assert n <= capacity
+
+    prmu = np.zeros((capacity, jobs), dtype=np.int16)
+    depth = np.zeros(capacity, dtype=np.int16)
+    prmu[:n] = prmu0
+    depth[:n] = depth0
+    best = 2**31 - 1 if init_ub is None else int(init_ub)
+    return SearchState(
+        prmu=jnp.asarray(prmu),
+        depth=jnp.asarray(depth),
+        size=jnp.int32(n),
+        best=jnp.int32(best),
+        tree=jnp.int64(0),
+        sol=jnp.int64(0),
+        iters=jnp.int64(0),
+        overflow=jnp.asarray(False),
+    )
+
+
+def make_children(prmu: jax.Array, depth: jax.Array) -> jax.Array:
+    """Dense (B, J, J) child permutations: slot i swaps positions depth<->i
+    (the prefix-swap branching of decompose, reference: PFSP_lib.c:13-16)."""
+    B, J = prmu.shape
+    pos = jnp.arange(J, dtype=jnp.int32)[None, None, :]     # permutation index
+    slot = jnp.arange(J, dtype=jnp.int32)[None, :, None]    # which child
+    d = depth[:, None, None].astype(jnp.int32)
+    at_depth = jnp.take_along_axis(
+        prmu, depth[:, None].astype(jnp.int32), axis=1
+    )                                                        # (B, 1) job at prmu[depth]
+    base = prmu[:, None, :]                                  # (B, 1, J)
+    swapped_in = jnp.take_along_axis(
+        prmu, jnp.broadcast_to(slot[..., 0], (B, J)).astype(jnp.int32), axis=1
+    )[:, :, None]                                            # (B, J, 1) prmu[i]
+    child = jnp.where(pos == d, swapped_in,
+                      jnp.where(pos == slot, at_depth[:, :, None], base))
+    return child.astype(jnp.int16)
+
+
+def step(tables: BoundTables, lb_kind: int, chunk: int,
+         state: SearchState) -> SearchState:
+    """One pop->bound->prune->branch cycle (the compiled analogue of the
+    reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320)."""
+    capacity, J = state.prmu.shape
+    B = chunk
+
+    # --- pop up to B parents off the top (popBackBulk analogue)
+    n = jnp.minimum(state.size, B)
+    start = state.size - n
+    rows = start + jnp.arange(B, dtype=jnp.int32)
+    valid = jnp.arange(B) < n
+    rows = jnp.clip(rows, 0, capacity - 1)
+    p_prmu = state.prmu[rows]                        # (B, J)
+    p_depth = state.depth[rows].astype(jnp.int32)
+    p_depth = jnp.where(valid, p_depth, 0)
+
+    # --- bound the dense child grid
+    bounds = batched.children_bounds(lb_kind)(tables, p_prmu, p_depth, valid)
+    mask = batched.child_mask(p_prmu, p_depth, valid)
+
+    # --- leaves: complete schedules; count + tighten incumbent
+    # (reference: the depth==jobs branch of decompose, PFSP_lib.c:24-32)
+    is_leaf = ((p_depth + 1) == J)[:, None] & mask
+    sol = state.sol + is_leaf.sum(dtype=jnp.int64)
+    leaf_best = jnp.where(is_leaf, bounds, I32_MAX).min()
+    best = jnp.minimum(state.best, leaf_best)
+
+    # --- prune + push surviving internal children
+    push = mask & ~is_leaf & (bounds < best)
+    flat_push = push.reshape(-1)
+    n_push = flat_push.sum(dtype=jnp.int32)
+    tree = state.tree + n_push.astype(jnp.int64)
+
+    children = make_children(p_prmu, p_depth).reshape(B * J, J)
+    child_depth = jnp.broadcast_to(
+        (p_depth + 1)[:, None], (B, J)
+    ).reshape(-1).astype(jnp.int16)
+
+    # compacting scatter: k-th surviving child -> row start + k
+    dest = jnp.where(flat_push,
+                     start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
+                     capacity)                       # capacity => dropped
+    new_size = start + n_push
+    overflow = state.overflow | (new_size > capacity)
+    prmu = state.prmu.at[dest].set(children, mode="drop")
+    depth = state.depth.at[dest].set(child_depth, mode="drop")
+
+    return SearchState(prmu=prmu, depth=depth, size=new_size, best=best,
+                       tree=tree, sol=sol, iters=state.iters + 1,
+                       overflow=overflow)
+
+
+@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "max_iters"))
+def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
+        max_iters: int | None = None) -> SearchState:
+    """Run the search to exhaustion (or `max_iters`) in one compiled loop
+    (the analogue of pfsp_c.c:55-63's while(1) pop+decompose)."""
+
+    def cond(s: SearchState):
+        go = (s.size > 0) & ~s.overflow
+        if max_iters is not None:
+            go = go & (s.iters < max_iters)
+        return go
+
+    return jax.lax.while_loop(cond, functools.partial(step, tables, lb_kind, chunk),
+                              state)
+
+
+class SearchResult(NamedTuple):
+    explored_tree: int
+    explored_sol: int
+    best: int
+    iters: int
+    overflow: bool
+
+
+def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
+           chunk: int = 64, capacity: int = 1 << 18,
+           max_iters: int | None = None,
+           tables: BoundTables | None = None) -> SearchResult:
+    """Host entry point: build tables, run, fetch counters.
+
+    Retries with doubled capacity on overflow rather than failing — the
+    static-shape replacement for the reference's realloc-on-push.
+    """
+    if tables is None:
+        tables = batched.make_tables(p_times)
+    jobs = p_times.shape[1]
+    while True:
+        state = init_state(jobs, capacity, init_ub)
+        out = run(tables, state, lb_kind, chunk, max_iters)
+        if not bool(out.overflow):
+            return SearchResult(
+                explored_tree=int(out.tree), explored_sol=int(out.sol),
+                best=int(out.best), iters=int(out.iters),
+                overflow=False,
+            )
+        capacity *= 2
