@@ -1,0 +1,278 @@
+"""Multi-device distributed PFSP engine: one SPMD program over the mesh.
+
+The reference needs three nested runtimes for this — OpenMP threads per
+node (pfsp_multigpu_cuda.c:143), MPI ranks across nodes with a dedicated
+communicator thread (pfsp_dist_multigpu_cuda.c:283, 364-469), and CUDA
+streams per GPU. Here the whole hierarchy is one `shard_map`ped program
+over a 1-D worker mesh: every worker owns a private HBM pool and runs the
+same compiled loop; every `balance_period` steps the workers
+
+  - share the incumbent via `pmin` (the per-round Allreduce MIN of
+    `best_l`, dist:369-374, and the intra-node `checkBest` CAS,
+    pfsp_multigpu_cuda.c:30-50, in one op),
+  - rebalance pools via all_gather + all_to_all (see parallel/balance.py),
+
+and the loop predicate `psum(has_work) > 0` *is* the distributed
+termination detection (`globalTermination`'s Allgather of has-work flags,
+dist:69-88, moved on-device).
+
+Phase schedule mirrors the reference's 3-step scheme (dist:193-205,
+864-882): a replicated-cost host BFS warm-up generates a frontier of at
+least `min_seed * workers` nodes (step 1), round-robin striding assigns
+each worker its stripe (`roundRobin_distribution`, Pool_atom.c:14-36),
+the SPMD loop explores (step 2), and exhaustion needs no step-3 drain
+because the collective balance keeps feeding idle workers until the
+global pool is empty.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops import batched, reference as ref
+from ..ops.batched import BoundTables
+from ..parallel import balance as bal
+from ..parallel.mesh import WORKER_AXIS, shard_map, worker_mesh
+from . import sequential as seq
+from .device import SearchState, step
+
+AX = WORKER_AXIS
+
+
+# ---------------------------------------------------------------------------
+# Step 1: host BFS warm-up (breadth generates parallelism; reference runs
+# this replicated on every rank, dist:198-205 — here once on the host)
+
+
+@dataclasses.dataclass
+class Frontier:
+    prmu: np.ndarray    # (n, jobs) int16
+    depth: np.ndarray   # (n,) int16
+    tree: int           # counters accumulated during warm-up
+    sol: int
+    best: int
+
+
+def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
+               target: int) -> Frontier:
+    """Pop-front BFS until the frontier holds >= target nodes (or the tree
+    is exhausted). Same decompose semantics as the oracle, so warm-up
+    counters + device counters add up to the sequential totals."""
+    jobs = p_times.shape[1]
+    lb1 = ref.make_lb1_data(p_times)
+    lb2 = ref.make_lb2_data(lb1) if lb_kind == seq.LB2 else None
+    best = seq.INT_MAX if init_ub is None else int(init_ub)
+    tree = sol = 0
+
+    from collections import deque
+    frontier: deque[tuple[np.ndarray, int]] = deque(
+        [(np.arange(jobs, dtype=np.int16), 0)]
+    )
+    while frontier and len(frontier) < target:
+        prmu, depth = frontier.popleft()
+        limit1 = depth - 1
+        if lb_kind == seq.LB1_D:
+            lb_begin = ref.lb1_children_bounds(lb1, prmu, limit1, jobs)
+        for i in range(depth, jobs):
+            child = prmu.copy()
+            child[depth], child[i] = child[i], child[depth]
+            if lb_kind == seq.LB1:
+                bound = ref.lb1_bound(lb1, child, limit1 + 1, jobs)
+            elif lb_kind == seq.LB1_D:
+                bound = int(lb_begin[int(prmu[i])])
+            else:
+                bound = ref.lb2_bound(lb1, lb2, child, limit1 + 1, jobs, best)
+            if depth + 1 == jobs:
+                sol += 1
+                if bound < best:
+                    best = bound
+            elif bound < best:
+                frontier.append((child, depth + 1))
+                tree += 1
+
+    if frontier:
+        prmu = np.stack([f[0] for f in frontier]).astype(np.int16)
+        depth = np.array([f[1] for f in frontier], dtype=np.int16)
+    else:
+        prmu = np.zeros((0, jobs), np.int16)
+        depth = np.zeros((0,), np.int16)
+    return Frontier(prmu=prmu, depth=depth, tree=tree, sol=sol, best=best)
+
+
+# ---------------------------------------------------------------------------
+# Step 2: the SPMD search loop
+
+
+def _balance_round(s: SearchState, transfer_cap: int,
+                   min_transfer: int) -> SearchState:
+    """One collective steal-half exchange (see parallel/balance.py)."""
+    capacity, J = s.prmu.shape
+    D = jax.lax.psum(1, AX)
+    sizes = jax.lax.all_gather(s.size, AX)                  # (D,)
+    plan = bal.exchange_plan(sizes, transfer_cap, min_transfer)
+    me = jax.lax.axis_index(AX)
+    my_out = plan[me]                                       # (D,)
+    total_out = my_out.sum(dtype=jnp.int32)
+
+    # pack donated nodes (from the stack top) into per-receiver blocks
+    offs = jnp.cumsum(my_out, dtype=jnp.int32) - my_out     # exclusive prefix
+    base = s.size - total_out
+    k = jnp.arange(transfer_cap, dtype=jnp.int32)
+    rows = base + offs[:, None] + k[None, :]                # (D, cap)
+    send_mask = k[None, :] < my_out[:, None]
+    rows_c = jnp.clip(rows, 0, capacity - 1)
+    buf_prmu = s.prmu[rows_c]                               # (D, cap, J)
+    buf_depth = jnp.where(send_mask, s.depth[rows_c], -1)   # -1 = hole
+
+    rbuf_prmu = jax.lax.all_to_all(buf_prmu, AX, 0, 0)
+    rbuf_depth = jax.lax.all_to_all(buf_depth, AX, 0, 0)
+
+    # push received nodes (compacting scatter onto the new top)
+    flat_depth = rbuf_depth.reshape(-1)
+    flat_prmu = rbuf_prmu.reshape(-1, J)
+    push = flat_depth >= 0
+    n_push = push.sum(dtype=jnp.int32)
+    dest = jnp.where(push, base + jnp.cumsum(push, dtype=jnp.int32) - 1,
+                     capacity)
+    new_size = base + n_push
+    return s._replace(
+        prmu=s.prmu.at[dest].set(flat_prmu, mode="drop"),
+        depth=s.depth.at[dest].set(flat_depth.astype(jnp.int16), mode="drop"),
+        size=new_size,
+        overflow=s.overflow | (new_size > capacity),
+    )
+
+
+def _local_state(prmu, depth, size, best, tree, sol, iters, overflow):
+    return SearchState(prmu=prmu[0], depth=depth[0], size=size[0],
+                       best=best[0], tree=tree[0], sol=sol[0],
+                       iters=iters[0], overflow=overflow[0])
+
+
+def _expand(s: SearchState):
+    return tuple(x[None, ...] for x in s)
+
+
+def build_dist_run(mesh, tables: BoundTables, lb_kind: int, chunk: int,
+                   balance_period: int, transfer_cap: int,
+                   min_transfer: int, max_rounds: int | None = None):
+    """Compile the distributed search: state sharded over the worker axis,
+    bound tables replicated."""
+
+    def worker_loop(tables: BoundTables, *state_leaves):
+        s = _local_state(*state_leaves)
+
+        def cond(s: SearchState):
+            has_work = jax.lax.psum(s.size, AX) > 0
+            ok = jax.lax.psum(s.overflow.astype(jnp.int32), AX) == 0
+            go = has_work & ok
+            if max_rounds is not None:
+                go = go & (s.iters < max_rounds * balance_period)
+            return go
+
+        local_step = functools.partial(step, tables, lb_kind, chunk)
+
+        def body(s: SearchState):
+            s = jax.lax.fori_loop(0, balance_period,
+                                  lambda _, x: local_step(x), s)
+            s = s._replace(best=jax.lax.pmin(s.best, AX))
+            return _balance_round(s, transfer_cap, min_transfer)
+
+        return _expand(jax.lax.while_loop(cond, body, s))
+
+    spec_state = tuple(P(AX) for _ in SearchState._fields)
+    spec_tables = jax.tree.map(lambda _: P(), tables)
+    return jax.jit(shard_map(
+        worker_loop, mesh,
+        in_specs=(spec_tables,) + spec_state,
+        out_specs=spec_state,
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Host entry point
+
+
+class DistResult:
+    def __init__(self, explored_tree, explored_sol, best, per_device,
+                 warmup_tree, warmup_sol):
+        self.explored_tree = explored_tree
+        self.explored_sol = explored_sol
+        self.best = best
+        self.per_device = per_device        # dict of (D,) arrays for stats
+        self.warmup_tree = warmup_tree
+        self.warmup_sol = warmup_sol
+
+
+def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
+                    init_best: int):
+    """Round-robin stripe the frontier across workers
+    (reference: roundRobin_distribution, Pool_atom.c:14-36)."""
+    prmu = np.zeros((n_dev, capacity, jobs), np.int16)
+    depth = np.zeros((n_dev, capacity), np.int16)
+    sizes = np.zeros(n_dev, np.int32)
+    for d in range(n_dev):
+        stripe_p = fr.prmu[d::n_dev]
+        stripe_d = fr.depth[d::n_dev]
+        n = len(stripe_d)
+        assert n <= capacity
+        prmu[d, :n] = stripe_p
+        depth[d, :n] = stripe_d
+        sizes[d] = n
+    return (
+        jnp.asarray(prmu), jnp.asarray(depth), jnp.asarray(sizes),
+        jnp.full((n_dev,), init_best, jnp.int32),
+        jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
+        jnp.zeros(n_dev, jnp.int64),
+        jnp.zeros(n_dev, bool),
+    )
+
+
+def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
+           n_devices: int | None = None, chunk: int = 64,
+           capacity: int = 1 << 17, balance_period: int = 4,
+           transfer_cap: int | None = None, min_transfer: int | None = None,
+           min_seed: int = 32, max_rounds: int | None = None,
+           tables: BoundTables | None = None, mesh=None) -> DistResult:
+    """Distributed B&B over all available devices (the flagship engine;
+    capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search)."""
+    if mesh is None:
+        mesh = worker_mesh(n_devices)
+    n_dev = mesh.devices.size
+    jobs = p_times.shape[1]
+    if tables is None:
+        tables = batched.make_tables(p_times)
+    transfer_cap = transfer_cap or 4 * chunk
+    min_transfer = min_transfer or 2 * chunk
+
+    fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
+    init_best = fr.best if init_ub is None else min(fr.best, int(init_ub))
+
+    run = build_dist_run(mesh, tables, lb_kind, chunk, balance_period,
+                         transfer_cap, min_transfer, max_rounds)
+    while True:
+        state = _shard_frontier(fr, n_dev, capacity, jobs, init_best)
+        out = SearchState(*run(tables, *state))
+        if not bool(np.asarray(out.overflow).any()):
+            break
+        capacity *= 2
+
+    tree_dev = np.asarray(out.tree)
+    sol_dev = np.asarray(out.sol)
+    return DistResult(
+        explored_tree=int(tree_dev.sum()) + fr.tree,
+        explored_sol=int(sol_dev.sum()) + fr.sol,
+        best=int(np.asarray(out.best).min()),
+        per_device={
+            "tree": tree_dev, "sol": sol_dev,
+            "iters": np.asarray(out.iters),
+            "final_size": np.asarray(out.size),
+        },
+        warmup_tree=fr.tree, warmup_sol=fr.sol,
+    )

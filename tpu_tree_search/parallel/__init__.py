@@ -1,0 +1,3 @@
+from . import mesh, balance
+
+__all__ = ["mesh", "balance"]

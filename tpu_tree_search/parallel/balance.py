@@ -1,0 +1,55 @@
+"""Collective load balancing: work stealing as an all_to_all exchange.
+
+The reference has two dynamic load-balancing tiers: intra-node randomized
+steal-half work stealing with CAS spin-locks (WS0/WS1 loops,
+pfsp_multigpu_cuda.c:347-431) and inter-node collective redistribution
+driven by a dedicated communicator thread (Allgather of needs + donor pops
++ Allgatherv scatter, pfsp_dist_multigpu_cuda.c:380-465). On a TPU mesh
+both collapse into one synchronous exchange executed by every worker
+inside the compiled loop:
+
+1. `all_gather` the pool sizes (every worker sees the global picture —
+   the analogue of the Allgather of `local_need`).
+2. Compute a deterministic exchange plan, identically on every worker:
+   rank workers by size; the r-th fullest donates to the r-th emptiest
+   half of their difference (steal-half, the reference's `ratio=2`
+   semantics from popBackBulk, Pool_atom.c:154-178), capped by the static
+   transfer-buffer size.
+3. Donors pop from the top of their stack (deepest nodes — preserving the
+   DFS locality the reference's popBack stealing keeps), pack into a
+   (workers, cap, ...) buffer, `all_to_all` it, receivers push valid rows.
+
+No locks, no victim retries, no communicator thread: the plan is a pure
+function of the gathered sizes, so every worker agrees on it by
+construction. Empty-handed workers keep looping (their local steps are
+masked no-ops) until the exchange refills them or global termination —
+the reference's idle-spin + reawaken protocol (dist:652-686) with the
+spin replaced by the loop's own cadence.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def exchange_plan(sizes: jax.Array, cap: int, min_transfer: int) -> jax.Array:
+    """(D, D) flow matrix: plan[d, e] nodes move d -> e this round.
+
+    Pure function of the globally-known sizes vector, so every worker
+    computes the same plan. Pairing: r-th largest donates to r-th
+    smallest `min(cap, (diff)//2)` when diff >= min_transfer (steal-half
+    with the reference's `size >= 2m` steal threshold, Pool_atom.c:154-178).
+    """
+    D = sizes.shape[0]
+    sizes = sizes.astype(jnp.int32)
+    order_desc = jnp.argsort(-sizes)            # stable: ties by worker id
+    order_asc = jnp.argsort(sizes)
+    donors = order_desc                          # (D,)
+    receivers = order_asc
+    diff = sizes[donors] - sizes[receivers]
+    amount = jnp.clip(diff // 2, 0, cap)
+    amount = jnp.where(diff >= min_transfer, amount, 0)
+    amount = jnp.where(donors == receivers, 0, amount)
+    plan = jnp.zeros((D, D), jnp.int32).at[donors, receivers].add(amount)
+    return plan

@@ -1,0 +1,48 @@
+"""Device-mesh construction.
+
+The reference binds parallel workers explicitly — OpenMP thread ids to
+CUDA devices intra-node (pfsp_multigpu_cuda.c:159-160) and MPI ranks to
+nodes inter-node (pfsp_dist_multigpu_cuda.c:910). The TPU equivalent is a
+`jax.sharding.Mesh` with a single `"workers"` axis laid over all chips:
+ICI inside a slice, DCN across hosts, with no code distinction between
+the two tiers — growing the mesh is the only change for multi-host
+(`jax.distributed.initialize` + the same program).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+WORKER_AXIS = "workers"
+
+
+def worker_mesh(n_devices: int | None = None,
+                devices: list | None = None) -> Mesh:
+    """1-D mesh over all (or the first n) addressable devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        assert len(devices) >= n_devices, (
+            f"need {n_devices} devices, have {len(devices)}"
+        )
+        devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (WORKER_AXIS,))
+
+
+def shard_map(fn, mesh, in_specs, out_specs):
+    """Version-tolerant shard_map wrapper.
+
+    check_vma is disabled: the engine's scan/while carries are seeded from
+    unvarying constants but updated from worker-varying pool data, which
+    the varying-manual-axes checker rejects even though the program is a
+    correct SPMD computation (collectives appear only at the balance and
+    termination points, by construction).
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False)
