@@ -159,13 +159,14 @@ def _expand(s: SearchState):
     return tuple(x[None, ...] for x in s)
 
 
-def build_dist_run(mesh, tables: BoundTables, lb_kind: int, chunk: int,
-                   balance_period: int, transfer_cap: int,
-                   min_transfer: int, max_rounds: int | None = None):
-    """Compile the distributed search: state sharded over the worker axis,
-    bound tables replicated."""
+def build_dist_loop(mesh, tables, make_local_step,
+                    balance_period: int, transfer_cap: int,
+                    min_transfer: int, max_rounds: int | None = None):
+    """Compile a distributed search loop for any problem: state sharded over
+    the worker axis, problem tables replicated. `make_local_step(tables)`
+    returns the problem's SearchState -> SearchState step."""
 
-    def worker_loop(tables: BoundTables, *state_leaves):
+    def worker_loop(tables, *state_leaves):
         s = _local_state(*state_leaves)
 
         def cond(s: SearchState):
@@ -176,7 +177,7 @@ def build_dist_run(mesh, tables: BoundTables, lb_kind: int, chunk: int,
                 go = go & (s.iters < max_rounds * balance_period)
             return go
 
-        local_step = functools.partial(step, tables, lb_kind, chunk)
+        local_step = make_local_step(tables)
 
         def body(s: SearchState):
             s = jax.lax.fori_loop(0, balance_period,
@@ -254,8 +255,11 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
     init_best = fr.best if init_ub is None else min(fr.best, int(init_ub))
 
-    run = build_dist_run(mesh, tables, lb_kind, chunk, balance_period,
-                         transfer_cap, min_transfer, max_rounds)
+    def make_local_step(t):
+        return functools.partial(step, t, lb_kind, chunk)
+
+    run = build_dist_loop(mesh, tables, make_local_step, balance_period,
+                          transfer_cap, min_transfer, max_rounds)
     while True:
         state = _shard_frontier(fr, n_dev, capacity, jobs, init_best)
         out = SearchState(*run(tables, *state))

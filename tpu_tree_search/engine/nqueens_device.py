@@ -1,0 +1,156 @@
+"""N-Queens device engines (single-device and distributed).
+
+Same HBM-pool machinery as the PFSP engine (engine/device.py) with the
+problem-specific differences of the reference's N-Queens programs
+(reference: nqueens_c.c:99-148, nqueens_multigpu_cuda.cu:213-360):
+
+- children are *safe* candidates, all of which are pushed — including
+  complete boards (no bound, no incumbent);
+- a popped node at depth N counts as a solution;
+- `explored_tree` counts pushes, as in PFSP.
+
+The reference's multi-GPU N-Queens has no work stealing (static split
+only, SURVEY.md §2.2); the TPU version reuses the collective balancer
+anyway — strictly more capable, same results.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import nqueens_ops
+from ..parallel.mesh import worker_mesh
+from . import distributed as dist
+from .device import SearchState, init_state, make_children
+
+I32_MAX = jnp.int32(2**31 - 1)
+
+
+def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
+    """One pop -> safety-check -> branch cycle."""
+    capacity, N = state.prmu.shape
+    B = chunk
+
+    n_pop = jnp.minimum(state.size, B)
+    start = state.size - n_pop
+    rows = jnp.clip(start + jnp.arange(B, dtype=jnp.int32), 0, capacity - 1)
+    valid = jnp.arange(B) < n_pop
+    board = state.prmu[rows]
+    depth = jnp.where(valid, state.depth[rows].astype(jnp.int32), 0)
+
+    # popped complete boards are solutions (reference: nqueens_c.c:104-106)
+    sol = state.sol + ((depth == N) & valid).sum(dtype=jnp.int64)
+
+    push = nqueens_ops.safe_children(board, depth, valid, g=g)
+    flat_push = push.reshape(-1)
+    n_push = flat_push.sum(dtype=jnp.int32)
+    tree = state.tree + n_push.astype(jnp.int64)
+
+    children = make_children(board, depth).reshape(B * N, N)
+    child_depth = jnp.broadcast_to((depth + 1)[:, None], (B, N)) \
+        .reshape(-1).astype(jnp.int16)
+
+    dest = jnp.where(flat_push,
+                     start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
+                     capacity)
+    new_size = start + n_push
+    return SearchState(
+        prmu=state.prmu.at[dest].set(children, mode="drop"),
+        depth=state.depth.at[dest].set(child_depth, mode="drop"),
+        size=new_size, best=state.best, tree=tree, sol=sol,
+        iters=state.iters + 1,
+        overflow=state.overflow | (new_size > capacity),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n", "g", "chunk", "max_iters"))
+def run(state: SearchState, n: int, g: int, chunk: int,
+        max_iters: int | None = None) -> SearchState:
+    def cond(s):
+        go = (s.size > 0) & ~s.overflow
+        if max_iters is not None:
+            go = go & (s.iters < max_iters)
+        return go
+
+    return jax.lax.while_loop(cond, functools.partial(nq_step, n, g, chunk),
+                              state)
+
+
+class NQResult(NamedTuple):
+    explored_tree: int
+    explored_sol: int
+    iters: int
+
+
+def search(n: int, g: int = 1, chunk: int = 64, capacity: int = 1 << 18,
+           max_iters: int | None = None) -> NQResult:
+    """Single-device N-Queens search (reference: nqueens_gpu_cuda.cu)."""
+    while True:
+        state = init_state(n, capacity, None)
+        out = run(state, n, g, chunk, max_iters)
+        if not bool(out.overflow):
+            return NQResult(explored_tree=int(out.tree),
+                            explored_sol=int(out.sol),
+                            iters=int(out.iters))
+        capacity *= 2
+
+
+def bfs_warmup(n: int, target: int):
+    """Host BFS frontier for seeding the mesh (reference step 1,
+    nqueens_multigpu_cuda.cu:232-238)."""
+    from collections import deque
+
+    from ..problems import nqueens as nq
+    tree = sol = 0
+    frontier = deque([(np.arange(n, dtype=np.int16), 0)])
+    while frontier and len(frontier) < target:
+        board, depth = frontier.popleft()
+        if depth == n:
+            sol += 1
+            continue
+        for j in range(depth, n):
+            if nq.is_safe(board, depth, int(board[j])):
+                child = board.copy()
+                child[depth], child[j] = child[j], child[depth]
+                frontier.append((child, depth + 1))
+                tree += 1
+    prmu = (np.stack([f[0] for f in frontier]).astype(np.int16)
+            if frontier else np.zeros((0, n), np.int16))
+    depths = np.array([f[1] for f in frontier], dtype=np.int16)
+    return dist.Frontier(prmu=prmu, depth=depths, tree=tree, sol=sol,
+                         best=2**31 - 1)
+
+
+def search_distributed(n: int, g: int = 1, n_devices: int | None = None,
+                       chunk: int = 64, capacity: int = 1 << 17,
+                       balance_period: int = 4, min_seed: int = 32,
+                       mesh=None) -> NQResult:
+    """Distributed N-Queens over the worker mesh
+    (capability parity with nqueens_multigpu_cuda.cu, plus balancing)."""
+    if mesh is None:
+        mesh = worker_mesh(n_devices)
+    n_dev = mesh.devices.size
+    fr = bfs_warmup(n, target=min_seed * n_dev)
+
+    def make_local_step(_tables):
+        return functools.partial(nq_step, n, g, chunk)
+
+    loop = dist.build_dist_loop(mesh, (), make_local_step, balance_period,
+                                transfer_cap=4 * chunk,
+                                min_transfer=2 * chunk)
+    while True:
+        state = dist._shard_frontier(fr, n_dev, capacity, n, 2**31 - 1)
+        out = SearchState(*loop((), *state))
+        if not bool(np.asarray(out.overflow).any()):
+            break
+        capacity *= 2
+    return NQResult(
+        explored_tree=int(np.asarray(out.tree).sum()) + fr.tree,
+        explored_sol=int(np.asarray(out.sol).sum()) + fr.sol,
+        iters=int(np.asarray(out.iters).max()),
+    )
