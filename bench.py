@@ -1,0 +1,72 @@
+"""Benchmark driver: PFSP B&B node-evaluation throughput on one chip.
+
+Runs the single-device engine on Taillard ta021 (20 jobs x 20 machines,
+the hardest instance of the reference's headline single-GPU set,
+BASELINE.md) with LB1 and ub=opt for a fixed number of compiled loop
+iterations, and reports child-bound evaluations per second.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "node_evals_per_sec", "vs_baseline": N}
+
+`vs_baseline` is the fraction of the north-star target of 1e9 node
+evaluations/sec (BASELINE.json: the v5p-32 pod-level goal for the port;
+single-chip values are a lower bound on the pod rate, which scales with
+the mesh).
+"""
+
+import json
+import os
+import sys
+import time
+
+# allow platform override for local debugging (e.g. TTS_BENCH_PLATFORM=cpu)
+if os.environ.get("TTS_BENCH_PLATFORM"):
+    os.environ["JAX_PLATFORMS"] = os.environ["TTS_BENCH_PLATFORM"]
+    import jax
+    jax.config.update("jax_platforms", os.environ["TTS_BENCH_PLATFORM"])
+
+import numpy as np  # noqa: E402
+
+from tpu_tree_search.engine import device  # noqa: E402
+from tpu_tree_search.ops import batched  # noqa: E402
+from tpu_tree_search.problems import taillard  # noqa: E402
+
+
+def main():
+    inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
+    lb_kind = int(os.environ.get("TTS_BENCH_LB", "1"))
+    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "512"))
+    iters = int(os.environ.get("TTS_BENCH_ITERS", "600"))
+    capacity = 1 << 20
+
+    p = taillard.processing_times(inst)
+    ub = taillard.optimal_makespan(inst)
+    tables = batched.make_tables(p)
+    jobs = p.shape[1]
+
+    # compile + warm the pool (also past the shallow, underfilled iterations)
+    state = device.init_state(jobs, capacity, ub)
+    state = device.run(tables, state, lb_kind, chunk, max_iters=50)
+    state.size.block_until_ready()
+    evals0 = int(state.evals)
+
+    t0 = time.perf_counter()
+    state = device.run(tables, state, lb_kind, chunk, max_iters=50 + iters)
+    state.size.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    evals = int(state.evals) - evals0
+    rate = evals / dt
+    print(json.dumps({
+        "metric": f"pfsp_ta{inst:03d}_lb{lb_kind}_node_evals_per_sec_per_chip",
+        "value": round(rate, 1),
+        "unit": "node_evals_per_sec",
+        "vs_baseline": round(rate / 1e9, 4),
+    }))
+    print(f"# evals={evals} dt={dt:.3f}s iters={iters} chunk={chunk} "
+          f"pool={int(state.size)} best={int(state.best)}",
+          file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,7 @@ class SearchState(NamedTuple):
     tree: jax.Array      # int64 explored (= pushed) internal nodes
     sol: jax.Array       # int64 evaluated leaf children
     iters: jax.Array     # int64 loop iterations (stats)
+    evals: jax.Array     # int64 child bound evaluations (the bench metric)
     overflow: jax.Array  # bool: capacity would have been exceeded
 
 
@@ -81,6 +82,7 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
         tree=jnp.int64(0),
         sol=jnp.int64(0),
         iters=jnp.int64(0),
+        evals=jnp.int64(0),
         overflow=jnp.asarray(False),
     )
 
@@ -154,6 +156,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
     return SearchState(prmu=prmu, depth=depth, size=new_size, best=best,
                        tree=tree, sol=sol, iters=state.iters + 1,
+                       evals=state.evals + mask.sum(dtype=jnp.int64),
                        overflow=overflow)
 
 
@@ -178,6 +181,7 @@ class SearchResult(NamedTuple):
     explored_sol: int
     best: int
     iters: int
+    evals: int
     overflow: bool
 
 
@@ -200,6 +204,6 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             return SearchResult(
                 explored_tree=int(out.tree), explored_sol=int(out.sol),
                 best=int(out.best), iters=int(out.iters),
-                overflow=False,
+                evals=int(out.evals), overflow=False,
             )
         capacity *= 2

@@ -149,10 +149,10 @@ def _balance_round(s: SearchState, transfer_cap: int,
     )
 
 
-def _local_state(prmu, depth, size, best, tree, sol, iters, overflow):
+def _local_state(prmu, depth, size, best, tree, sol, iters, evals, overflow):
     return SearchState(prmu=prmu[0], depth=depth[0], size=size[0],
                        best=best[0], tree=tree[0], sol=sol[0],
-                       iters=iters[0], overflow=overflow[0])
+                       iters=iters[0], evals=evals[0], overflow=overflow[0])
 
 
 def _expand(s: SearchState):
@@ -230,7 +230,7 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
         jnp.asarray(prmu), jnp.asarray(depth), jnp.asarray(sizes),
         jnp.full((n_dev,), init_best, jnp.int32),
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
-        jnp.zeros(n_dev, jnp.int64),
+        jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, bool),
     )
 
@@ -276,6 +276,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         per_device={
             "tree": tree_dev, "sol": sol_dev,
             "iters": np.asarray(out.iters),
+            "evals": np.asarray(out.evals),
             "final_size": np.asarray(out.size),
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,

@@ -64,6 +64,8 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
         depth=state.depth.at[dest].set(child_depth, mode="drop"),
         size=new_size, best=state.best, tree=tree, sol=sol,
         iters=state.iters + 1,
+        evals=state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
+                             & valid[:, None]).sum(dtype=jnp.int64),
         overflow=state.overflow | (new_size > capacity),
     )
 
