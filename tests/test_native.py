@@ -1,0 +1,49 @@
+"""Native C++ host runtime vs the Python oracle (exact count equality)."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search import native
+from tpu_tree_search.engine import sequential as seq
+from tpu_tree_search.problems import taillard
+from tpu_tree_search.problems.pfsp import PFSPInstance
+
+
+def test_native_builds():
+    native.build()
+
+
+def test_native_taillard_matches_python():
+    for inst in (1, 14, 31, 56, 111):
+        np.testing.assert_array_equal(native.processing_times(inst),
+                                      taillard.processing_times(inst))
+        assert native.optimal_makespan(inst) == taillard.optimal_makespan(inst)
+
+
+@pytest.mark.parametrize("lb_kind", [0, 1, 2])
+@pytest.mark.parametrize("ub", ["opt", "inf"])
+def test_native_search_matches_oracle(lb_kind, ub):
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=11)
+    init_ub = inst.brute_force_optimum() if ub == "opt" else None
+    want = seq.pfsp_search(inst, lb=lb_kind, init_ub=init_ub)
+    tree, sol, best, _ = native.search(inst.p_times, lb_kind, init_ub)
+    assert (tree, sol, best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_native_bfs_frontier_matches_python_warmup():
+    from tpu_tree_search.engine import distributed
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=12)
+    fr = distributed.bfs_warmup(inst.p_times, 1, None, target=20)
+    prmu, depth, tree, sol, best = native.bfs_frontier(
+        inst.p_times, 1, None, target=20)
+    assert (tree, sol, best) == (fr.tree, fr.sol, fr.best)
+    np.testing.assert_array_equal(prmu, fr.prmu)
+    np.testing.assert_array_equal(depth, fr.depth)
+
+
+@pytest.mark.parametrize("n", [6, 8, 9])
+def test_native_nqueens(n):
+    want = seq.nqueens_search(n)
+    tree, sol, _ = native.nqueens(n)
+    assert (tree, sol) == (want.explored_tree, want.explored_sol)

@@ -60,10 +60,23 @@ class Frontier:
 
 
 def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
-               target: int) -> Frontier:
+               target: int, use_native: bool = True) -> Frontier:
     """Pop-front BFS until the frontier holds >= target nodes (or the tree
     is exhausted). Same decompose semantics as the oracle, so warm-up
-    counters + device counters add up to the sequential totals."""
+    counters + device counters add up to the sequential totals.
+
+    Uses the native C++ runtime when available (tpu_tree_search/native);
+    the pure-Python path below is the validated fallback/oracle.
+    """
+    if use_native:
+        try:
+            from .. import native
+            prmu, depth, tree, sol, best = native.bfs_frontier(
+                p_times, lb_kind, init_ub, target)
+            return Frontier(prmu=prmu, depth=depth, tree=tree, sol=sol,
+                            best=best)
+        except Exception:
+            pass  # fall through to the Python implementation
     jobs = p_times.shape[1]
     lb1 = ref.make_lb1_data(p_times)
     lb2 = ref.make_lb2_data(lb1) if lb_kind == seq.LB2 else None
