@@ -1,0 +1,58 @@
+"""Stats toolkit and CSV schema tests."""
+
+import numpy as np
+
+from tpu_tree_search.utils import csv_stats, stats
+
+
+def test_boxplot_stats_basics():
+    b = stats.compute_boxplot_stats([1, 2, 3, 4, 5, 6, 7, 8, 9])
+    assert b.minimum == 1 and b.maximum == 9 and b.median == 5
+    assert b.q1 == 2.5 and b.q3 == 7.5          # Tukey hinges, odd n
+    assert np.isclose(b.iqr, 5.0)
+    assert np.isclose(b.mean, 5.0)
+
+
+def test_percentile_interpolation():
+    v = np.array([10.0, 20.0, 30.0, 40.0])
+    assert stats.percentile_sorted(v, 0.5) == 25.0
+    assert stats.percentile_sorted(v, 0.0) == 10.0
+    assert stats.percentile_sorted(v, 1.0) == 40.0
+
+
+def test_csv_single_schema(tmp_path):
+    import pandas as pd
+    path = str(tmp_path / "singlegpu.csv")
+    csv_stats.write_single(path, 14, 1, 1377, 25, 50000, 1.5, 1.2, 100, 10)
+    csv_stats.write_single(path, 21, 2, 2297, 25, 50000, 2.5, 2.2, 200, 20)
+    df = pd.read_csv(path)
+    assert list(df.columns) == csv_stats.SINGLE_HEADER.split(",")
+    assert len(df) == 2
+    assert df.loc[1, "optimum"] == 2297
+
+
+def test_csv_dist_schema_roundtrip(tmp_path):
+    import pandas as pd
+    path = str(tmp_path / "dist_multigpu.csv")
+    per_device = {"tree": [5, 6], "sol": [1, 2], "evals": [50, 60],
+                  "steals": [1, 0], "recv": [10, 0]}
+    csv_stats.write_dist(path, 21, 1, 2, 0, 1, 1, 2297, 25, 50000, 5000,
+                         3.5, 11, 3, per_device)
+    df = pd.read_csv(path)
+    assert list(df.columns) == csv_stats.DIST_HEADER.split(",")
+    # array cells parse back the way the reference's data scripts do
+    assert df.loc[0, "all_exp_tree_gpu"] == "[5,6]"
+
+
+def test_cli_pfsp_runs(tmp_path, capsys):
+    """End-to-end CLI on the smallest real workload shape we can afford in
+    CI: truncated ta014 run."""
+    from tpu_tree_search.cli import main
+    csv = str(tmp_path / "out.csv")
+    rc = main(["pfsp", "-i", "14", "-l", "1", "-u", "1", "-D", "1",
+               "--chunk", "16", "--capacity", "65536",
+               "--max-iters", "5", "--csv", csv])
+    assert rc == 0
+    captured = capsys.readouterr().out
+    assert "ta14" in captured and "Elapsed time" in captured
+    assert (tmp_path / "out.csv").exists()

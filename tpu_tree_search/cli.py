@@ -1,0 +1,160 @@
+"""Command-line interface.
+
+Mirrors the reference's flag vocabulary (reference: PFSP_lib.c:173-320 for
+PFSP, nqueens_multigpu_cuda.cu:25-89 for N-Queens) and its settings/results
+report format (PFSP_lib.c:133-170), so reference users can re-run their
+command lines against the TPU engine:
+
+    python -m tpu_tree_search pfsp -i 14 -l 1 -u 1 -D 1
+    python -m tpu_tree_search nqueens -N 13 -g 1
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+from .utils.config import NQueensConfig, PFSPConfig
+
+
+def _pfsp_parser(sub):
+    p = sub.add_parser("pfsp", help="Taillard PFSP B&B")
+    d = PFSPConfig()
+    p.add_argument("-i", "--inst", type=int, default=d.inst)
+    p.add_argument("-l", "--lb", type=int, default=d.lb, choices=(0, 1, 2))
+    p.add_argument("-u", "--ub", type=int, default=d.ub, choices=(0, 1))
+    p.add_argument("-m", type=int, default=d.m)
+    p.add_argument("-M", type=int, default=d.M)
+    p.add_argument("-T", type=int, default=d.T)
+    p.add_argument("-D", type=int, default=d.D)
+    p.add_argument("-C", type=int, default=d.C)
+    p.add_argument("-w", "--ws", type=int, default=d.ws)
+    p.add_argument("-L", type=int, default=d.L)
+    p.add_argument("-p", "--perc", type=float, default=d.perc)
+    p.add_argument("--chunk", type=int, default=d.chunk)
+    p.add_argument("--capacity", type=int, default=d.capacity)
+    p.add_argument("--balance-period", type=int, default=d.balance_period)
+    p.add_argument("--csv", type=str, default=None)
+    p.add_argument("--max-iters", type=int, default=None,
+                   help="truncate the search (debugging)")
+
+
+def _nq_parser(sub):
+    p = sub.add_parser("nqueens", help="N-Queens backtracking")
+    d = NQueensConfig()
+    p.add_argument("-N", type=int, default=d.N)
+    p.add_argument("-g", type=int, default=d.g)
+    p.add_argument("-D", type=int, default=d.D)
+    p.add_argument("--chunk", type=int, default=d.chunk)
+    p.add_argument("--capacity", type=int, default=d.capacity)
+
+
+def _print_pfsp_settings(args, machines, jobs, n_dev):
+    print("=" * 49)
+    print(f"TPU B&B ({n_dev} device(s) - balancing [{int(args.ws or args.L)}])")
+    print(f"Resolution of PFSP Taillard's instance: ta{args.inst} "
+          f"(m = {machines}, n = {jobs})")
+    print("Initial upper bound: " + ("opt" if args.ub == 1 else "inf"))
+    print("Lower bound function: " + {0: "lb1_d", 1: "lb1", 2: "lb2"}[args.lb])
+    print("Branching rule: fwd")
+    print("=" * 49)
+
+
+def _print_results(optimum, tree, sol, elapsed):
+    print("=" * 49)
+    print(f"Size of the explored tree: {tree}")
+    print(f"Number of explored solutions: {sol}")
+    print(f"Optimal makespan: {optimum}")
+    print(f"Elapsed time: {elapsed:.4f} [s]")
+    print("=" * 49)
+
+
+def run_pfsp(args) -> int:
+    import jax
+
+    from .engine import device, distributed
+    from .problems import taillard
+    from .utils import csv_stats
+
+    p = taillard.processing_times(args.inst)
+    jobs, machines = p.shape[1], p.shape[0]
+    init_ub = taillard.optimal_makespan(args.inst) if args.ub == 1 else None
+    n_dev = args.D if args.D > 0 else len(jax.devices())
+    _print_pfsp_settings(args, machines, jobs, n_dev)
+
+    t0 = time.perf_counter()
+    if n_dev == 1:
+        out = device.search(p, lb_kind=args.lb, init_ub=init_ub,
+                            chunk=args.chunk, capacity=args.capacity,
+                            max_iters=args.max_iters)
+        tree, sol, best = out.explored_tree, out.explored_sol, out.best
+        per_device = {"tree": [tree], "sol": [sol], "evals": [out.evals],
+                      "steals": [0], "recv": [0]}
+    else:
+        res = distributed.search(
+            p, lb_kind=args.lb, init_ub=init_ub, n_devices=n_dev,
+            chunk=args.chunk, capacity=args.capacity,
+            balance_period=(args.balance_period if (args.ws or args.L)
+                            else 1 << 30),
+            min_seed=args.m,
+            max_rounds=args.max_iters)
+        tree, sol, best = res.explored_tree, res.explored_sol, res.best
+        per_device = {k: list(v) for k, v in res.per_device.items()}
+    elapsed = time.perf_counter() - t0
+
+    _print_results(best, tree, sol, elapsed)
+    if args.csv:
+        if n_dev == 1:
+            csv_stats.write_single(args.csv, args.inst, args.lb, best, args.m,
+                                   args.M, elapsed, elapsed, tree, sol)
+        else:
+            csv_stats.write_dist(args.csv, args.inst, args.lb, n_dev, args.C,
+                                 args.L, 1, best, args.m, args.M, args.T,
+                                 elapsed, tree, sol, per_device)
+    return 0
+
+
+def run_nqueens(args) -> int:
+    import jax
+
+    from .engine import nqueens_device
+
+    n_dev = args.D if args.D > 0 else len(jax.devices())
+    print("=" * 49)
+    print(f"TPU N-Queens ({n_dev} device(s))")
+    print(f"Resolution of the {args.N}-Queens instance")
+    print(f"  with {args.g} safety check(s) per evaluation")
+    print("=" * 49)
+    t0 = time.perf_counter()
+    if n_dev == 1:
+        out = nqueens_device.search(args.N, g=args.g, chunk=args.chunk,
+                                    capacity=args.capacity)
+    else:
+        out = nqueens_device.search_distributed(
+            args.N, g=args.g, n_devices=n_dev, chunk=args.chunk,
+            capacity=args.capacity)
+    elapsed = time.perf_counter() - t0
+    print("=" * 49)
+    print(f"Size of the explored tree: {out.explored_tree}")
+    print(f"Number of explored solutions: {out.explored_sol}")
+    print(f"Elapsed time: {elapsed:.4f} [s]")
+    print("=" * 49)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpu_tree_search")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    _pfsp_parser(sub)
+    _nq_parser(sub)
+    args = ap.parse_args(argv)
+    if args.cmd == "pfsp":
+        return run_pfsp(args)
+    return run_nqueens(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,6 +54,9 @@ class SearchState(NamedTuple):
     sol: jax.Array       # int64 evaluated leaf children
     iters: jax.Array     # int64 loop iterations (stats)
     evals: jax.Array     # int64 child bound evaluations (the bench metric)
+    sent: jax.Array      # int64 nodes donated via balance exchanges
+    recv: jax.Array      # int64 nodes received via balance exchanges
+    steals: jax.Array    # int64 balance rounds that received > 0 nodes
     overflow: jax.Array  # bool: capacity would have been exceeded
 
 
@@ -83,6 +86,9 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
         sol=jnp.int64(0),
         iters=jnp.int64(0),
         evals=jnp.int64(0),
+        sent=jnp.int64(0),
+        recv=jnp.int64(0),
+        steals=jnp.int64(0),
         overflow=jnp.asarray(False),
     )
 
@@ -154,10 +160,10 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     prmu = state.prmu.at[dest].set(children, mode="drop")
     depth = state.depth.at[dest].set(child_depth, mode="drop")
 
-    return SearchState(prmu=prmu, depth=depth, size=new_size, best=best,
-                       tree=tree, sol=sol, iters=state.iters + 1,
-                       evals=state.evals + mask.sum(dtype=jnp.int64),
-                       overflow=overflow)
+    return state._replace(prmu=prmu, depth=depth, size=new_size, best=best,
+                          tree=tree, sol=sol, iters=state.iters + 1,
+                          evals=state.evals + mask.sum(dtype=jnp.int64),
+                          overflow=overflow)
 
 
 @functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "max_iters"))

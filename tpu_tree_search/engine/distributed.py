@@ -158,14 +158,15 @@ def _balance_round(s: SearchState, transfer_cap: int,
         prmu=s.prmu.at[dest].set(flat_prmu, mode="drop"),
         depth=s.depth.at[dest].set(flat_depth.astype(jnp.int16), mode="drop"),
         size=new_size,
+        sent=s.sent + total_out.astype(jnp.int64),
+        recv=s.recv + n_push.astype(jnp.int64),
+        steals=s.steals + (n_push > 0).astype(jnp.int64),
         overflow=s.overflow | (new_size > capacity),
     )
 
 
-def _local_state(prmu, depth, size, best, tree, sol, iters, evals, overflow):
-    return SearchState(prmu=prmu[0], depth=depth[0], size=size[0],
-                       best=best[0], tree=tree[0], sol=sol[0],
-                       iters=iters[0], evals=evals[0], overflow=overflow[0])
+def _local_state(*leaves):
+    return SearchState(*(x[0] for x in leaves))
 
 
 def _expand(s: SearchState):
@@ -244,6 +245,8 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
         jnp.full((n_dev,), init_best, jnp.int32),
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
+        jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
+        jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, bool),
     )
 
@@ -290,6 +293,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             "tree": tree_dev, "sol": sol_dev,
             "iters": np.asarray(out.iters),
             "evals": np.asarray(out.evals),
+            "sent": np.asarray(out.sent),
+            "recv": np.asarray(out.recv),
+            "steals": np.asarray(out.steals),
             "final_size": np.asarray(out.size),
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,

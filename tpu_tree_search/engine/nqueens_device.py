@@ -59,10 +59,10 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
                      start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
                      capacity)
     new_size = start + n_push
-    return SearchState(
+    return state._replace(
         prmu=state.prmu.at[dest].set(children, mode="drop"),
         depth=state.depth.at[dest].set(child_depth, mode="drop"),
-        size=new_size, best=state.best, tree=tree, sol=sol,
+        size=new_size, tree=tree, sol=sol,
         iters=state.iters + 1,
         evals=state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
                              & valid[:, None]).sum(dtype=jnp.int64),

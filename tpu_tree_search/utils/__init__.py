@@ -1,0 +1,3 @@
+from . import stats, csv_stats, config
+
+__all__ = ["stats", "csv_stats", "config"]

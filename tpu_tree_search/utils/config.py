@@ -1,0 +1,49 @@
+"""Run configuration.
+
+One dataclass replaces the reference's three config tiers (SURVEY.md §5):
+getopt CLI flags (PFSP_lib.c:173-320), compile-time size macros
+(macro.h:9-11 — here just static shapes baked into jit), and site
+makefiles (N/A: one toolchain). Reference flags keep their names and
+defaults (PFSP_lib.c:175-185); TPU-specific knobs are documented inline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+@dataclasses.dataclass
+class PFSPConfig:
+    # --- reference flags (semantics per README.md:49-101)
+    inst: int = 14        # -i Taillard instance id
+    lb: int = 1           # -l bound: 0=lb1_d, 1=lb1, 2=lb2
+    ub: int = 1           # -u 1: seed incumbent with known optimum; 0: inf
+    m: int = 25           # -m min pool before offload -> min seed/worker
+    M: int = 50000        # -M max offload chunk -> pop-chunk ceiling
+    T: int = 5000         # -T CPU-thread chunk (no CPU co-processing tier)
+    D: int = 0            # -D devices (0 = all addressable)
+    C: int = 0            # -C multicore co-processing (N/A on TPU: the VPU
+                          #    lanes are the "extra cores"; accepted, ignored)
+    ws: int = 1           # -w intra-mesh balancing on/off
+    L: int = 1            # -L inter-node balancing on/off (same collective
+                          #    tier on TPU; ws==0 and L==0 disable balance)
+    perc: float = 0.5     # -p steal fraction (steal-half = 0.5)
+    # --- TPU engine knobs
+    chunk: int = 256          # parents popped per compiled step
+    capacity: int = 1 << 20   # per-device pool rows
+    balance_period: int = 4   # steps between collective balance rounds
+    csv: str | None = None    # append a reference-schema CSV row here
+
+    @property
+    def balancing_enabled(self) -> bool:
+        return bool(self.ws or self.L)
+
+
+@dataclasses.dataclass
+class NQueensConfig:
+    N: int = 14           # -N board size
+    g: int = 1            # -g safety-check repetitions (work scaling)
+    D: int = 0            # devices (0 = all)
+    chunk: int = 256
+    capacity: int = 1 << 20
+    balance_period: int = 4

@@ -1,0 +1,70 @@
+"""Descriptive statistics for experiment analysis.
+
+Mirrors the reference's sorted-vector statistics toolkit
+(reference: common/util.c:94-201): min/max/median, Tukey quartiles,
+arbitrary percentile, standard deviation, and the boxplot-stats bundle the
+`data/*.py` analysis scripts consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class BoxplotStats:
+    """Same fields as the reference's compute_boxplot_stats
+    (common/util.c:168-201)."""
+
+    minimum: float
+    q1: float
+    median: float
+    q3: float
+    maximum: float
+    mean: float
+    stddev: float
+    iqr: float
+    lower_fence: float
+    upper_fence: float
+
+
+def median_sorted(v: np.ndarray) -> float:
+    n = len(v)
+    mid = n // 2
+    return float(v[mid]) if n % 2 else float((v[mid - 1] + v[mid]) / 2.0)
+
+
+def quartiles_sorted(v: np.ndarray) -> tuple[float, float]:
+    """Tukey hinges: median of lower/upper half, halves excluding the
+    middle element for odd n (the reference's convention, util.c:128-145)."""
+    n = len(v)
+    half = n // 2
+    lower = v[:half]
+    upper = v[half + (n % 2):]
+    return median_sorted(lower), median_sorted(upper)
+
+
+def percentile_sorted(v: np.ndarray, p: float) -> float:
+    """Linear-interpolated percentile on a sorted vector (util.c:147-157)."""
+    n = len(v)
+    if n == 1:
+        return float(v[0])
+    rank = p * (n - 1)
+    lo = int(np.floor(rank))
+    frac = rank - lo
+    hi = min(lo + 1, n - 1)
+    return float(v[lo] + frac * (v[hi] - v[lo]))
+
+
+def compute_boxplot_stats(values) -> BoxplotStats:
+    v = np.sort(np.asarray(values, dtype=np.float64))
+    q1, q3 = quartiles_sorted(v)
+    iqr = q3 - q1
+    return BoxplotStats(
+        minimum=float(v[0]), q1=q1, median=median_sorted(v), q3=q3,
+        maximum=float(v[-1]), mean=float(v.mean()),
+        stddev=float(v.std(ddof=0)), iqr=iqr,
+        lower_fence=q1 - 1.5 * iqr, upper_fence=q3 + 1.5 * iqr,
+    )
