@@ -1,0 +1,47 @@
+"""Exact tree/sol/best parity with the reference engine on real instances.
+
+tests/golden/pfsp_lb2_ub1.jsonl holds (tree, sol, best) of the reference's
+sequential engine (driven through its own library: decompose + lb2_bound,
+PFSP_lib.c/c_bound_johnson.c) on Taillard instances with LB2 and ub=opt.
+With ub=opt the B&B tree is exploration-order independent, so the native
+C++ engine and the JAX device engine must reproduce the counts exactly —
+the strongest cross-implementation invariant the reference offers
+(SURVEY.md §4).
+"""
+
+import json
+import pathlib
+
+import pytest
+
+from tpu_tree_search import native
+from tpu_tree_search.problems import taillard
+
+GOLDEN = pathlib.Path(__file__).parent / "golden" / "pfsp_lb2_ub1.jsonl"
+CASES = [json.loads(l) for l in GOLDEN.read_text().splitlines()]
+
+# keep CI bounded: native handles everything below a million nodes quickly
+NATIVE_CASES = [c for c in CASES if c["tree"] <= 700_000]
+# the compiled engine on the CPU test backend is slower; smallest cases only
+DEVICE_CASES = [c for c in CASES if c["tree"] <= 150_000]
+
+
+@pytest.mark.parametrize("case", NATIVE_CASES,
+                         ids=lambda c: f"ta{c['inst']:03d}")
+def test_native_matches_reference(case):
+    p = taillard.processing_times(case["inst"])
+    ub = taillard.optimal_makespan(case["inst"])
+    tree, sol, best, _ = native.search(p, lb_kind=2, init_ub=ub)
+    assert (tree, sol, best) == (case["tree"], case["sol"], case["best"])
+
+
+@pytest.mark.parametrize("case", DEVICE_CASES,
+                         ids=lambda c: f"ta{c['inst']:03d}")
+def test_device_engine_matches_reference(case):
+    from tpu_tree_search.engine import device
+    p = taillard.processing_times(case["inst"])
+    ub = taillard.optimal_makespan(case["inst"])
+    out = device.search(p, lb_kind=2, init_ub=ub, chunk=64,
+                        capacity=1 << 16)
+    assert (out.explored_tree, out.explored_sol, out.best) == \
+           (case["tree"], case["sol"], case["best"])
