@@ -1,0 +1,75 @@
+"""Checkpoint/resume and segmented-driver tests."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import checkpoint, device, sequential as seq
+from tpu_tree_search.ops import batched
+from tpu_tree_search.problems.pfsp import PFSPInstance
+
+
+def _setup():
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=21)
+    opt = inst.brute_force_optimum()
+    tables = batched.make_tables(inst.p_times)
+    return inst, opt, tables
+
+
+def test_save_load_roundtrip(tmp_path):
+    inst, opt, tables = _setup()
+    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.run(tables, state, 1, 8, max_iters=4)
+    path = tmp_path / "ckpt.npz"
+    checkpoint.save(path, state, meta={"segment": 1})
+    restored, meta = checkpoint.load(path)
+    assert int(meta["segment"]) == 1
+    for a, b in zip(state, restored):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_resume_reaches_same_result(tmp_path):
+    """Interrupt mid-search, reload, finish: totals equal an uninterrupted
+    run (the capability the reference lacks, SURVEY.md §5)."""
+    inst, opt, tables = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+
+    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.run(tables, state, 1, 8, max_iters=3)
+    checkpoint.save(tmp_path / "c.npz", state)
+
+    restored, _ = checkpoint.load(tmp_path / "c.npz")
+    final = device.run(tables, restored, 1, 8)
+    assert (int(final.tree), int(final.sol), int(final.best)) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_segmented_driver(tmp_path):
+    inst, opt, tables = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    reports = []
+
+    def run_fn(state, target_iters):
+        return device.run(tables, state, 1, 2, max_iters=target_iters)
+
+    state = device.init_state(inst.jobs, 1 << 10, opt)
+    final = checkpoint.run_segmented(
+        run_fn, state, segment_iters=2,
+        checkpoint_path=str(tmp_path / "seg.npz"),
+        heartbeat=reports.append)
+    assert int(final.tree) == want.explored_tree
+    assert len(reports) >= 2
+    assert (tmp_path / "seg.npz").exists()
+    assert reports[-1].pool_size == 0
+
+
+def test_segmented_stall_detection():
+    class FrozenRunner:
+        def __call__(self, state, target):
+            return state  # never progresses
+
+    inst, opt, tables = _setup()
+    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.run(tables, state, 1, 8, max_iters=2)  # non-empty pool
+    with pytest.raises(RuntimeError, match="stalled"):
+        checkpoint.run_segmented(FrozenRunner(), state, segment_iters=4,
+                                 heartbeat=None, stall_limit=2)

@@ -1,0 +1,104 @@
+"""Checkpoint / resume for long searches.
+
+The reference has no checkpointing at all — a killed multi-day run loses
+everything (SURVEY.md §5: "Checkpoint/resume: none"). Because the TPU
+engine's entire search state is a handful of plain tensors (the pool
+arrays, cursors, incumbent, counters), snapshotting is trivial and cheap:
+one host fetch + one compressed npz per interval.
+
+`run_segmented` is the production driver: it runs the compiled loop in
+bounded segments (max_iters at a time), checkpointing, heartbeat-printing
+(the reference's 5000-iteration progress print, pfsp_gpu_cuda.c:324-330)
+and stall-detecting between segments — the failure-detection layer the
+reference also lacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from .device import SearchState
+
+
+def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
+    """Snapshot a search state (single-device or stacked distributed)."""
+    arrays = {f: np.asarray(x) for f, x in zip(SearchState._fields, state)}
+    if meta:
+        for k, v in meta.items():
+            arrays[f"meta_{k}"] = np.asarray(v)
+    path = pathlib.Path(path)
+    tmp = path.with_suffix(".tmp.npz")
+    np.savez_compressed(tmp, **arrays)
+    tmp.rename(path)
+
+
+def load(path: str | pathlib.Path) -> tuple[SearchState, dict]:
+    with np.load(pathlib.Path(path)) as z:
+        state = SearchState(*(jnp.asarray(z[f]) for f in SearchState._fields))
+        meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    return state, meta
+
+
+@dataclasses.dataclass
+class SegmentReport:
+    segment: int
+    iters: int
+    tree: int
+    sol: int
+    best: int
+    pool_size: int
+    elapsed: float
+
+
+def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
+                  checkpoint_path: str | None = None,
+                  checkpoint_every: int = 1,
+                  heartbeat=print, max_segments: int | None = None,
+                  stall_limit: int = 3):
+    """Drive `run_fn(state, extra_iters) -> state` to exhaustion in bounded
+    segments.
+
+    - checkpoints every `checkpoint_every` segments when a path is given;
+    - calls `heartbeat(SegmentReport)` after each segment;
+    - raises RuntimeError after `stall_limit` consecutive segments with no
+      progress (tree/sol/iters all unchanged) — a compiled-loop stall is a
+      bug, not a state, so fail loudly rather than spin (the reference's
+      equivalent symptom is its 10-second "Still Idle" print, dist:663-668).
+    """
+    t0 = time.perf_counter()
+    seg = 0
+    stalls = 0
+    last = (int(np.asarray(state.iters).max()), -1, -1)
+    while True:
+        target = (seg + 1) * segment_iters
+        state = run_fn(state, target)
+        seg += 1
+        iters = int(np.asarray(state.iters).max())
+        tree = int(np.asarray(state.tree).sum())
+        sol = int(np.asarray(state.sol).sum())
+        size = int(np.asarray(state.size).sum())
+        if heartbeat is not None:
+            heartbeat(SegmentReport(
+                segment=seg, iters=iters, tree=tree, sol=sol,
+                best=int(np.asarray(state.best).min()), pool_size=size,
+                elapsed=time.perf_counter() - t0))
+        if checkpoint_path and seg % checkpoint_every == 0:
+            save(checkpoint_path, state, meta={"segment": seg})
+        if size == 0 or bool(np.asarray(state.overflow).any()):
+            return state
+        if (iters, tree, sol) == last:
+            stalls += 1
+            if stalls >= stall_limit:
+                raise RuntimeError(
+                    f"search stalled: no progress across {stalls} segments "
+                    f"(iters={iters}, pool={size})")
+        else:
+            stalls = 0
+        last = (iters, tree, sol)
+        if max_segments is not None and seg >= max_segments:
+            return state

@@ -1,0 +1,57 @@
+"""Device introspection (the reference's gpu_info, common/gpu_util.cu:5-17,
+re-expressed for the JAX device model) plus profiler hooks.
+
+The reference instruments phases with omp_get_wtime() brackets and a
+manual FLOP model (SURVEY.md §5). Here the compiled loop is opaque to
+host timers, so the profiling story is `jax.profiler` traces (`trace`
+below — inspect with TensorBoard or xprof) plus the engine's device-side
+counters (tree/sol/evals/sent/recv/steals per worker).
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+def describe_devices() -> list[dict]:
+    """One record per addressable device (platform, kind, process, memory
+    stats when the backend exposes them)."""
+    out = []
+    for d in jax.devices():
+        rec = {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "?"),
+            "process": getattr(d, "process_index", 0),
+        }
+        try:
+            stats = d.memory_stats()
+            if stats:
+                rec["bytes_in_use"] = stats.get("bytes_in_use")
+                rec["bytes_limit"] = stats.get("bytes_limit")
+        except Exception:
+            pass
+        out.append(rec)
+    return out
+
+
+def print_device_info() -> None:
+    for rec in describe_devices():
+        line = (f"Device {rec['id']}: {rec['platform']} ({rec['kind']}) "
+                f"process {rec['process']}")
+        if rec.get("bytes_limit"):
+            line += (f", HBM {rec.get('bytes_in_use', 0) / 2**30:.2f}/"
+                     f"{rec['bytes_limit'] / 2**30:.2f} GiB")
+        print(line)
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """jax.profiler trace around a code block."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
