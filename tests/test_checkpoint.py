@@ -44,22 +44,71 @@ def test_resume_reaches_same_result(tmp_path):
 
 
 def test_segmented_driver(tmp_path):
+    # Discovery mode (UB=inf): the search must actually explore the tree,
+    # so it spans multiple segments at segment_iters=2.
     inst, opt, tables = _setup()
-    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    ub0 = 1 << 20
+    want = seq.pfsp_search(inst, lb=1, init_ub=ub0)
     reports = []
 
     def run_fn(state, target_iters):
         return device.run(tables, state, 1, 2, max_iters=target_iters)
 
-    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.init_state(inst.jobs, 1 << 10, ub0)
     final = checkpoint.run_segmented(
         run_fn, state, segment_iters=2,
         checkpoint_path=str(tmp_path / "seg.npz"),
         heartbeat=reports.append)
-    assert int(final.tree) == want.explored_tree
+    # Discovery-mode tree counts are traversal-order-dependent; the hard
+    # invariant is that the optimum is found and the tree was explored.
+    assert int(final.best) == want.best == opt
+    assert int(final.tree) > 0
     assert len(reports) >= 2
     assert (tmp_path / "seg.npz").exists()
     assert reports[-1].pool_size == 0
+
+
+def test_segmented_resume_offsets_targets(tmp_path):
+    """Resuming run_segmented from a checkpoint whose iters already exceed
+    segment_iters must keep making progress (targets offset by start iters),
+    not spin and raise a spurious stall."""
+    inst, opt, tables = _setup()
+    ub0 = 1 << 20
+
+    def run_fn(state, target_iters):
+        return device.run(tables, state, 1, 2, max_iters=target_iters)
+
+    state = device.init_state(inst.jobs, 1 << 10, ub0)
+    state = device.run(tables, state, 1, 2, max_iters=10)
+    assert int(state.size) > 0
+    checkpoint.save(tmp_path / "mid.npz", state)
+
+    restored, _ = checkpoint.load(tmp_path / "mid.npz")
+    final = checkpoint.run_segmented(run_fn, restored, segment_iters=2,
+                                     heartbeat=None)
+    assert int(final.size) == 0
+    assert int(final.best) == opt
+
+
+def test_overflow_state_is_recoverable(tmp_path):
+    """An overflow abort must not lose nodes: the overflowing step leaves
+    the state untouched (only the flag set), so grow + resume yields exactly
+    the unconstrained run's totals."""
+    inst, opt, tables = _setup()
+    ub0 = 1 << 20
+    want_state = device.init_state(inst.jobs, 1 << 12, ub0)
+    want = device.run(tables, want_state, 1, 8)
+    assert not bool(want.overflow)
+
+    small = device.init_state(inst.jobs, 48, ub0)
+    small = device.run(tables, small, 1, 8)
+    assert bool(small.overflow)
+
+    grown = checkpoint.grow(small, 1 << 12)
+    final = device.run(tables, grown, 1, 8)
+    assert not bool(final.overflow)
+    assert (int(final.tree), int(final.sol), int(final.best)) == \
+           (int(want.tree), int(want.sol), int(want.best))
 
 
 def test_segmented_stall_detection():
@@ -68,8 +117,9 @@ def test_segmented_stall_detection():
             return state  # never progresses
 
     inst, opt, tables = _setup()
-    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.init_state(inst.jobs, 1 << 10, 1 << 20)
     state = device.run(tables, state, 1, 8, max_iters=2)  # non-empty pool
+    assert int(state.size) > 0
     with pytest.raises(RuntimeError, match="stalled"):
         checkpoint.run_segmented(FrozenRunner(), state, segment_iters=4,
                                  heartbeat=None, stall_limit=2)

@@ -40,6 +40,15 @@ def _pfsp_parser(sub):
     p.add_argument("--csv", type=str, default=None)
     p.add_argument("--max-iters", type=int, default=None,
                    help="truncate the search (debugging)")
+    p.add_argument("--segment-iters", type=int, default=None,
+                   help="run in bounded segments with heartbeat reports "
+                        "(enables checkpointing; single-device only)")
+    p.add_argument("--checkpoint", type=str, default=None,
+                   help="checkpoint path; if the file exists the search "
+                        "resumes from it")
+    p.add_argument("--grow-capacity", type=int, default=None,
+                   help="re-home a resumed checkpoint into a larger pool "
+                        "(recovery after an overflow abort)")
 
 
 def _nq_parser(sub):
@@ -63,11 +72,12 @@ def _print_pfsp_settings(args, machines, jobs, n_dev):
     print("=" * 49)
 
 
-def _print_results(optimum, tree, sol, elapsed):
+def _print_results(optimum, tree, sol, elapsed, complete=True):
     print("=" * 49)
     print(f"Size of the explored tree: {tree}")
     print(f"Number of explored solutions: {sol}")
-    print(f"Optimal makespan: {optimum}")
+    label = "Optimal makespan" if complete else "Best makespan found (truncated run)"
+    print(f"{label}: {optimum}")
     print(f"Elapsed time: {elapsed:.4f} [s]")
     print("=" * 49)
 
@@ -86,7 +96,18 @@ def run_pfsp(args) -> int:
     _print_pfsp_settings(args, machines, jobs, n_dev)
 
     t0 = time.perf_counter()
-    if n_dev == 1:
+    if args.segment_iters is not None or args.checkpoint is not None:
+        if n_dev != 1:
+            print("error: --segment-iters/--checkpoint require -D 1",
+                  file=sys.stderr)
+            return 2
+        try:
+            out = _run_pfsp_segmented(args, p, init_ub)
+        except (RuntimeError, ValueError, OSError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        tree, sol, best = int(out.tree), int(out.sol), int(out.best)
+    elif n_dev == 1:
         out = device.search(p, lb_kind=args.lb, init_ub=init_ub,
                             chunk=args.chunk, capacity=args.capacity,
                             max_iters=args.max_iters)
@@ -105,7 +126,8 @@ def run_pfsp(args) -> int:
         per_device = {k: list(v) for k, v in res.per_device.items()}
     elapsed = time.perf_counter() - t0
 
-    _print_results(best, tree, sol, elapsed)
+    _print_results(best, tree, sol, elapsed,
+                   complete=args.max_iters is None)
     if args.csv:
         if n_dev == 1:
             csv_stats.write_single(args.csv, args.inst, args.lb, best, args.m,
@@ -115,6 +137,44 @@ def run_pfsp(args) -> int:
                                  args.L, 1, best, args.m, args.M, args.T,
                                  elapsed, tree, sol, per_device)
     return 0
+
+
+def _run_pfsp_segmented(args, p, init_ub):
+    """Segmented single-device search with heartbeat + checkpoint/resume
+    (the durability layer the reference lacks, SURVEY.md §5)."""
+    import os
+
+    from .engine import checkpoint, device
+    from .ops import batched
+
+    jobs = p.shape[1]
+    tables = batched.make_tables(p)
+    if args.checkpoint and os.path.exists(args.checkpoint):
+        state, meta = checkpoint.load(args.checkpoint)
+        if args.grow_capacity:
+            state = checkpoint.grow(state, args.grow_capacity)
+        print(f"Resumed from {args.checkpoint} "
+              f"(segment {int(meta.get('segment', 0))}, "
+              f"iters {int(np.asarray(state.iters).max())}, "
+              f"pool {int(np.asarray(state.size).sum())})")
+    else:
+        state = device.init_state(jobs, args.grow_capacity or args.capacity,
+                                  init_ub)
+
+    seg_iters = args.segment_iters or 2048
+
+    def run_fn(s, target):
+        return device.run(tables, s, args.lb, args.chunk, max_iters=target)
+
+    def heartbeat(r):
+        print(f"[segment {r.segment}] iters={r.iters} tree={r.tree} "
+              f"sol={r.sol} best={r.best} pool={r.pool_size} "
+              f"t={r.elapsed:.2f}s")
+
+    return checkpoint.run_segmented(
+        run_fn, state, segment_iters=seg_iters,
+        checkpoint_path=args.checkpoint, heartbeat=heartbeat,
+        max_total_iters=args.max_iters)
 
 
 def run_nqueens(args) -> int:
@@ -147,12 +207,30 @@ def run_nqueens(args) -> int:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="tpu_tree_search")
+    ap.add_argument("--platform", type=str, default=None,
+                    help="override the JAX platform (e.g. cpu for "
+                         "debugging); must precede the subcommand")
     sub = ap.add_subparsers(dest="cmd", required=True)
     _pfsp_parser(sub)
     _nq_parser(sub)
+    sub.add_parser("devices",
+                   help="describe attached devices (the reference's "
+                        "gpu_info, common/gpu_util.cu:5-17)")
     args = ap.parse_args(argv)
+    if args.platform:
+        # Env vars alone are read too early (the environment preloads jax
+        # via sitecustomize); flip the platform through jax.config.
+        import os
+
+        import jax
+        os.environ["JAX_PLATFORMS"] = args.platform
+        jax.config.update("jax_platforms", args.platform)
     if args.cmd == "pfsp":
         return run_pfsp(args)
+    if args.cmd == "devices":
+        from .utils.device_info import print_device_info
+        print_device_info()
+        return 0
     return run_nqueens(args)
 
 

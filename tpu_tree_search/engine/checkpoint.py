@@ -44,6 +44,25 @@ def load(path: str | pathlib.Path) -> tuple[SearchState, dict]:
     return state, meta
 
 
+def grow(state: SearchState, new_capacity: int) -> SearchState:
+    """Re-home a (single-device) search state into a larger pool — the
+    recovery path after an overflow abort: load the checkpoint, grow, rerun.
+    """
+    prmu = np.asarray(state.prmu)
+    if prmu.ndim != 2:
+        raise ValueError("grow() supports single-device states only")
+    capacity, jobs = prmu.shape
+    if new_capacity < capacity:
+        raise ValueError(f"new_capacity {new_capacity} < current {capacity}")
+    new_prmu = np.zeros((new_capacity, jobs), dtype=prmu.dtype)
+    new_depth = np.zeros(new_capacity, dtype=np.asarray(state.depth).dtype)
+    new_prmu[:capacity] = prmu
+    new_depth[:capacity] = np.asarray(state.depth)
+    return state._replace(prmu=jnp.asarray(new_prmu),
+                          depth=jnp.asarray(new_depth),
+                          overflow=jnp.asarray(False))
+
+
 @dataclasses.dataclass
 class SegmentReport:
     segment: int
@@ -59,23 +78,38 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   checkpoint_path: str | None = None,
                   checkpoint_every: int = 1,
                   heartbeat=print, max_segments: int | None = None,
-                  stall_limit: int = 3):
-    """Drive `run_fn(state, extra_iters) -> state` to exhaustion in bounded
-    segments.
+                  max_total_iters: int | None = None,
+                  stall_limit: int = 3,
+                  raise_on_overflow: bool = True):
+    """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
+    bounded segments.
+
+    `run_fn` receives a CUMULATIVE iteration ceiling (matching
+    `device.run(..., max_iters=...)`'s semantics: the loop condition is
+    `state.iters < max_iters`), not an increment. Targets are offset by the
+    incoming state's iteration count, so resuming from a loaded checkpoint
+    works.
 
     - checkpoints every `checkpoint_every` segments when a path is given;
     - calls `heartbeat(SegmentReport)` after each segment;
     - raises RuntimeError after `stall_limit` consecutive segments with no
       progress (tree/sol/iters all unchanged) — a compiled-loop stall is a
       bug, not a state, so fail loudly rather than spin (the reference's
-      equivalent symptom is its 10-second "Still Idle" print, dist:663-668).
+      equivalent symptom is its 10-second "Still Idle" print, dist:663-668);
+    - on pool overflow the search state is incomplete: raises RuntimeError
+      (after checkpointing, so the state is recoverable) unless
+      `raise_on_overflow=False`, in which case the caller must check
+      `state.overflow` before trusting the counters.
     """
     t0 = time.perf_counter()
     seg = 0
     stalls = 0
-    last = (int(np.asarray(state.iters).max()), -1, -1)
+    start_iters = int(np.asarray(state.iters).max())
+    last = (start_iters, -1, -1)
     while True:
-        target = (seg + 1) * segment_iters
+        target = start_iters + (seg + 1) * segment_iters
+        if max_total_iters is not None:
+            target = min(target, start_iters + max_total_iters)
         state = run_fn(state, target)
         seg += 1
         iters = int(np.asarray(state.iters).max())
@@ -89,7 +123,16 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                 elapsed=time.perf_counter() - t0))
         if checkpoint_path and seg % checkpoint_every == 0:
             save(checkpoint_path, state, meta={"segment": seg})
-        if size == 0 or bool(np.asarray(state.overflow).any()):
+        if bool(np.asarray(state.overflow).any()):
+            if checkpoint_path and seg % checkpoint_every != 0:
+                save(checkpoint_path, state, meta={"segment": seg})
+            if raise_on_overflow:
+                raise RuntimeError(
+                    f"pool overflow at segment {seg} (pool={size}): search "
+                    "incomplete; resume from the checkpoint with a larger "
+                    "capacity")
+            return state
+        if size == 0:
             return state
         if (iters, tree, sol) == last:
             stalls += 1
@@ -101,4 +144,7 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
             stalls = 0
         last = (iters, tree, sol)
         if max_segments is not None and seg >= max_segments:
+            return state
+        if (max_total_iters is not None
+                and iters >= start_iters + max_total_iters):
             return state

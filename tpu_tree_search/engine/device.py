@@ -156,30 +156,49 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                      start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
                      capacity)                       # capacity => dropped
     new_size = start + n_push
-    overflow = state.overflow | (new_size > capacity)
+
+    # An overflowing step must NOT commit: children past capacity are
+    # dropped by the scatter, so advancing the cursor would silently lose
+    # subtrees (and make the overflow checkpoint unrecoverable). Instead
+    # the state is left exactly as before the step with only the flag
+    # set, so grow-capacity + resume continues the search losslessly.
+    overflow = new_size > capacity
     prmu = state.prmu.at[dest].set(children, mode="drop")
     depth = state.depth.at[dest].set(child_depth, mode="drop")
+    keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
+    return state._replace(
+        prmu=keep(prmu, state.prmu),
+        depth=keep(depth, state.depth),
+        size=keep(new_size, state.size),
+        best=keep(best, state.best),
+        tree=keep(tree, state.tree),
+        sol=keep(sol, state.sol),
+        iters=state.iters + 1,
+        evals=keep(state.evals + mask.sum(dtype=jnp.int64), state.evals),
+        overflow=state.overflow | overflow)
 
-    return state._replace(prmu=prmu, depth=depth, size=new_size, best=best,
-                          tree=tree, sol=sol, iters=state.iters + 1,
-                          evals=state.evals + mask.sum(dtype=jnp.int64),
-                          overflow=overflow)
 
-
-@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "max_iters"))
-def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
-        max_iters: int | None = None) -> SearchState:
-    """Run the search to exhaustion (or `max_iters`) in one compiled loop
-    (the analogue of pfsp_c.c:55-63's while(1) pop+decompose)."""
-
+@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk"))
+def _run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
+         max_iters: jax.Array) -> SearchState:
     def cond(s: SearchState):
-        go = (s.size > 0) & ~s.overflow
-        if max_iters is not None:
-            go = go & (s.iters < max_iters)
-        return go
+        return (s.size > 0) & ~s.overflow & (s.iters < max_iters)
 
     return jax.lax.while_loop(cond, functools.partial(step, tables, lb_kind, chunk),
                               state)
+
+
+def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
+        max_iters: int | None = None) -> SearchState:
+    """Run the search to exhaustion (or up to a cumulative `max_iters`) in
+    one compiled loop (the analogue of pfsp_c.c:55-63's while(1)
+    pop+decompose). `max_iters` is a traced scalar, NOT a static argument:
+    segmented drivers pass a new ceiling every segment and must hit the
+    compile cache."""
+    limit = (jnp.iinfo(state.iters.dtype).max if max_iters is None
+             else max_iters)
+    return _run(tables, state, lb_kind, chunk,
+                jnp.asarray(limit, dtype=state.iters.dtype))
 
 
 class SearchResult(NamedTuple):

@@ -59,28 +59,43 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
                      start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
                      capacity)
     new_size = start + n_push
+    # As in device.step: an overflowing step must not commit (the scatter
+    # drops out-of-capacity children), so the state stays resumable.
+    overflow = new_size > capacity
+    keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
+    evals = state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
+                           & valid[:, None]).sum(dtype=jnp.int64)
     return state._replace(
-        prmu=state.prmu.at[dest].set(children, mode="drop"),
-        depth=state.depth.at[dest].set(child_depth, mode="drop"),
-        size=new_size, tree=tree, sol=sol,
+        prmu=keep(state.prmu.at[dest].set(children, mode="drop"), state.prmu),
+        depth=keep(state.depth.at[dest].set(child_depth, mode="drop"),
+                   state.depth),
+        size=keep(new_size, state.size),
+        tree=keep(tree, state.tree),
+        sol=keep(sol, state.sol),
         iters=state.iters + 1,
-        evals=state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
-                             & valid[:, None]).sum(dtype=jnp.int64),
-        overflow=state.overflow | (new_size > capacity),
+        evals=keep(evals, state.evals),
+        overflow=state.overflow | overflow,
     )
 
 
-@functools.partial(jax.jit, static_argnames=("n", "g", "chunk", "max_iters"))
-def run(state: SearchState, n: int, g: int, chunk: int,
-        max_iters: int | None = None) -> SearchState:
+@functools.partial(jax.jit, static_argnames=("n", "g", "chunk"))
+def _run(state: SearchState, n: int, g: int, chunk: int,
+         max_iters: jax.Array) -> SearchState:
     def cond(s):
-        go = (s.size > 0) & ~s.overflow
-        if max_iters is not None:
-            go = go & (s.iters < max_iters)
-        return go
+        return (s.size > 0) & ~s.overflow & (s.iters < max_iters)
 
     return jax.lax.while_loop(cond, functools.partial(nq_step, n, g, chunk),
                               state)
+
+
+def run(state: SearchState, n: int, g: int, chunk: int,
+        max_iters: int | None = None) -> SearchState:
+    """`max_iters` is a traced scalar (see device.run): segmented callers
+    pass a new ceiling per segment without recompiling."""
+    limit = (jnp.iinfo(state.iters.dtype).max if max_iters is None
+             else max_iters)
+    return _run(state, n, g, chunk,
+                jnp.asarray(limit, dtype=state.iters.dtype))
 
 
 class NQResult(NamedTuple):

@@ -42,7 +42,7 @@ def print_device_info() -> None:
         line = (f"Device {rec['id']}: {rec['platform']} ({rec['kind']}) "
                 f"process {rec['process']}")
         if rec.get("bytes_limit"):
-            line += (f", HBM {rec.get('bytes_in_use', 0) / 2**30:.2f}/"
+            line += (f", HBM {(rec.get('bytes_in_use') or 0) / 2**30:.2f}/"
                      f"{rec['bytes_limit'] / 2**30:.2f} GiB")
         print(line)
 
