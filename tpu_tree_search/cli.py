@@ -107,11 +107,13 @@ def run_pfsp(args) -> int:
             print(f"error: {e}", file=sys.stderr)
             return 1
         tree, sol, best = int(out.tree), int(out.sol), int(out.best)
+        complete = int(np.asarray(out.size).sum()) == 0
     elif n_dev == 1:
         out = device.search(p, lb_kind=args.lb, init_ub=init_ub,
                             chunk=args.chunk, capacity=args.capacity,
                             max_iters=args.max_iters)
         tree, sol, best = out.explored_tree, out.explored_sol, out.best
+        complete = out.complete
         per_device = {"tree": [tree], "sol": [sol], "evals": [out.evals],
                       "steals": [0], "recv": [0]}
     else:
@@ -123,11 +125,11 @@ def run_pfsp(args) -> int:
             min_seed=args.m,
             max_rounds=args.max_iters)
         tree, sol, best = res.explored_tree, res.explored_sol, res.best
+        complete = res.complete
         per_device = {k: list(v) for k, v in res.per_device.items()}
     elapsed = time.perf_counter() - t0
 
-    _print_results(best, tree, sol, elapsed,
-                   complete=args.max_iters is None)
+    _print_results(best, tree, sol, elapsed, complete=complete)
     if args.csv:
         if n_dev == 1:
             csv_stats.write_single(args.csv, args.inst, args.lb, best, args.m,

@@ -44,6 +44,14 @@ def load(path: str | pathlib.Path) -> tuple[SearchState, dict]:
     return state, meta
 
 
+class PoolOverflow(RuntimeError):
+    """Pool capacity exceeded; `.state` is the (resumable) search state."""
+
+    def __init__(self, message: str, state: SearchState):
+        super().__init__(message)
+        self.state = state
+
+
 def grow(state: SearchState, new_capacity: int) -> SearchState:
     """Re-home a (single-device) search state into a larger pool — the
     recovery path after an overflow abort: load the checkpoint, grow, rerun.
@@ -127,10 +135,13 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
             if checkpoint_path and seg % checkpoint_every != 0:
                 save(checkpoint_path, state, meta={"segment": seg})
             if raise_on_overflow:
-                raise RuntimeError(
+                hint = (f"resume from {checkpoint_path} with a larger "
+                        "capacity" if checkpoint_path else
+                        "rerun with a larger capacity, or catch "
+                        "PoolOverflow and grow() its .state")
+                raise PoolOverflow(
                     f"pool overflow at segment {seg} (pool={size}): search "
-                    "incomplete; resume from the checkpoint with a larger "
-                    "capacity")
+                    f"incomplete; {hint}", state)
             return state
         if size == 0:
             return state

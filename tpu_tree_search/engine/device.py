@@ -162,13 +162,17 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     # subtrees (and make the overflow checkpoint unrecoverable). Instead
     # the state is left exactly as before the step with only the flag
     # set, so grow-capacity + resume continues the search losslessly.
+    # Pool arrays stay untouched by routing the whole scatter to the
+    # drop row (O(chunk), no capacity-sized select on the hot loop);
+    # the remaining guards are scalar selects.
     overflow = new_size > capacity
+    dest = jnp.where(overflow, capacity, dest)
     prmu = state.prmu.at[dest].set(children, mode="drop")
     depth = state.depth.at[dest].set(child_depth, mode="drop")
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     return state._replace(
-        prmu=keep(prmu, state.prmu),
-        depth=keep(depth, state.depth),
+        prmu=prmu,
+        depth=depth,
         size=keep(new_size, state.size),
         best=keep(best, state.best),
         tree=keep(tree, state.tree),
@@ -208,6 +212,7 @@ class SearchResult(NamedTuple):
     iters: int
     evals: int
     overflow: bool
+    complete: bool = True  # pool drained (False: max_iters truncation)
 
 
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
@@ -230,5 +235,6 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 explored_tree=int(out.tree), explored_sol=int(out.sol),
                 best=int(out.best), iters=int(out.iters),
                 evals=int(out.evals), overflow=False,
+                complete=int(out.size) == 0,
             )
         capacity *= 2

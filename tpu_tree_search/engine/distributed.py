@@ -216,13 +216,14 @@ def build_dist_loop(mesh, tables, make_local_step,
 
 class DistResult:
     def __init__(self, explored_tree, explored_sol, best, per_device,
-                 warmup_tree, warmup_sol):
+                 warmup_tree, warmup_sol, complete=True):
         self.explored_tree = explored_tree
         self.explored_sol = explored_sol
         self.best = best
         self.per_device = per_device        # dict of (D,) arrays for stats
         self.warmup_tree = warmup_tree
         self.warmup_sol = warmup_sol
+        self.complete = complete            # all pools drained
 
 
 def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
@@ -299,4 +300,5 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             "final_size": np.asarray(out.size),
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,
+        complete=int(np.asarray(out.size).sum()) == 0,
     )

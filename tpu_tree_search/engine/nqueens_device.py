@@ -59,16 +59,17 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
                      start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
                      capacity)
     new_size = start + n_push
-    # As in device.step: an overflowing step must not commit (the scatter
-    # drops out-of-capacity children), so the state stays resumable.
+    # As in device.step: an overflowing step must not commit, so the state
+    # stays resumable. The scatter is routed to the drop row (O(chunk));
+    # scalars are guarded with selects.
     overflow = new_size > capacity
+    dest = jnp.where(overflow, capacity, dest)
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     evals = state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
                            & valid[:, None]).sum(dtype=jnp.int64)
     return state._replace(
-        prmu=keep(state.prmu.at[dest].set(children, mode="drop"), state.prmu),
-        depth=keep(state.depth.at[dest].set(child_depth, mode="drop"),
-                   state.depth),
+        prmu=state.prmu.at[dest].set(children, mode="drop"),
+        depth=state.depth.at[dest].set(child_depth, mode="drop"),
         size=keep(new_size, state.size),
         tree=keep(tree, state.tree),
         sol=keep(sol, state.sol),
