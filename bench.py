@@ -35,9 +35,9 @@ from tpu_tree_search.problems import taillard  # noqa: E402
 def main():
     inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
     lb_kind = int(os.environ.get("TTS_BENCH_LB", "1"))
-    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "512"))
-    iters = int(os.environ.get("TTS_BENCH_ITERS", "600"))
-    capacity = 1 << 20
+    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "8192"))
+    iters = int(os.environ.get("TTS_BENCH_ITERS", "300"))
+    capacity = 1 << 22
 
     p = taillard.processing_times(inst)
     ub = taillard.optimal_makespan(inst)
@@ -45,7 +45,7 @@ def main():
     jobs = p.shape[1]
 
     # compile + warm the pool (also past the shallow, underfilled iterations)
-    state = device.init_state(jobs, capacity, ub)
+    state = device.init_state(jobs, capacity, ub, p_times=p)
     state = device.run(tables, state, lb_kind, chunk, max_iters=50)
     state.size.block_until_ready()
     evals0 = int(state.evals)

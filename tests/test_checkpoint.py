@@ -17,7 +17,7 @@ def _setup():
 
 def test_save_load_roundtrip(tmp_path):
     inst, opt, tables = _setup()
-    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.init_state(inst.jobs, 1 << 10, opt, p_times=inst.p_times)
     state = device.run(tables, state, 1, 8, max_iters=4)
     path = tmp_path / "ckpt.npz"
     checkpoint.save(path, state, meta={"segment": 1})
@@ -33,7 +33,7 @@ def test_resume_reaches_same_result(tmp_path):
     inst, opt, tables = _setup()
     want = seq.pfsp_search(inst, lb=1, init_ub=opt)
 
-    state = device.init_state(inst.jobs, 1 << 10, opt)
+    state = device.init_state(inst.jobs, 1 << 10, opt, p_times=inst.p_times)
     state = device.run(tables, state, 1, 8, max_iters=3)
     checkpoint.save(tmp_path / "c.npz", state)
 
@@ -54,7 +54,7 @@ def test_segmented_driver(tmp_path):
     def run_fn(state, target_iters):
         return device.run(tables, state, 1, 2, max_iters=target_iters)
 
-    state = device.init_state(inst.jobs, 1 << 10, ub0)
+    state = device.init_state(inst.jobs, 1 << 10, ub0, p_times=inst.p_times)
     final = checkpoint.run_segmented(
         run_fn, state, segment_iters=2,
         checkpoint_path=str(tmp_path / "seg.npz"),
@@ -78,7 +78,7 @@ def test_segmented_resume_offsets_targets(tmp_path):
     def run_fn(state, target_iters):
         return device.run(tables, state, 1, 2, max_iters=target_iters)
 
-    state = device.init_state(inst.jobs, 1 << 10, ub0)
+    state = device.init_state(inst.jobs, 1 << 10, ub0, p_times=inst.p_times)
     state = device.run(tables, state, 1, 2, max_iters=10)
     assert int(state.size) > 0
     checkpoint.save(tmp_path / "mid.npz", state)
@@ -96,11 +96,11 @@ def test_overflow_state_is_recoverable(tmp_path):
     the unconstrained run's totals."""
     inst, opt, tables = _setup()
     ub0 = 1 << 20
-    want_state = device.init_state(inst.jobs, 1 << 12, ub0)
+    want_state = device.init_state(inst.jobs, 1 << 12, ub0, p_times=inst.p_times)
     want = device.run(tables, want_state, 1, 8)
     assert not bool(want.overflow)
 
-    small = device.init_state(inst.jobs, 48, ub0)
+    small = device.init_state(inst.jobs, 48, ub0, p_times=inst.p_times)
     small = device.run(tables, small, 1, 8)
     assert bool(small.overflow)
 
@@ -111,13 +111,62 @@ def test_overflow_state_is_recoverable(tmp_path):
            (int(want.tree), int(want.sol), int(want.best))
 
 
+def test_midloop_overflow_is_recoverable():
+    """Overflow hit *inside* the compiled loop (capacity above the scratch
+    margin, so steps actually run): the overflowing step must route its
+    block write to the scratch margin, leave the live region intact, and
+    grow + resume must match the unconstrained run exactly."""
+    inst, opt, tables = _setup()
+    ub0 = 1 << 20
+    want_state = device.init_state(inst.jobs, 1 << 12, ub0,
+                                   p_times=inst.p_times)
+    want = device.run(tables, want_state, 1, 8)
+    assert not bool(want.overflow)
+
+    # chunk*jobs = 64; capacity 96 leaves a usable limit of 32 rows
+    small = device.init_state(inst.jobs, 96, ub0, p_times=inst.p_times)
+    small = device.run(tables, small, 1, 8)
+    assert bool(small.overflow)
+    assert int(small.iters) > 0          # the loop really ran
+
+    grown = checkpoint.grow(small, 1 << 12)
+    final = device.run(tables, grown, 1, 8)
+    assert not bool(final.overflow)
+    assert (int(final.tree), int(final.sol), int(final.best)) == \
+           (int(want.tree), int(want.sol), int(want.best))
+
+
+def test_load_pre_aux_checkpoint(tmp_path):
+    """Checkpoints written before the pool carried [front | remain] aux
+    tables load via reconstruction from p_times."""
+    inst, opt, tables = _setup()
+    state = device.init_state(inst.jobs, 1 << 10, opt, p_times=inst.p_times)
+    state = device.run(tables, state, 1, 8, max_iters=4)
+    arrays = {f: np.asarray(x) for f, x in zip(state._fields, state)
+              if f != "aux"}
+    np.savez_compressed(tmp_path / "old.npz", **arrays)
+
+    with pytest.raises(ValueError, match="pre-aux"):
+        checkpoint.load(tmp_path / "old.npz")
+
+    restored, _ = checkpoint.load(tmp_path / "old.npz",
+                                  p_times=inst.p_times)
+    n = int(state.size)   # rows above the cursor are garbage, not compared
+    np.testing.assert_array_equal(np.asarray(restored.aux)[:n],
+                                  np.asarray(state.aux)[:n])
+    final = device.run(tables, restored, 1, 8)
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    assert (int(final.tree), int(final.sol), int(final.best)) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
 def test_segmented_stall_detection():
     class FrozenRunner:
         def __call__(self, state, target):
             return state  # never progresses
 
     inst, opt, tables = _setup()
-    state = device.init_state(inst.jobs, 1 << 10, 1 << 20)
+    state = device.init_state(inst.jobs, 1 << 10, 1 << 20, p_times=inst.p_times)
     state = device.run(tables, state, 1, 8, max_iters=2)  # non-empty pool
     assert int(state.size) > 0
     with pytest.raises(RuntimeError, match="stalled"):

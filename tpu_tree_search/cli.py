@@ -152,7 +152,7 @@ def _run_pfsp_segmented(args, p, init_ub):
     jobs = p.shape[1]
     tables = batched.make_tables(p)
     if args.checkpoint and os.path.exists(args.checkpoint):
-        state, meta = checkpoint.load(args.checkpoint)
+        state, meta = checkpoint.load(args.checkpoint, p_times=p)
         if args.grow_capacity:
             state = checkpoint.grow(state, args.grow_capacity)
         print(f"Resumed from {args.checkpoint} "
@@ -161,7 +161,7 @@ def _run_pfsp_segmented(args, p, init_ub):
               f"pool {int(np.asarray(state.size).sum())})")
     else:
         state = device.init_state(jobs, args.grow_capacity or args.capacity,
-                                  init_ub)
+                                  init_ub, p_times=p)
 
     seg_iters = args.segment_iters or 2048
 

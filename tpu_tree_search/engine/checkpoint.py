@@ -37,10 +37,33 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     tmp.rename(path)
 
 
-def load(path: str | pathlib.Path) -> tuple[SearchState, dict]:
+def load(path: str | pathlib.Path,
+         p_times: np.ndarray | None = None) -> tuple[SearchState, dict]:
+    """Load a snapshot. Pre-aux checkpoints (before the pool carried
+    per-node [front | remain] tables) are upgraded on load by
+    reconstructing aux from the live rows — pass the instance's
+    `p_times` for that; without it such files raise a clear error."""
     with np.load(pathlib.Path(path)) as z:
-        state = SearchState(*(jnp.asarray(z[f]) for f in SearchState._fields))
+        arrays = {f: z[f] for f in SearchState._fields if f in z.files}
         meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    if "aux" not in arrays:
+        if p_times is None:
+            raise ValueError(
+                f"{path} is a pre-aux checkpoint; pass p_times to load() "
+                "so the per-node pool tables can be reconstructed")
+        from ..ops import reference as ref
+        prmu = arrays["prmu"]
+        depth = arrays["depth"]
+        size = np.atleast_1d(arrays["size"])
+        stacked = prmu.ndim == 3
+        aux = np.zeros(prmu.shape[:-1] + (2 * p_times.shape[0],), np.int32)
+        for d in range(prmu.shape[0] if stacked else 1):
+            n = int(size[d if stacked else 0])
+            sl = (d, slice(0, n)) if stacked else slice(0, n)
+            aux[sl] = ref.prefix_front_remain(p_times, prmu[sl], depth[sl])
+        arrays["aux"] = aux
+    state = SearchState(*(jnp.asarray(arrays[f])
+                          for f in SearchState._fields))
     return state, meta
 
 
@@ -64,10 +87,14 @@ def grow(state: SearchState, new_capacity: int) -> SearchState:
         raise ValueError(f"new_capacity {new_capacity} < current {capacity}")
     new_prmu = np.zeros((new_capacity, jobs), dtype=prmu.dtype)
     new_depth = np.zeros(new_capacity, dtype=np.asarray(state.depth).dtype)
+    aux = np.asarray(state.aux)
+    new_aux = np.zeros((new_capacity, aux.shape[1]), dtype=aux.dtype)
     new_prmu[:capacity] = prmu
     new_depth[:capacity] = np.asarray(state.depth)
+    new_aux[:capacity] = aux
     return state._replace(prmu=jnp.asarray(new_prmu),
                           depth=jnp.asarray(new_depth),
+                          aux=jnp.asarray(new_aux),
                           overflow=jnp.asarray(False))
 
 

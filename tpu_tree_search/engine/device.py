@@ -37,10 +37,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import batched
+from ..ops import batched, reference as ref
 from ..ops.batched import BoundTables
 
 I32_MAX = jnp.int32(2**31 - 1)
+
+
+def row_limit(capacity: int, chunk: int, jobs: int) -> int:
+    """Usable pool rows. The top `chunk*jobs` rows are a scratch margin:
+    the push block-write always writes a full chunk*jobs block, and an
+    overflowing step routes it there so the live region stays untouched.
+    Every commit point (step, balance, seeding) must keep
+    `size <= row_limit` — that invariant is what keeps the block write in
+    bounds and overflow recovery lossless."""
+    return max(capacity - chunk * jobs, 0)
 
 
 class SearchState(NamedTuple):
@@ -48,6 +58,10 @@ class SearchState(NamedTuple):
 
     prmu: jax.Array      # (capacity, jobs) int16
     depth: jax.Array     # (capacity,) int16
+    aux: jax.Array       # (capacity, A) int32 per-node tables; PFSP stores
+                         # [front | remain] (A = 2*machines) so bounds never
+                         # rescan the prefix; problems without per-node
+                         # tables (N-Queens) use A = 0
     size: jax.Array      # int32 live-row cursor
     best: jax.Array      # int32 incumbent makespan
     tree: jax.Array      # int64 explored (= pushed) internal nodes
@@ -62,8 +76,13 @@ class SearchState(NamedTuple):
 
 def init_state(jobs: int, capacity: int, init_ub: int | None,
                prmu0: np.ndarray | None = None,
-               depth0: np.ndarray | None = None) -> SearchState:
-    """Pool with the given seed nodes (default: the root at depth 0)."""
+               depth0: np.ndarray | None = None,
+               p_times: np.ndarray | None = None) -> SearchState:
+    """Pool with the given seed nodes (default: the root at depth 0).
+
+    `p_times` (PFSP) sizes and fills the per-node aux tables; without it the
+    aux width is 0 (problems like N-Queens that carry no per-node tables).
+    """
     if prmu0 is None:
         prmu0 = np.arange(jobs, dtype=np.int16)[None, :]
         depth0 = np.zeros(1, dtype=np.int16)
@@ -76,10 +95,16 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     depth = np.zeros(capacity, dtype=np.int16)
     prmu[:n] = prmu0
     depth[:n] = depth0
+    if p_times is not None:
+        aux = np.zeros((capacity, 2 * p_times.shape[0]), dtype=np.int32)
+        aux[:n] = ref.prefix_front_remain(p_times, prmu0, depth0)
+    else:
+        aux = np.zeros((capacity, 0), dtype=np.int32)
     best = 2**31 - 1 if init_ub is None else int(init_ub)
     return SearchState(
         prmu=jnp.asarray(prmu),
         depth=jnp.asarray(depth),
+        aux=jnp.asarray(aux),
         size=jnp.int32(n),
         best=jnp.int32(best),
         tree=jnp.int64(0),
@@ -95,20 +120,24 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
 
 def make_children(prmu: jax.Array, depth: jax.Array) -> jax.Array:
     """Dense (B, J, J) child permutations: slot i swaps positions depth<->i
-    (the prefix-swap branching of decompose, reference: PFSP_lib.c:13-16)."""
+    (the prefix-swap branching of decompose, reference: PFSP_lib.c:13-16).
+
+    Gather-free: the value swapped into position `depth` is just `prmu[b, i]`
+    (= `prmu` itself along the slot axis), and the job swapped out to
+    position i is extracted with a masked sum — per-element dynamic
+    gathers cost ~ms at this batch size on TPU, pure vector ops don't."""
     B, J = prmu.shape
     pos = jnp.arange(J, dtype=jnp.int32)[None, None, :]     # permutation index
     slot = jnp.arange(J, dtype=jnp.int32)[None, :, None]    # which child
     d = depth[:, None, None].astype(jnp.int32)
-    at_depth = jnp.take_along_axis(
-        prmu, depth[:, None].astype(jnp.int32), axis=1
-    )                                                        # (B, 1) job at prmu[depth]
+    at_depth = jnp.sum(
+        jnp.where(jnp.arange(J)[None, :] == depth[:, None].astype(jnp.int32),
+                  prmu.astype(jnp.int32), 0),
+        axis=1)                                              # (B,) prmu[b, depth]
     base = prmu[:, None, :]                                  # (B, 1, J)
-    swapped_in = jnp.take_along_axis(
-        prmu, jnp.broadcast_to(slot[..., 0], (B, J)).astype(jnp.int32), axis=1
-    )[:, :, None]                                            # (B, J, 1) prmu[i]
+    swapped_in = prmu[:, :, None]                            # (B, J, 1) prmu[b, i]
     child = jnp.where(pos == d, swapped_in,
-                      jnp.where(pos == slot, at_depth[:, :, None], base))
+                      jnp.where(pos == slot, at_depth[:, None, None], base))
     return child.astype(jnp.int16)
 
 
@@ -118,20 +147,33 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320)."""
     capacity, J = state.prmu.shape
     B = chunk
+    assert capacity >= B, f"pool capacity {capacity} < chunk {B}"
+    M = tables.p.shape[0]
+    assert state.aux.shape[1] == 2 * M, (
+        f"pool aux width {state.aux.shape[1]} != 2*machines {2 * M}: "
+        "seed the state with init_state(..., p_times=...) so it carries "
+        "the per-node [front | remain] tables")
 
-    # --- pop up to B parents off the top (popBackBulk analogue)
+    # --- pop up to B parents off the top (popBackBulk analogue); the pop
+    # window [start, start+B) is contiguous, so dynamic_slice beats a gather
     n = jnp.minimum(state.size, B)
     start = state.size - n
-    rows = start + jnp.arange(B, dtype=jnp.int32)
     valid = jnp.arange(B) < n
-    rows = jnp.clip(rows, 0, capacity - 1)
-    p_prmu = state.prmu[rows]                        # (B, J)
-    p_depth = state.depth[rows].astype(jnp.int32)
+    zero = jnp.zeros((), start.dtype)
+    p_prmu = jax.lax.dynamic_slice(state.prmu, (start, zero), (B, J))
+    p_depth = jax.lax.dynamic_slice(state.depth, (start,), (B,)) \
+        .astype(jnp.int32)
     p_depth = jnp.where(valid, p_depth, 0)
+    p_aux = jax.lax.dynamic_slice(state.aux, (start, zero), (B, 2 * M))
+    p_front = p_aux[:, :M]
+    p_remain = p_aux[:, M:]
 
-    # --- bound the dense child grid
-    bounds = batched.children_bounds(lb_kind)(tables, p_prmu, p_depth, valid)
+    # --- bound the dense child grid from the pooled parent tables
+    child_front, child_p = batched._child_fronts(tables, p_prmu, p_front)
     mask = batched.child_mask(p_prmu, p_depth, valid)
+    bounds = batched.bounds_from_parts(lb_kind, tables, p_prmu, p_depth,
+                                       valid, p_front, p_remain,
+                                       child_front, child_p, mask)
 
     # --- leaves: complete schedules; count + tighten incumbent
     # (reference: the depth==jobs branch of decompose, PFSP_lib.c:24-32)
@@ -147,32 +189,54 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     tree = state.tree + n_push.astype(jnp.int64)
 
     children = make_children(p_prmu, p_depth).reshape(B * J, J)
-    child_depth = jnp.broadcast_to(
-        (p_depth + 1)[:, None], (B, J)
-    ).reshape(-1).astype(jnp.int16)
+    # depth rides as an extra aux column: 1-D (element) gathers are far
+    # slower than row gathers on TPU, so compaction moves [front | remain |
+    # depth] in one row-gather and splits afterwards
+    child_aux = jnp.concatenate(
+        [child_front.astype(jnp.int32),
+         (p_remain[:, None, :] - child_p).astype(jnp.int32),
+         jnp.broadcast_to((p_depth + 1)[:, None, None], (B, J, 1))],
+        axis=-1,
+    ).reshape(B * J, 2 * M + 1)
 
-    # compacting scatter: k-th surviving child -> row start + k
-    dest = jnp.where(flat_push,
-                     start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
-                     capacity)                       # capacity => dropped
+    # Compaction: stable-partition survivors to the front (child order
+    # preserved, so tree traversal matches the reference exactly), then
+    # write the whole B*J block contiguously at `start`. A row-wise
+    # compacting scatter here costs ~100x more than sort+block-write on
+    # TPU (scatter serializes row updates); the garbage rows past n_push
+    # land above the cursor and are never read. The top chunk*J rows of
+    # the pool are a scratch margin (see row_limit) so the block write
+    # stays in bounds even when the live region is full.
+    order = jnp.argsort(~flat_push, stable=True)
+    children = jnp.take(children, order, axis=0)
+    child_aux = jnp.take(child_aux, order, axis=0)
+    child_depth = child_aux[:, 2 * M].astype(jnp.int16)
+    child_aux = child_aux[:, :2 * M]
+
+    limit = row_limit(capacity, B, J)
     new_size = start + n_push
 
-    # An overflowing step must NOT commit: children past capacity are
-    # dropped by the scatter, so advancing the cursor would silently lose
-    # subtrees (and make the overflow checkpoint unrecoverable). Instead
-    # the state is left exactly as before the step with only the flag
-    # set, so grow-capacity + resume continues the search losslessly.
-    # Pool arrays stay untouched by routing the whole scatter to the
-    # drop row (O(chunk), no capacity-sized select on the hot loop);
-    # the remaining guards are scalar selects.
-    overflow = new_size > capacity
-    dest = jnp.where(overflow, capacity, dest)
-    prmu = state.prmu.at[dest].set(children, mode="drop")
-    depth = state.depth.at[dest].set(child_depth, mode="drop")
+    # An overflowing step must NOT commit: advancing the cursor past the
+    # limit would lose subtrees (and make the overflow checkpoint
+    # unrecoverable). The state is left exactly as before the step with
+    # only the flag set — the block write is routed to the scratch margin
+    # (rows [limit, limit + B*J) hold no live data by the size <= limit
+    # invariant) and scalars are guarded with selects, so grow-capacity +
+    # resume continues the search losslessly.
+    overflow = new_size > limit
+    write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
+    zero = jnp.zeros((), start.dtype)
+    prmu = jax.lax.dynamic_update_slice(state.prmu, children,
+                                        (write_at, zero))
+    depth = jax.lax.dynamic_update_slice(state.depth, child_depth,
+                                         (write_at,))
+    aux = jax.lax.dynamic_update_slice(state.aux, child_aux,
+                                       (write_at, zero))
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     return state._replace(
         prmu=prmu,
         depth=depth,
+        aux=aux,
         size=keep(new_size, state.size),
         best=keep(best, state.best),
         tree=keep(tree, state.tree),
@@ -199,10 +263,16 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
     pop+decompose). `max_iters` is a traced scalar, NOT a static argument:
     segmented drivers pass a new ceiling every segment and must hit the
     compile cache."""
-    limit = (jnp.iinfo(state.iters.dtype).max if max_iters is None
-             else max_iters)
+    capacity, jobs = state.prmu.shape
+    if int(np.asarray(state.size).max()) > row_limit(capacity, chunk, jobs):
+        # Pool already fuller than the usable limit (e.g. capacity < the
+        # chunk*jobs scratch margin): report overflow without touching
+        # anything — the caller grows the pool and resumes losslessly.
+        return state._replace(overflow=jnp.asarray(True))
+    ceiling = (jnp.iinfo(state.iters.dtype).max if max_iters is None
+               else max_iters)
     return _run(tables, state, lb_kind, chunk,
-                jnp.asarray(limit, dtype=state.iters.dtype))
+                jnp.asarray(ceiling, dtype=state.iters.dtype))
 
 
 class SearchResult(NamedTuple):
@@ -228,7 +298,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         tables = batched.make_tables(p_times)
     jobs = p_times.shape[1]
     while True:
-        state = init_state(jobs, capacity, init_ub)
+        state = init_state(jobs, capacity, init_ub, p_times=p_times)
         out = run(tables, state, lb_kind, chunk, max_iters)
         if not bool(out.overflow):
             return SearchResult(

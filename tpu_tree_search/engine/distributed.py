@@ -40,7 +40,7 @@ from ..ops.batched import BoundTables
 from ..parallel import balance as bal
 from ..parallel.mesh import WORKER_AXIS, shard_map, worker_mesh
 from . import sequential as seq
-from .device import SearchState, step
+from .device import SearchState, row_limit as device_row_limit, step
 
 AX = WORKER_AXIS
 
@@ -57,6 +57,7 @@ class Frontier:
     tree: int           # counters accumulated during warm-up
     sol: int
     best: int
+    aux: np.ndarray | None = None  # (n, A) int32 per-node pool tables
 
 
 def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
@@ -123,8 +124,10 @@ def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
 
 
 def _balance_round(s: SearchState, transfer_cap: int,
-                   min_transfer: int) -> SearchState:
-    """One collective steal-half exchange (see parallel/balance.py)."""
+                   min_transfer: int, limit: int) -> SearchState:
+    """One collective steal-half exchange (see parallel/balance.py).
+    `limit` is the usable-row bound (device.row_limit) every commit must
+    respect so the engine's block writes stay in bounds."""
     capacity, J = s.prmu.shape
     D = jax.lax.psum(1, AX)
     sizes = jax.lax.all_gather(s.size, AX)                  # (D,)
@@ -141,14 +144,18 @@ def _balance_round(s: SearchState, transfer_cap: int,
     send_mask = k[None, :] < my_out[:, None]
     rows_c = jnp.clip(rows, 0, capacity - 1)
     buf_prmu = s.prmu[rows_c]                               # (D, cap, J)
+    buf_aux = s.aux[rows_c]                                 # (D, cap, A)
     buf_depth = jnp.where(send_mask, s.depth[rows_c], -1)   # -1 = hole
 
     rbuf_prmu = jax.lax.all_to_all(buf_prmu, AX, 0, 0)
+    rbuf_aux = jax.lax.all_to_all(buf_aux, AX, 0, 0)
     rbuf_depth = jax.lax.all_to_all(buf_depth, AX, 0, 0)
 
     # push received nodes (compacting scatter onto the new top)
     flat_depth = rbuf_depth.reshape(-1)
     flat_prmu = rbuf_prmu.reshape(-1, J)
+    flat_aux = rbuf_aux.reshape(
+        rbuf_aux.shape[0] * rbuf_aux.shape[1], s.aux.shape[1])
     push = flat_depth >= 0
     n_push = push.sum(dtype=jnp.int32)
     dest = jnp.where(push, base + jnp.cumsum(push, dtype=jnp.int32) - 1,
@@ -157,11 +164,12 @@ def _balance_round(s: SearchState, transfer_cap: int,
     return s._replace(
         prmu=s.prmu.at[dest].set(flat_prmu, mode="drop"),
         depth=s.depth.at[dest].set(flat_depth.astype(jnp.int16), mode="drop"),
+        aux=s.aux.at[dest].set(flat_aux, mode="drop"),
         size=new_size,
         sent=s.sent + total_out.astype(jnp.int64),
         recv=s.recv + n_push.astype(jnp.int64),
         steals=s.steals + (n_push > 0).astype(jnp.int64),
-        overflow=s.overflow | (new_size > capacity),
+        overflow=s.overflow | (new_size > limit),
     )
 
 
@@ -175,10 +183,13 @@ def _expand(s: SearchState):
 
 def build_dist_loop(mesh, tables, make_local_step,
                     balance_period: int, transfer_cap: int,
-                    min_transfer: int, max_rounds: int | None = None):
+                    min_transfer: int, max_rounds: int | None = None,
+                    limit: int | None = None):
     """Compile a distributed search loop for any problem: state sharded over
     the worker axis, problem tables replicated. `make_local_step(tables)`
-    returns the problem's SearchState -> SearchState step."""
+    returns the problem's SearchState -> SearchState step. `limit` is the
+    per-worker usable-row bound (device.row_limit); defaults to the full
+    pool capacity for steps that reserve no scratch margin."""
 
     def worker_loop(tables, *state_leaves):
         s = _local_state(*state_leaves)
@@ -197,7 +208,8 @@ def build_dist_loop(mesh, tables, make_local_step,
             s = jax.lax.fori_loop(0, balance_period,
                                   lambda _, x: local_step(x), s)
             s = s._replace(best=jax.lax.pmin(s.best, AX))
-            return _balance_round(s, transfer_cap, min_transfer)
+            row_bound = s.prmu.shape[0] if limit is None else limit
+            return _balance_round(s, transfer_cap, min_transfer, row_bound)
 
         return _expand(jax.lax.while_loop(cond, body, s))
 
@@ -227,22 +239,31 @@ class DistResult:
 
 
 def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
-                    init_best: int):
+                    init_best: int, limit: int | None = None):
     """Round-robin stripe the frontier across workers
-    (reference: roundRobin_distribution, Pool_atom.c:14-36)."""
+    (reference: roundRobin_distribution, Pool_atom.c:14-36). `limit`
+    (device.row_limit) bounds each stripe so seeding respects the
+    engine's usable-row invariant."""
+    if limit is None:
+        limit = capacity
+    aux_w = 0 if fr.aux is None else fr.aux.shape[1]
     prmu = np.zeros((n_dev, capacity, jobs), np.int16)
     depth = np.zeros((n_dev, capacity), np.int16)
+    aux = np.zeros((n_dev, capacity, aux_w), np.int32)
     sizes = np.zeros(n_dev, np.int32)
     for d in range(n_dev):
         stripe_p = fr.prmu[d::n_dev]
         stripe_d = fr.depth[d::n_dev]
         n = len(stripe_d)
-        assert n <= capacity
+        assert n <= limit
         prmu[d, :n] = stripe_p
         depth[d, :n] = stripe_d
+        if aux_w:
+            aux[d, :n] = fr.aux[d::n_dev]
         sizes[d] = n
     return (
-        jnp.asarray(prmu), jnp.asarray(depth), jnp.asarray(sizes),
+        jnp.asarray(prmu), jnp.asarray(depth), jnp.asarray(aux),
+        jnp.asarray(sizes),
         jnp.full((n_dev,), init_best, jnp.int32),
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
@@ -270,15 +291,24 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     min_transfer = min_transfer or 2 * chunk
 
     fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
+    fr.aux = ref.prefix_front_remain(p_times, fr.prmu, fr.depth)
     init_best = fr.best if init_ub is None else min(fr.best, int(init_ub))
 
     def make_local_step(t):
         return functools.partial(step, t, lb_kind, chunk)
 
-    run = build_dist_loop(mesh, tables, make_local_step, balance_period,
-                          transfer_cap, min_transfer, max_rounds)
+    # a stripe must fit under the usable-row limit: pre-grow rather than
+    # fail seeding (the graceful path the overflow retry provides mid-run)
+    stripe = -(-max(len(fr.depth), 1) // n_dev)
+    while device_row_limit(capacity, chunk, jobs) < stripe:
+        capacity *= 2
+
     while True:
-        state = _shard_frontier(fr, n_dev, capacity, jobs, init_best)
+        run = build_dist_loop(mesh, tables, make_local_step, balance_period,
+                              transfer_cap, min_transfer, max_rounds,
+                              limit=device_row_limit(capacity, chunk, jobs))
+        state = _shard_frontier(fr, n_dev, capacity, jobs, init_best,
+                                limit=device_row_limit(capacity, chunk, jobs))
         out = SearchState(*run(tables, *state))
         if not bool(np.asarray(out.overflow).any()):
             break

@@ -26,7 +26,7 @@ import numpy as np
 from ..ops import nqueens_ops
 from ..parallel.mesh import worker_mesh
 from . import distributed as dist
-from .device import SearchState, init_state, make_children
+from .device import SearchState, init_state, make_children, row_limit
 
 I32_MAX = jnp.int32(2**31 - 1)
 
@@ -38,10 +38,13 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
 
     n_pop = jnp.minimum(state.size, B)
     start = state.size - n_pop
-    rows = jnp.clip(start + jnp.arange(B, dtype=jnp.int32), 0, capacity - 1)
     valid = jnp.arange(B) < n_pop
-    board = state.prmu[rows]
-    depth = jnp.where(valid, state.depth[rows].astype(jnp.int32), 0)
+    zero = jnp.zeros((), start.dtype)
+    board = jax.lax.dynamic_slice(state.prmu, (start, zero), (B, N))
+    depth = jnp.where(
+        valid,
+        jax.lax.dynamic_slice(state.depth, (start,), (B,)).astype(jnp.int32),
+        0)
 
     # popped complete boards are solutions (reference: nqueens_c.c:104-106)
     sol = state.sol + ((depth == N) & valid).sum(dtype=jnp.int64)
@@ -55,21 +58,25 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
     child_depth = jnp.broadcast_to((depth + 1)[:, None], (B, N)) \
         .reshape(-1).astype(jnp.int16)
 
-    dest = jnp.where(flat_push,
-                     start + jnp.cumsum(flat_push, dtype=jnp.int32) - 1,
-                     capacity)
+    # As in device.step: stable-partition survivors first, block-write at
+    # `start` (scatter-free push), route an overflowing write to the
+    # scratch margin so the state stays resumable.
+    order = jnp.argsort(~flat_push, stable=True)
+    children = jnp.take(children, order, axis=0)
+    child_depth = jnp.take(child_depth, order)
+
+    limit = row_limit(capacity, B, N)
     new_size = start + n_push
-    # As in device.step: an overflowing step must not commit, so the state
-    # stays resumable. The scatter is routed to the drop row (O(chunk));
-    # scalars are guarded with selects.
-    overflow = new_size > capacity
-    dest = jnp.where(overflow, capacity, dest)
+    overflow = new_size > limit
+    write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     evals = state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
                            & valid[:, None]).sum(dtype=jnp.int64)
     return state._replace(
-        prmu=state.prmu.at[dest].set(children, mode="drop"),
-        depth=state.depth.at[dest].set(child_depth, mode="drop"),
+        prmu=jax.lax.dynamic_update_slice(state.prmu, children,
+                                          (write_at, zero)),
+        depth=jax.lax.dynamic_update_slice(state.depth, child_depth,
+                                           (write_at,)),
         size=keep(new_size, state.size),
         tree=keep(tree, state.tree),
         sol=keep(sol, state.sol),
@@ -93,10 +100,14 @@ def run(state: SearchState, n: int, g: int, chunk: int,
         max_iters: int | None = None) -> SearchState:
     """`max_iters` is a traced scalar (see device.run): segmented callers
     pass a new ceiling per segment without recompiling."""
-    limit = (jnp.iinfo(state.iters.dtype).max if max_iters is None
-             else max_iters)
+    capacity = state.prmu.shape[0]
+    if int(np.asarray(state.size).max()) > row_limit(capacity, chunk, n):
+        # as in device.run: overflow-flag, don't touch anything
+        return state._replace(overflow=jnp.asarray(True))
+    ceiling = (jnp.iinfo(state.iters.dtype).max if max_iters is None
+               else max_iters)
     return _run(state, n, g, chunk,
-                jnp.asarray(limit, dtype=state.iters.dtype))
+                jnp.asarray(ceiling, dtype=state.iters.dtype))
 
 
 class NQResult(NamedTuple):
@@ -158,11 +169,17 @@ def search_distributed(n: int, g: int = 1, n_devices: int | None = None,
     def make_local_step(_tables):
         return functools.partial(nq_step, n, g, chunk)
 
-    loop = dist.build_dist_loop(mesh, (), make_local_step, balance_period,
-                                transfer_cap=4 * chunk,
-                                min_transfer=2 * chunk)
+    stripe = -(-max(len(fr.depth), 1) // n_dev)
+    while row_limit(capacity, chunk, n) < stripe:
+        capacity *= 2
+
     while True:
-        state = dist._shard_frontier(fr, n_dev, capacity, n, 2**31 - 1)
+        loop = dist.build_dist_loop(mesh, (), make_local_step, balance_period,
+                                    transfer_cap=4 * chunk,
+                                    min_transfer=2 * chunk,
+                                    limit=row_limit(capacity, chunk, n))
+        state = dist._shard_frontier(fr, n_dev, capacity, n, 2**31 - 1,
+                                     limit=row_limit(capacity, chunk, n))
         out = SearchState(*loop((), *state))
         if not bool(np.asarray(out.overflow).any()):
             break

@@ -124,10 +124,24 @@ def _child_fronts(t: BoundTables, prmu, front):
     """front of every dense child: append job prmu[b, i] to parent b's prefix
     (one add_forward chain, c_bound_simple.c:31-38, on (B, J) lanes).
 
+    The job-id -> processing-times lookup is a one-hot matmul on the MXU
+    rather than a gather: per-element dynamic gathers serialize on TPU
+    (~ms at 100k+ lanes) while a (B*J, J) x (J, M) matmul is microseconds.
+    f32 accumulates integers exactly (p_times < 2^24).
+
     Returns (child_front [(B, J, M)], child_p [(B, J, M)] the per-machine
     processing times of the appended job)."""
-    jobs = prmu.astype(jnp.int32)                    # (B, J) appended job ids
-    child_p = t.p_t[jobs]                            # (B, J, M)
+    B, J = prmu.shape
+    M = t.p.shape[0]
+    onehot = (prmu[..., None].astype(jnp.int32)
+              == jnp.arange(J, dtype=jnp.int32)).astype(jnp.float32)
+    # HIGHEST precision: the default TPU matmul pass rounds f32 inputs
+    # through bfloat16, which would corrupt processing times > 256
+    child_p = jnp.dot(onehot.reshape(B * J, J),
+                      t.p_t.astype(jnp.float32),
+                      precision=jax.lax.Precision.HIGHEST,
+                      preferred_element_type=jnp.float32)
+    child_p = child_p.astype(jnp.int32).reshape(B, J, M)   # (B, J, M)
     chain = front[:, None, 0] + child_p[..., 0]
     cols = [chain]
     M = t.p.shape[0]
@@ -145,18 +159,12 @@ def child_mask(prmu: jax.Array, depth: jax.Array, valid: jax.Array):
     return (jnp.arange(J)[None, :] >= depth[:, None]) & valid[:, None]
 
 
-def lb1_children(t: BoundTables, prmu, depth, valid):
-    """LB1 bound of every child (reference semantics: lb1_bound of the child
-    permutation, c_bound_simple.c:143-158, as launched per-child by
-    evaluate_gpu_lb1, PFSP_gpu_lib.cu:43-65).
+def lb1_from_parts(t: BoundTables, child_front, child_remain, mask):
+    """LB1 combine chain given each child's front/remain
+    (machine_bound_from_parts, c_bound_simple.c:126-141, on (B, J) lanes).
 
     Returns (B, J) int32; masked slots hold I32_MAX (always pruned).
     """
-    front, remain = parent_tables(t, prmu, depth)
-    child_front, child_p = _child_fronts(t, prmu, front)
-    child_remain = remain[:, None, :] - child_p       # job leaves 'remain'
-
-    # machine_bound_from_parts chain (c_bound_simple.c:126-141)
     M = t.p.shape[0]
     back = t.min_tails
     tmp0 = child_front[..., 0] + child_remain[..., 0]
@@ -165,21 +173,33 @@ def lb1_children(t: BoundTables, prmu, depth, valid):
         tmp1 = jnp.maximum(tmp0, child_front[..., k] + child_remain[..., k])
         lb = jnp.maximum(lb, tmp1 + back[k])
         tmp0 = tmp1
-    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+    return jnp.where(mask, lb, I32_MAX)
 
 
-def lb1d_children(t: BoundTables, prmu, depth, valid):
-    """LB1_d incremental bound of every child (`add_front_and_bound`,
-    reference: c_bound_simple.c:218-244, as launched per-parent by
-    evaluate_gpu_lb1_d, PFSP_gpu_lib.cu:73-102).
+def lb1_children(t: BoundTables, prmu, depth, valid):
+    """LB1 bound of every child (reference semantics: lb1_bound of the child
+    permutation, c_bound_simple.c:143-158, as launched per-child by
+    evaluate_gpu_lb1, PFSP_gpu_lib.cu:43-65).
+
+    Recomputes the parents' prefix tables; the engines instead carry
+    front/remain in the pool and call `lb1_from_parts` directly.
+    """
+    front, remain = parent_tables(t, prmu, depth)
+    child_front, child_p = _child_fronts(t, prmu, front)
+    child_remain = remain[:, None, :] - child_p       # job leaves 'remain'
+    return lb1_from_parts(t, child_front, child_remain,
+                          child_mask(prmu, depth, valid))
+
+
+def lb1d_from_parts(t: BoundTables, front, remain, child_p, mask):
+    """LB1_d chain given the parents' front/remain and each child's
+    per-machine processing times (`add_front_and_bound`,
+    c_bound_simple.c:218-244, on (B, J) lanes).
 
     Returns (B, J) int32; masked slots hold I32_MAX.
     """
-    front, remain = parent_tables(t, prmu, depth)
-    _, child_p = _child_fronts(t, prmu, front)        # only needs p of the job
     back = t.min_tails
     M = t.p.shape[0]
-
     lb = (front[:, None, 0] + remain[:, None, 0] + back[0]) \
         * jnp.ones_like(child_p[..., 0])
     tmp0 = front[:, None, 0] + child_p[..., 0]
@@ -187,13 +207,23 @@ def lb1d_children(t: BoundTables, prmu, depth, valid):
         tmp1 = jnp.maximum(tmp0, front[:, None, k])
         lb = jnp.maximum(lb, tmp1 + remain[:, None, k] + back[k])
         tmp0 = tmp1 + child_p[..., k]
-    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+    return jnp.where(mask, lb, I32_MAX)
 
 
-def lb2_children(t: BoundTables, prmu, depth, valid):
-    """LB2 Johnson bound of every child (reference: lb2_bound,
-    c_bound_johnson.c:239-254, per-child as evaluate_gpu_lb2,
-    PFSP_gpu_lib.cu:105-127).
+def lb1d_children(t: BoundTables, prmu, depth, valid):
+    """LB1_d incremental bound of every child (as launched per-parent by
+    evaluate_gpu_lb1_d, PFSP_gpu_lib.cu:73-102). Recomputes parent tables;
+    engines use `lb1d_from_parts`."""
+    front, remain = parent_tables(t, prmu, depth)
+    _, child_p = _child_fronts(t, prmu, front)        # only needs p of the job
+    return lb1d_from_parts(t, front, remain, child_p,
+                           child_mask(prmu, depth, valid))
+
+
+def lb2_from_parts(t: BoundTables, prmu, depth, child_front, mask):
+    """LB2 Johnson bound of every child given each child's front
+    (reference: lb2_bound, c_bound_johnson.c:239-254, per-child as
+    evaluate_gpu_lb2, PFSP_gpu_lib.cu:105-127).
 
     The reference's data-dependent early exit over machine pairs
     (c_bound_johnson.c:231-233) is replaced by a full masked max over all
@@ -206,9 +236,6 @@ def lb2_children(t: BoundTables, prmu, depth, valid):
     prmu = jnp.asarray(prmu)
     depth = jnp.asarray(depth)
     B, J = prmu.shape
-    P = t.ma0.shape[0]
-    front, _ = parent_tables(t, prmu, depth)
-    child_front, _ = _child_fronts(t, prmu, front)    # (B, J, M)
 
     # inverse permutation: slot_of_job[b, job] = position of job in prmu[b]
     slot_of_job = jnp.zeros((B, J), jnp.int32).at[
@@ -245,10 +272,34 @@ def lb2_children(t: BoundTables, prmu, depth, valid):
     back1 = jnp.take(t.min_tails, t.ma1)
     per_pair = jnp.maximum(tmp1 + back1, tmp0 + back0)
     lb = per_pair.max(axis=-1)                        # (B, J)
-    return jnp.where(child_mask(prmu, depth, valid), lb, I32_MAX)
+    return jnp.where(mask, lb, I32_MAX)
+
+
+def lb2_children(t: BoundTables, prmu, depth, valid):
+    """LB2 bound of every child, recomputing parent tables; engines use
+    `lb2_from_parts`."""
+    front, _ = parent_tables(t, prmu, depth)
+    child_front, _ = _child_fronts(t, prmu, front)    # (B, J, M)
+    return lb2_from_parts(t, prmu, depth, child_front,
+                          child_mask(prmu, depth, valid))
 
 
 def children_bounds(lb_kind: int):
     """Dispatch like the reference's `decompose`/`evaluate_gpu`
     (PFSP_lib.h:30-48, PFSP_gpu_lib.cu:129-152): 0=LB1_d, 1=LB1, 2=LB2."""
     return {0: lb1d_children, 1: lb1_children, 2: lb2_children}[lb_kind]
+
+
+def bounds_from_parts(lb_kind: int, t: BoundTables, prmu, depth, valid,
+                      front, remain, child_front, child_p, mask):
+    """Bound dispatch for engines that carry front/remain in the pool —
+    no O(jobs) prefix rescan (the reference pays that rescan per bound,
+    c_bound_simple.c:51-69; here each node's tables ride along with it)."""
+    if lb_kind == 0:
+        return lb1d_from_parts(t, front, remain, child_p, mask)
+    if lb_kind == 1:
+        child_remain = remain[:, None, :] - child_p
+        return lb1_from_parts(t, child_front, child_remain, mask)
+    if lb_kind == 2:
+        return lb2_from_parts(t, prmu, depth, child_front, mask)
+    raise ValueError(f"unknown lb_kind {lb_kind}")

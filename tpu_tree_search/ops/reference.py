@@ -163,6 +163,35 @@ def lb1_children_bounds(data: LB1Data, perm, limit1: int, limit2: int) -> np.nda
     return lb_begin
 
 
+def prefix_front_remain(p_times: np.ndarray, prmu: np.ndarray,
+                        depth: np.ndarray) -> np.ndarray:
+    """Per-node pool auxiliary data `[front | remain]` (n, 2*machines) int32.
+
+    `front` is the actual machine-completion vector of the scheduled prefix
+    (zeros for an empty prefix — children chain from the parent's true
+    front, not from min_heads) and `remain` the per-machine unscheduled
+    work. This is what the device engines carry in the pool so bounds never
+    rescan the prefix (the reference recomputes it per bound,
+    c_bound_simple.c:51-69).
+    """
+    p = np.asarray(p_times, dtype=np.int64)
+    m = p.shape[0]
+    prmu = np.asarray(prmu).reshape(-1, p.shape[1])
+    depth = np.asarray(depth).reshape(-1)
+    total = p.sum(axis=1)
+    out = np.zeros((prmu.shape[0], 2 * m), dtype=np.int32)
+    for b in range(prmu.shape[0]):
+        front = np.zeros(m, dtype=np.int64)
+        sched = np.zeros(m, dtype=np.int64)
+        for i in range(int(depth[b])):
+            job = int(prmu[b, i])
+            add_forward(job, p, front)
+            sched += p[:, job]
+        out[b, :m] = front
+        out[b, m:] = total - sched
+    return out
+
+
 def eval_solution(data: LB1Data, perm) -> int:
     """Makespan of a complete permutation (reference: c_bound_simple.c:92-106)."""
     front = np.zeros(data.p_times.shape[0], dtype=np.int64)
