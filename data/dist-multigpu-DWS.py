@@ -1,0 +1,24 @@
+#!/usr/bin/env python
+"""Load-balance (work-stealing) success accounting for distributed runs
+(reference counterpart: pfsp/data/dist-multigpu-DWS.py:30-60, which sums
+WS0/WS1 steal successes per rank; the TPU engine's collective balancer
+reports `steals` = exchange rounds that delivered nodes and
+`all_dist_load_bal` = nodes received per device).
+
+Usage: python data/dist-multigpu-DWS.py [dist.csv]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+rows = analysis.read_rows(sys.argv[1] if len(sys.argv) > 1 else "dist.csv")
+print(f"{'inst':>6} {'devs':>5} {'time[s]':>10} {'steal_rounds':>13} "
+      f"{'nodes_recv':>11}")
+for rec in analysis.steal_summary(rows):
+    print(f"ta{int(rec['instance_id']):03d} {int(rec['devices']):5d} "
+          f"{rec['total_time']:10.3f} "
+          f"{rec['steal_rounds'] if rec['steal_rounds'] is not None else '-':>13} "
+          f"{rec['nodes_received'] if rec['nodes_received'] is not None else '-':>11}")

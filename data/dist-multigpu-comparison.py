@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Distributed scaling vs the reference's published LUMI-G series.
+
+The reference hardcodes its measured ta021/ta024 distributed runtimes
+for 1..128 LUMI-G nodes (8 GPUs each) in
+pfsp/data/dist-multigpu-comparison.py:17-23; this script prints a TPU
+dist CSV against that series.
+
+Usage: python data/dist-multigpu-comparison.py [dist.csv]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import numpy as np
+
+from tpu_tree_search.utils import analysis
+
+NODES = [1, 2, 4, 8, 16, 32, 64, 128]
+# BASELINE.md "Distributed runtime ta021/ta024" (Chapel comparator series)
+REF_TA021 = [6600.81, 3941.10, 1967.77, 984.75, 495.01, 247.91, 125.87, 67.16]
+REF_TA024 = [1866.64, 1086.43, 541.01, 273.56, 136.94, 69.40, 36.01, 20.36]
+REF = {21: dict(zip(NODES, REF_TA021)), 24: dict(zip(NODES, REF_TA024))}
+
+rows = analysis.read_rows(sys.argv[1] if len(sys.argv) > 1 else "dist.csv")
+med = analysis.times_by_key(rows, ("instance_id", "comm_size"))
+
+print(f"{'inst':>6} {'hosts':>6} {'tpu[s]':>10} {'ref[s]':>10} {'vs_ref':>8}")
+for (inst, cs), times in sorted(med.items()):
+    t = float(np.median(times))
+    ref = REF.get(int(inst), {}).get(int(cs))
+    print(f"ta{int(inst):03d} {int(cs):6d} {t:10.2f} "
+          f"{ref or float('nan'):10.2f} "
+          f"{(ref / t) if ref else float('nan'):8.2f}x")

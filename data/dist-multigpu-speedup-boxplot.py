@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Multi-host scaling sweep: speedup + boxplot stats over comm_size
+(reference counterpart: pfsp/data/dist-multigpu-speedup-boxplot.py,
+which sweeps comm_size in {2..128} vs the 32-PU intra-node baseline).
+
+Usage: python data/dist-multigpu-speedup-boxplot.py [dist.csv] [baseline_comm_size]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+path = sys.argv[1] if len(sys.argv) > 1 else "dist.csv"
+base = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+rows = analysis.read_rows(path)
+
+sp = analysis.speedup_table(rows, "comm_size", base)
+bx = analysis.boxplot_by(rows, ("instance_id", "comm_size"))
+
+print(f"{'inst':>6} {'hosts':>6} {'median[s]':>10} {'speedup':>8} "
+      f"{'q1':>9} {'q3':>9}")
+for (inst, cs), rec in sp.items():
+    s = bx[(inst, cs)]
+    spd = rec["speedup"]
+    print(f"ta{int(inst):03d} {int(cs):6d} {rec['median_time']:10.3f} "
+          f"{spd if spd else float('nan'):8.2f} {s.q1:9.3f} {s.q3:9.3f}")

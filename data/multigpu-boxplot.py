@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Runtime boxplot statistics per (instance, device count)
+(reference counterpart: pfsp/data/multigpu-boxplot.py; the stats math is
+the reference's own util.c toolkit, see tpu_tree_search/utils/stats.py).
+
+Usage: python data/multigpu-boxplot.py [multidevice.csv] [--plot out.png]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+args = [a for a in sys.argv[1:] if not a.startswith("--")]
+rows = analysis.read_rows(args[0] if args else "multidevice.csv")
+stats = analysis.boxplot_by(rows, ("instance_id", "D"))
+
+print(f"{'inst':>6} {'D':>4} {'min':>9} {'q1':>9} {'median':>9} "
+      f"{'q3':>9} {'max':>9}")
+for (inst, d), s in sorted(stats.items()):
+    print(f"ta{int(inst):03d} {int(d):4d} {s.minimum:9.3f} {s.q1:9.3f} "
+          f"{s.median:9.3f} {s.q3:9.3f} {s.maximum:9.3f}")
+
+if "--plot" in sys.argv:
+    out = sys.argv[sys.argv.index("--plot") + 1]
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        sys.exit("matplotlib not available; omit --plot")
+    keys = sorted(stats)
+    fig, ax = plt.subplots(figsize=(8, 4))
+    ax.bxp([{
+        "label": f"ta{int(i):03d}/D{int(d)}",
+        "whislo": stats[(i, d)].minimum, "q1": stats[(i, d)].q1,
+        "med": stats[(i, d)].median, "q3": stats[(i, d)].q3,
+        "whishi": stats[(i, d)].maximum,
+    } for i, d in keys], showfliers=False)
+    ax.set_ylabel("runtime [s]")
+    ax.tick_params(axis="x", rotation=45)
+    fig.tight_layout()
+    fig.savefig(out, dpi=150)
+    print(f"wrote {out}")

@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Strong-scaling speedup/efficiency over the device count
+(reference counterpart: pfsp/data/multigpu-speedup.py:29-66, which maps
+processing units to GPUs via {4:1, 8:2, 16:4, 32:8}; a TPU processing
+unit is a mesh device, so `D` is used directly).
+
+Usage: python data/multigpu-speedup.py [multidevice.csv] [baseline_D]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+path = sys.argv[1] if len(sys.argv) > 1 else "multidevice.csv"
+base = int(sys.argv[2]) if len(sys.argv) > 2 else 1
+rows = analysis.read_rows(path)
+table = analysis.speedup_table(rows, "D", base)
+
+print(f"{'inst':>6} {'D':>4} {'median[s]':>10} {'speedup':>8} {'eff':>6}")
+for (inst, d), rec in table.items():
+    sp = rec["speedup"]
+    ef = rec["efficiency"]
+    print(f"ta{int(inst):03d} {int(d):4d} {rec['median_time']:10.3f} "
+          f"{sp if sp else float('nan'):8.2f} "
+          f"{ef if ef else float('nan'):6.2f}")

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Per-PU counter breakdown of multi-device runs
+(reference counterpart: pfsp/data/multigpu-stats-analysis.py:43-70,
+which tabulates the per-thread time-breakdown columns; the TPU engine's
+phases are fused into the compiled loop, so the live per-PU signals are
+the work counters: explored tree/solutions per device, steal rounds).
+
+Usage: python data/multigpu-stats-analysis.py [multidevice.csv]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+rows = analysis.read_rows(sys.argv[1] if len(sys.argv) > 1
+                          else "multidevice.csv")
+breakdown = analysis.per_pu_breakdown(
+    rows, ("exp_tree_gpu", "exp_sol_gpu", "gen_child_gpu", "steals_gpu"))
+
+for rec in breakdown:
+    print(f"ta{int(rec['instance_id']):03d} D={rec['devices']}")
+    for field, s in rec.items():
+        if isinstance(s, dict):
+            print(f"  {field:16s} min={s['min']:12.0f} "
+                  f"median={s['median']:12.0f} max={s['max']:12.0f} "
+                  f"sum={s['sum']:14.0f}")

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Single-device runtimes vs the reference's published GPU numbers.
+
+The reference hardcodes its measured V100-CUDA and MI50-HIP runtimes for
+the ten 20x20 instances ta021-ta030 (reference: pfsp/data/single-GPU.py:
+20-21, 39-40, instance order :6); this script compares a TPU
+single-device CSV against those baselines and prints the speedup.
+
+Usage: python data/single-device-comparison.py [singledevice.csv] [--plot out.png]
+"""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+# ta-instance -> published seconds (BASELINE.md "Single-GPU PFSP runtime")
+V100_CUDA = {29: 4.18, 30: 4.91, 22: 5.63, 27: 19.82, 23: 41.04,
+             28: 73.75, 25: 81.97, 26: 176.40, 24: 738.93, 21: 1308.79}
+MI50_HIP = {29: 7.56, 30: 9.14, 22: 10.52, 27: 38.08, 23: 79.44,
+            28: 140.81, 25: 159.35, 26: 379.45, 24: 1445.49, 21: 2538.23}
+
+args = [a for a in sys.argv[1:] if not a.startswith("--")]
+rows = analysis.read_rows(args[0] if args else "singledevice.csv")
+med = analysis.times_by_key(rows, ("instance_id",))
+
+print(f"{'inst':>6} {'tpu[s]':>10} {'V100[s]':>10} {'vsV100':>8} "
+      f"{'MI50[s]':>10} {'vsMI50':>8}")
+table = []
+for (inst,), times in sorted(med.items()):
+    import numpy as np
+    t = float(np.median(times))
+    v = V100_CUDA.get(int(inst))
+    m = MI50_HIP.get(int(inst))
+    print(f"ta{int(inst):03d}  {t:10.2f} {v or float('nan'):10.2f} "
+          f"{(v / t) if v else float('nan'):8.2f}x "
+          f"{m or float('nan'):10.2f} {(m / t) if m else float('nan'):8.2f}x")
+    table.append((int(inst), t, v, m))
+
+if "--plot" in sys.argv:
+    out = sys.argv[sys.argv.index("--plot") + 1]
+    try:
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        sys.exit("matplotlib not available; omit --plot")
+    import numpy as np
+    insts = [f"ta{i:03d}" for i, *_ in table]
+    x = np.arange(len(table))
+    fig, ax = plt.subplots(figsize=(9, 4))
+    ax.bar(x - 0.2, [r[1] for r in table], 0.2, label="TPU")
+    ax.bar(x, [r[2] or 0 for r in table], 0.2, label="V100 (ref)")
+    ax.bar(x + 0.2, [r[3] or 0 for r in table], 0.2, label="MI50 (ref)")
+    ax.set_xticks(x, insts)
+    ax.set_yscale("log")
+    ax.set_ylabel("runtime [s]")
+    ax.legend()
+    fig.tight_layout()
+    fig.savefig(out, dpi=150)
+    print(f"wrote {out}")

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Load + print the single-device experiment CSV
+(reference counterpart: pfsp/data/singlegpu.py)."""
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpu_tree_search.utils import analysis
+
+rows = analysis.read_rows(sys.argv[1] if len(sys.argv) > 1
+                          else "singledevice.csv")
+for r in rows:
+    print(f"ta{int(r['instance_id']):03d} lb{r['lower_bound']} "
+          f"opt={r['optimum']} time={r['total_time']:.3f}s "
+          f"tree={r['explored_tree']} sol={r['explored_sol']}")

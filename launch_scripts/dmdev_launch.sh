@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Multi-host campaign driver under SLURM
+# (reference: pfsp/launch_scripts/dmgpu_launch.sh — srun with
+# --ntasks-per-node=1, one MPI rank per node; here one JAX process per
+# host joins the global mesh via --multihost, collectives ride ICI
+# within a slice and DCN across hosts).
+#
+# Submit e.g.:  sbatch -N 4 launch_scripts/dmdev_launch.sh -j 20 -g 20 -l 2
+#
+#SBATCH --job-name=tts-dist
+#SBATCH --ntasks-per-node=1
+set -euo pipefail
+
+JOBS=20; MACHINES=20; LB=2; UB=1; REPS=1; OUT=dist.csv
+while getopts "j:g:l:u:r:o:" opt; do
+  case $opt in
+    j) JOBS=$OPTARG;; g) MACHINES=$OPTARG;; l) LB=$OPTARG;;
+    u) UB=$OPTARG;; r) REPS=$OPTARG;; o) OUT=$OPTARG;;
+    *) echo "usage: $0 [-j] [-g] [-l] [-u] [-r] [-o]"; exit 2;;
+  esac
+done
+
+source "$(dirname "$0")/instance_groups.sh"
+INSTANCES=$(instance_group "$JOBS" "$MACHINES")
+
+# jax.distributed.initialize discovers coordinator/rank from SLURM env
+for inst in $INSTANCES; do
+  for rep in $(seq 1 "$REPS"); do
+    echo ">>> ta$inst lb=$LB ub=$UB hosts=${SLURM_NNODES:-1} rep=$rep"
+    srun python -m tpu_tree_search --multihost pfsp \
+      -i "$inst" -l "$LB" -u "$UB" --csv "$OUT"
+  done
+done

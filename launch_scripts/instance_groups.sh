@@ -1,0 +1,22 @@
+# Taillard instance groups by jobs x machines, mirroring the reference's
+# mapping (reference: pfsp/launch_scripts/mgpu_launch.sh:41-75) with its
+# campaign exclusions (unsolved: ta051, ta054, ta055, ta059, ta060,
+# ta081, ta085-089, ta102 — mgpu_launch.sh:96).
+instance_group() {
+  local jobs=$1 machines=$2
+  case "${jobs}x${machines}" in
+    20x5)    echo "1 2 3 4 5 6 7 8 9 10";;
+    20x10)   echo "11 12 13 14 15 16 17 18 19 20";;
+    20x20)   echo "21 22 23 24 25 26 27 28 29 30";;
+    50x5)    echo "31 32 33 34 35 36 37 38 39 40";;
+    50x10)   echo "41 42 43 44 45 46 47 48 49 50";;
+    50x20)   echo "52 53 56 57 58";;          # 51,54,55,59,60 unsolved
+    100x5)   echo "61 62 63 64 65 66 67 68 69 70";;
+    100x10)  echo "71 72 73 74 75 76 77 78 79 80";;
+    100x20)  echo "82 83 84 90";;             # 81,85-89 unsolved
+    200x10)  echo "91 92 93 94 95 96 97 98 99 100";;
+    200x20)  echo "101 103 104 105 106 107 108 109 110";;  # 102 unsolved
+    500x20)  echo "111 112 113 114 115 116 117 118 119 120";;
+    *) echo "unknown instance group ${jobs}x${machines}" >&2; return 1;;
+  esac
+}

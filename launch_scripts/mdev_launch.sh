@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# One-host multi-device campaign driver
+# (reference: pfsp/launch_scripts/mgpu_launch.sh — LUMI standard-g,
+# 8 GPUs/node; here: all TPU chips jax.devices() exposes on this host).
+# Usage: mdev_launch.sh [-j jobs] [-g machines] [-l lb] [-u ub] [-D devs]
+#                       [-r reps] [-o out.csv]
+set -euo pipefail
+
+JOBS=20; MACHINES=20; LB=1; UB=1; DEVS=0; REPS=1; OUT=multidevice.csv
+while getopts "j:g:l:u:D:r:o:" opt; do
+  case $opt in
+    j) JOBS=$OPTARG;; g) MACHINES=$OPTARG;; l) LB=$OPTARG;;
+    u) UB=$OPTARG;; D) DEVS=$OPTARG;; r) REPS=$OPTARG;; o) OUT=$OPTARG;;
+    *) echo "usage: $0 [-j] [-g] [-l] [-u] [-D] [-r] [-o]"; exit 2;;
+  esac
+done
+
+source "$(dirname "$0")/instance_groups.sh"
+INSTANCES=$(instance_group "$JOBS" "$MACHINES")
+
+for inst in $INSTANCES; do
+  for rep in $(seq 1 "$REPS"); do
+    echo ">>> ta$inst lb=$LB ub=$UB D=$DEVS rep=$rep"
+    python -m tpu_tree_search pfsp -i "$inst" -l "$LB" -u "$UB" \
+      -D "$DEVS" --csv "$OUT"
+  done
+done

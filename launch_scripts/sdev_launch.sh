@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Single-device campaign driver (reference: pfsp/launch_scripts/sgpu_launch.sh).
+# Usage: sdev_launch.sh [-j jobs] [-g machines] [-l lb] [-u ub] [-r reps] [-o out.csv]
+set -euo pipefail
+
+JOBS=20; MACHINES=20; LB=1; UB=1; REPS=1; OUT=singledevice.csv
+while getopts "j:g:l:u:r:o:" opt; do
+  case $opt in
+    j) JOBS=$OPTARG;; g) MACHINES=$OPTARG;; l) LB=$OPTARG;;
+    u) UB=$OPTARG;; r) REPS=$OPTARG;; o) OUT=$OPTARG;;
+    *) echo "usage: $0 [-j jobs] [-g machines] [-l lb] [-u ub] [-r reps] [-o csv]"; exit 2;;
+  esac
+done
+
+source "$(dirname "$0")/instance_groups.sh"
+INSTANCES=$(instance_group "$JOBS" "$MACHINES")
+
+for inst in $INSTANCES; do
+  for rep in $(seq 1 "$REPS"); do
+    echo ">>> ta$inst lb=$LB ub=$UB rep=$rep"
+    python -m tpu_tree_search pfsp -i "$inst" -l "$LB" -u "$UB" --csv "$OUT"
+  done
+done

@@ -1,0 +1,122 @@
+"""Tests for the roofline model and the experiment-analysis toolkit
+(+ the data/ scripts' shared helpers) against CSVs produced by the real
+writers."""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.utils import analysis, csv_stats, roofline
+
+
+# ---------------------------------------------------------------------------
+# roofline
+
+
+def test_roofline_pairs():
+    assert roofline.pairs_of(20) == 190   # reference: P_of, PFSP_gpu_lib.cu:262
+    assert roofline.pairs_of(2) == 1
+
+
+def test_roofline_regimes():
+    lb1 = roofline.analyze(1, 20, 20)
+    lb2 = roofline.analyze(2, 20, 20)
+    # LB2 does ~160x the arithmetic per child on identical row traffic
+    assert lb2.flops_per_child > 100 * lb1.flops_per_child
+    assert lb2.intensity > lb1.intensity
+    assert lb1.bound <= lb1.bound_compute
+    assert lb1.bound <= lb1.bound_memory
+    assert "children/s" in roofline.report(1, 20, 20, measured_rate=1e7)
+
+
+def test_roofline_rejects_unknown_lb():
+    with pytest.raises(ValueError):
+        roofline.flops_per_child(7, 20, 20)
+
+
+# ---------------------------------------------------------------------------
+# analysis over real CSV writers
+
+
+def _write_dist_csv(path, times_by_hosts):
+    for hosts, times in times_by_hosts.items():
+        for t in times:
+            csv_stats.write_dist(
+                str(path), inst=21, lb=2, D=hosts, C=0, LB=1,
+                comm_size=hosts, optimum=2297, m=25, M=50000, T=5000,
+                total_time=t, total_tree=1000 * hosts, total_sol=3,
+                per_device={"tree": [500] * hosts, "sol": [1] * hosts,
+                            "evals": [9000] * hosts,
+                            "steals": [4] * hosts, "recv": [70] * hosts})
+
+
+def test_read_rows_decodes_array_cells(tmp_path):
+    path = tmp_path / "d.csv"
+    _write_dist_csv(path, {2: [10.0]})
+    rows = analysis.read_rows(str(path))
+    assert len(rows) == 1
+    np.testing.assert_array_equal(rows[0]["all_exp_tree_gpu"], [500, 500])
+    assert rows[0]["instance_id"] == 21
+
+
+def test_speedup_table(tmp_path):
+    path = tmp_path / "d.csv"
+    _write_dist_csv(path, {1: [100.0, 104.0], 2: [50.0, 54.0], 4: [26.0]})
+    rows = analysis.read_rows(str(path))
+    table = analysis.speedup_table(rows, "comm_size", 1)
+    assert table[(21, 1)]["speedup"] == 1.0
+    assert table[(21, 2)]["speedup"] == pytest.approx(102.0 / 52.0)
+    assert table[(21, 4)]["efficiency"] == pytest.approx(102.0 / 26.0 / 4)
+
+
+def test_boxplot_and_steals(tmp_path):
+    path = tmp_path / "d.csv"
+    _write_dist_csv(path, {2: [10.0, 20.0, 30.0]})
+    rows = analysis.read_rows(str(path))
+    bx = analysis.boxplot_by(rows, ("instance_id", "comm_size"))
+    assert bx[(21, 2)].median == 20.0
+    st = analysis.steal_summary(rows)
+    assert st[0]["steal_rounds"] == 8          # 4 per device x 2
+    assert st[0]["nodes_received"] == 140
+
+
+def test_per_pu_breakdown(tmp_path):
+    path = tmp_path / "d.csv"
+    _write_dist_csv(path, {4: [10.0]})
+    rows = analysis.read_rows(str(path))
+    out = analysis.per_pu_breakdown(rows, ("all_exp_tree_gpu",))
+    assert out[0]["all_exp_tree_gpu"]["sum"] == 2000.0
+
+
+# ---------------------------------------------------------------------------
+# the data/ scripts run end-to-end
+
+
+@pytest.mark.parametrize("script,writer", [
+    ("data/singlegpu.py", "single"),
+    ("data/multigpu-speedup.py", "multi"),
+    ("data/multigpu-boxplot.py", "multi"),
+    ("data/multigpu-stats-analysis.py", "multi"),
+    ("data/dist-multigpu-speedup-boxplot.py", "dist"),
+    ("data/dist-multigpu-comparison.py", "dist"),
+    ("data/dist-multigpu-DWS.py", "dist"),
+])
+def test_data_scripts_run(tmp_path, script, writer):
+    path = tmp_path / "x.csv"
+    if writer == "single":
+        csv_stats.write_single(str(path), 21, 1, 2297, 25, 50000,
+                               12.5, 12.0, 1000, 3)
+    elif writer == "multi":
+        for d, t in ((1, 100.0), (4, 30.0)):
+            csv_stats.write_multi(str(path), 21, 1, d, 0, 1, 2297, 25,
+                                  50000, 5000, t, 1000, 3,
+                                  {"tree": [250] * d, "sol": [1] * d,
+                                   "evals": [9000] * d, "steals": [2] * d})
+    else:
+        _write_dist_csv(path, {1: [100.0], 2: [52.0]})
+    proc = subprocess.run([sys.executable, script, str(path)],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "ta021" in proc.stdout

@@ -212,12 +212,28 @@ def main(argv=None) -> int:
     ap.add_argument("--platform", type=str, default=None,
                     help="override the JAX platform (e.g. cpu for "
                          "debugging); must precede the subcommand")
+    ap.add_argument("--multihost", action="store_true",
+                    help="join a multi-host mesh via "
+                         "jax.distributed.initialize() (coordinator/rank "
+                         "discovered from the cluster env, e.g. SLURM); "
+                         "the reference needs a separate MPI executable "
+                         "for this tier (pfsp_dist_multigpu_cuda.c) — "
+                         "here the same program runs, the mesh just "
+                         "spans every host's devices over ICI + DCN")
     sub = ap.add_subparsers(dest="cmd", required=True)
     _pfsp_parser(sub)
     _nq_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
                         "gpu_info, common/gpu_util.cu:5-17)")
+    rp = sub.add_parser("roofline",
+                        help="analytic FLOP/byte bound-kernel model "
+                             "(the reference's flop_lb*/bytes_per_inv_*, "
+                             "PFSP_gpu_lib.cu:213-267)")
+    rp.add_argument("-i", "--inst", type=int, default=21)
+    rp.add_argument("-l", "--lb", type=int, default=1, choices=(0, 1, 2))
+    rp.add_argument("--rate", type=float, default=None,
+                    help="measured node-evals/s to compare to the ceiling")
     args = ap.parse_args(argv)
     if args.platform:
         # Env vars alone are read too early (the environment preloads jax
@@ -227,11 +243,22 @@ def main(argv=None) -> int:
         import jax
         os.environ["JAX_PLATFORMS"] = args.platform
         jax.config.update("jax_platforms", args.platform)
+    if args.multihost:
+        import jax
+        jax.distributed.initialize()
     if args.cmd == "pfsp":
         return run_pfsp(args)
     if args.cmd == "devices":
         from .utils.device_info import print_device_info
         print_device_info()
+        return 0
+    if args.cmd == "roofline":
+        from .problems import taillard
+        from .utils import roofline
+        jobs = taillard.nb_jobs(args.inst)
+        machines = taillard.nb_machines(args.inst)
+        print(roofline.report(args.lb, jobs, machines,
+                              measured_rate=args.rate))
         return 0
     return run_nqueens(args)
 

@@ -25,10 +25,33 @@ import numpy as np
 from .device import SearchState
 
 
+POOL_FIELDS = ("prmu", "depth", "aux")
+
+
 def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
-    """Snapshot a search state (single-device or stacked distributed)."""
-    arrays = {f: np.asarray(x) for f, x in zip(SearchState._fields, state)}
+    """Snapshot a search state (single-device or stacked distributed).
+
+    Only the live pool rows (below the cursor) are fetched and written —
+    rows above the cursor are garbage by the engine invariant, and a
+    production pool is orders of magnitude larger than its live region
+    (fetching + compressing the full arrays made checkpoints cost more
+    than the segments they protected). The declared capacity is kept in
+    the file so load() re-homes the rows into an identical pool.
+    """
+    sizes = np.atleast_1d(np.asarray(state.size))
+    n = int(sizes.max())
+    arrays = {}
+    for f, x in zip(SearchState._fields, state):
+        if f == "depth":
+            x = x[..., :n]               # row axis is last
+        elif f in POOL_FIELDS:
+            x = x[..., :n, :]            # (/, row, feature)
+        arrays[f] = np.asarray(x)
+    arrays["meta_capacity"] = np.asarray(state.prmu.shape[-2])
     if meta:
+        if "capacity" in meta:
+            raise ValueError("meta key 'capacity' is reserved for the "
+                             "pool re-home size")
         for k, v in meta.items():
             arrays[f"meta_{k}"] = np.asarray(v)
     path = pathlib.Path(path)
@@ -46,6 +69,19 @@ def load(path: str | pathlib.Path,
     with np.load(pathlib.Path(path)) as z:
         arrays = {f: z[f] for f in SearchState._fields if f in z.files}
         meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    if "capacity" in meta:
+        # live-row snapshot: re-home into the declared capacity
+        capacity = int(meta.pop("capacity"))
+        for f in POOL_FIELDS:
+            if f not in arrays:
+                continue
+            x = arrays[f]
+            row_ax = x.ndim - 1 if f == "depth" else x.ndim - 2
+            pad = capacity - x.shape[row_ax]
+            if pad > 0:
+                widths = [(0, 0)] * x.ndim
+                widths[row_ax] = (0, pad)
+                arrays[f] = np.pad(x, widths)
     if "aux" not in arrays:
         if p_times is None:
             raise ValueError(

@@ -273,6 +273,67 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
     )
 
 
+def _fetch(x) -> np.ndarray:
+    """Bring a possibly globally-sharded per-device array to every host.
+
+    Single-controller (the normal case): a plain fetch. Multi-controller
+    (--multihost): the output spans non-addressable devices, so gather it
+    with multihost_utils (every process ends up with the full (D,) array,
+    matching the reference's stats Gather-to-rank-0, dist:817-832, except
+    every rank gets the totals)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(
+            x, tiled=False)).reshape(-1)
+    return np.asarray(x)
+
+
+def _to_mesh(mesh, spec_leaf, x):
+    """Commit one host-built state leaf to the mesh.
+
+    Multi-controller JAX rejects plain host arrays as jit inputs over a
+    global mesh; every process holds the identical global value (the
+    warm-up is replicated, like the reference's step 1 on every rank,
+    dist:198-205), so build the global array from per-shard callbacks."""
+    if jax.process_count() > 1:
+        from jax.sharding import NamedSharding
+        sharding = NamedSharding(mesh, spec_leaf)
+        return jax.make_array_from_callback(
+            np.shape(x), sharding, lambda idx: np.asarray(x)[idx])
+    return x
+
+
+def run_with_retry(mesh, tables, make_local_step, frontier: Frontier,
+                   capacity: int, chunk: int, jobs: int, init_best: int,
+                   balance_period: int, transfer_cap: int,
+                   min_transfer: int, max_rounds: int | None,
+                   limit_fn) -> SearchState:
+    """Seed the mesh from a frontier and run the SPMD loop, growing the
+    pool capacity and retrying on overflow (shared by the PFSP and
+    N-Queens distributed engines).
+
+    `limit_fn(capacity)` is the per-worker usable-row bound."""
+    # a stripe must fit under the usable-row limit: pre-grow rather than
+    # fail seeding (the graceful path the overflow retry provides mid-run)
+    stripe = -(-max(len(frontier.depth), 1) // mesh.devices.size)
+    while limit_fn(capacity) < stripe:
+        capacity *= 2
+
+    spec_state = tuple(P(AX) for _ in SearchState._fields)
+    while True:
+        run = build_dist_loop(mesh, tables, make_local_step, balance_period,
+                              transfer_cap, min_transfer, max_rounds,
+                              limit=limit_fn(capacity))
+        state = _shard_frontier(frontier, mesh.devices.size, capacity, jobs,
+                                init_best, limit=limit_fn(capacity))
+        state = tuple(_to_mesh(mesh, s, x)
+                      for s, x in zip(spec_state, state))
+        out = SearchState(*run(tables, *state))
+        if not bool(_fetch(out.overflow).any()):
+            return out
+        capacity *= 2
+
+
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            n_devices: int | None = None, chunk: int = 64,
            capacity: int = 1 << 17, balance_period: int = 4,
@@ -297,38 +358,27 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     def make_local_step(t):
         return functools.partial(step, t, lb_kind, chunk)
 
-    # a stripe must fit under the usable-row limit: pre-grow rather than
-    # fail seeding (the graceful path the overflow retry provides mid-run)
-    stripe = -(-max(len(fr.depth), 1) // n_dev)
-    while device_row_limit(capacity, chunk, jobs) < stripe:
-        capacity *= 2
+    out = run_with_retry(
+        mesh, tables, make_local_step, fr, capacity, chunk, jobs, init_best,
+        balance_period, transfer_cap, min_transfer, max_rounds,
+        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs))
 
-    while True:
-        run = build_dist_loop(mesh, tables, make_local_step, balance_period,
-                              transfer_cap, min_transfer, max_rounds,
-                              limit=device_row_limit(capacity, chunk, jobs))
-        state = _shard_frontier(fr, n_dev, capacity, jobs, init_best,
-                                limit=device_row_limit(capacity, chunk, jobs))
-        out = SearchState(*run(tables, *state))
-        if not bool(np.asarray(out.overflow).any()):
-            break
-        capacity *= 2
-
-    tree_dev = np.asarray(out.tree)
-    sol_dev = np.asarray(out.sol)
+    tree_dev = _fetch(out.tree)
+    sol_dev = _fetch(out.sol)
+    sizes = _fetch(out.size)
     return DistResult(
         explored_tree=int(tree_dev.sum()) + fr.tree,
         explored_sol=int(sol_dev.sum()) + fr.sol,
-        best=int(np.asarray(out.best).min()),
+        best=int(_fetch(out.best).min()),
         per_device={
             "tree": tree_dev, "sol": sol_dev,
-            "iters": np.asarray(out.iters),
-            "evals": np.asarray(out.evals),
-            "sent": np.asarray(out.sent),
-            "recv": np.asarray(out.recv),
-            "steals": np.asarray(out.steals),
-            "final_size": np.asarray(out.size),
+            "iters": _fetch(out.iters),
+            "evals": _fetch(out.evals),
+            "sent": _fetch(out.sent),
+            "recv": _fetch(out.recv),
+            "steals": _fetch(out.steals),
+            "final_size": sizes,
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,
-        complete=int(np.asarray(out.size).sum()) == 0,
+        complete=int(sizes.sum()) == 0,
     )

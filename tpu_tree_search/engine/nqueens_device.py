@@ -169,23 +169,13 @@ def search_distributed(n: int, g: int = 1, n_devices: int | None = None,
     def make_local_step(_tables):
         return functools.partial(nq_step, n, g, chunk)
 
-    stripe = -(-max(len(fr.depth), 1) // n_dev)
-    while row_limit(capacity, chunk, n) < stripe:
-        capacity *= 2
-
-    while True:
-        loop = dist.build_dist_loop(mesh, (), make_local_step, balance_period,
-                                    transfer_cap=4 * chunk,
-                                    min_transfer=2 * chunk,
-                                    limit=row_limit(capacity, chunk, n))
-        state = dist._shard_frontier(fr, n_dev, capacity, n, 2**31 - 1,
-                                     limit=row_limit(capacity, chunk, n))
-        out = SearchState(*loop((), *state))
-        if not bool(np.asarray(out.overflow).any()):
-            break
-        capacity *= 2
+    out = dist.run_with_retry(
+        mesh, (), make_local_step, fr, capacity, chunk, n,
+        init_best=2**31 - 1, balance_period=balance_period,
+        transfer_cap=4 * chunk, min_transfer=2 * chunk, max_rounds=None,
+        limit_fn=lambda cap: row_limit(cap, chunk, n))
     return NQResult(
-        explored_tree=int(np.asarray(out.tree).sum()) + fr.tree,
-        explored_sol=int(np.asarray(out.sol).sum()) + fr.sol,
-        iters=int(np.asarray(out.iters).max()),
+        explored_tree=int(dist._fetch(out.tree).sum()) + fr.tree,
+        explored_sol=int(dist._fetch(out.sol).sum()) + fr.sol,
+        iters=int(dist._fetch(out.iters).max()),
     )

@@ -1,0 +1,136 @@
+"""Shared experiment-analysis helpers for the `data/` scripts.
+
+The reference ships six pandas analysis scripts over its three CSV
+schemas (reference: pfsp/data/multigpu-speedup.py:29-66,
+multigpu-boxplot.py, multigpu-stats-analysis.py:43-70,
+dist-multigpu-speedup-boxplot.py, dist-multigpu-comparison.py:17-23,
+dist-multigpu-DWS.py:30-60). This module centralizes the parsing those
+scripts share — the quoted "[a,b,c]" per-PU array cells, speedup tables,
+work-stealing summaries — against the schema-compatible CSVs written by
+`utils/csv_stats.py`.
+"""
+
+from __future__ import annotations
+
+import csv
+from collections import defaultdict
+
+import numpy as np
+
+from .stats import BoxplotStats, compute_boxplot_stats
+
+
+def parse_array_cell(cell: str) -> np.ndarray:
+    """Decode the reference's '[a,b,c]' quoted array cell
+    (written by PFSP_statistic.c:7-30 / csv_stats._fmt_*_array)."""
+    body = cell.strip().strip('"').strip()
+    if body.startswith("["):
+        body = body[1:-1]
+    if not body:
+        return np.zeros(0)
+    return np.asarray([float(x) for x in body.split(",")])
+
+
+def read_rows(path: str) -> list[dict]:
+    """Read one of the experiment CSVs into dicts; array cells decoded."""
+    out = []
+    with open(path) as f:
+        for row in csv.DictReader(f):
+            rec = {}
+            for k, v in row.items():
+                if v is None:
+                    continue
+                v = v.strip()
+                if v.startswith('"[') or v.startswith("["):
+                    rec[k] = parse_array_cell(v)
+                else:
+                    try:
+                        rec[k] = float(v) if "." in v else int(v)
+                    except ValueError:
+                        rec[k] = v
+            out.append(rec)
+    return out
+
+
+def times_by_key(rows: list[dict], key_fields: tuple[str, ...],
+                 time_field: str = "total_time") -> dict[tuple, list[float]]:
+    """Group run times by a key (instance, PU count, ...) across
+    repetitions — the groupby all the reference scripts start with."""
+    groups: dict[tuple, list[float]] = defaultdict(list)
+    for r in rows:
+        key = tuple(r.get(f) for f in key_fields)
+        groups[key].append(float(r[time_field]))
+    return dict(groups)
+
+
+def speedup_table(rows: list[dict], scale_field: str,
+                  baseline_value) -> dict[tuple, dict]:
+    """Median-time speedup of every (instance, scale) point vs the
+    baseline scale (reference: multigpu-speedup.py:36-66 computes this
+    vs the 1-GPU run with the PU->GPU map {4:1, 8:2, 16:4, 32:8};
+    a TPU 'processing unit' is a mesh device, so the scale field is
+    used directly)."""
+    groups = times_by_key(rows, ("instance_id", scale_field))
+    med = {k: float(np.median(v)) for k, v in groups.items()}
+    out: dict[tuple, dict] = {}
+    for (inst, scale), t in sorted(med.items()):
+        base = med.get((inst, baseline_value))
+        out[(inst, scale)] = {
+            "median_time": t,
+            "speedup": (base / t) if base else None,
+            "efficiency": (base / t / (scale / baseline_value))
+            if base and scale else None,
+        }
+    return out
+
+
+def boxplot_by(rows: list[dict], key_fields: tuple[str, ...],
+               time_field: str = "total_time") -> dict[tuple, BoxplotStats]:
+    """Boxplot stats of run times per key (reference:
+    multigpu-boxplot.py / dist-multigpu-speedup-boxplot.py; the math is
+    the reference's own util.c toolkit, see utils/stats.py)."""
+    return {k: compute_boxplot_stats(v)
+            for k, v in times_by_key(rows, key_fields, time_field).items()}
+
+
+def steal_summary(rows: list[dict]) -> list[dict]:
+    """Work-stealing / load-balance success accounting per run
+    (reference: dist-multigpu-DWS.py:30-60 sums WS0/WS1 successes per
+    rank; here `steals` = balance rounds that delivered nodes and the
+    dist column `all_dist_load_bal` = nodes received)."""
+    out = []
+    for r in rows:
+        steals = r.get("all_steals_gpu", r.get("steals_gpu"))
+        recv = r.get("all_dist_load_bal")
+        rec = {
+            "instance_id": r.get("instance_id"),
+            "devices": r.get("comm_size", r.get("D")),
+            "total_time": r.get("total_time"),
+            "steal_rounds": (float(np.sum(steals))
+                             if steals is not None else None),
+            "nodes_received": (float(np.sum(recv))
+                               if recv is not None else None),
+        }
+        out.append(rec)
+    return out
+
+
+def per_pu_breakdown(rows: list[dict], array_fields: tuple[str, ...]) \
+        -> list[dict]:
+    """Per-PU min/median/max of the requested array columns
+    (reference: multigpu-stats-analysis.py:43-70 does this for the
+    per-thread time-breakdown columns)."""
+    out = []
+    for r in rows:
+        rec = {"instance_id": r.get("instance_id"),
+               "devices": r.get("comm_size", r.get("D"))}
+        for f in array_fields:
+            arr = r.get(f)
+            if arr is None or np.size(arr) == 0:
+                continue
+            rec[f] = {"min": float(np.min(arr)),
+                      "median": float(np.median(arr)),
+                      "max": float(np.max(arr)),
+                      "sum": float(np.sum(arr))}
+        out.append(rec)
+    return out

@@ -1,0 +1,115 @@
+"""Analytic roofline model for the bound kernels.
+
+TPU re-expression of the reference's per-invocation FLOP/byte model
+(reference: pfsp/lib/PFSP_gpu_lib.cu:213-267 — `flop_lb1`, `flop_lb2`,
+`bytes_per_inv_lb1`, `bytes_per_inv_lb2`, `P_of`). The reference flagged
+its model TODO/unused; here it is wired to the live engine so a bench run
+can report arithmetic intensity and the roofline-implied ceiling next to
+the measured rate.
+
+Op counts follow the reference's accounting style (one add and one max of
+the DP chain both count as one "flop"-equivalent integer op):
+
+- LB1 per child (the engine's incremental form): the `add_forward` chain
+  into the child front is 2M ops (max+add per machine), the remain update
+  is M subtracts, and `machine_bound_from_parts` is ~3M ops
+  (add, max, max per machine) — c_bound_simple.c:31-38, 126-141.
+- LB1_d per child: `add_front_and_bound` is ~5 ops per machine
+  (c_bound_simple.c:218-244).
+- LB2 per child: the Johnson sweep over all P = M(M-1)/2 machine pairs
+  costs ~5 ops per (pair, job) plus the 2M-op child-front chain
+  (c_bound_johnson.c:190-237).
+
+Bytes per invocation count the pool-row traffic the engine actually
+moves per child slot (pop + push of [prmu | depth | front | remain]).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# v5e ballpark peaks (per chip). The model only needs orders of
+# magnitude: it classifies kernels as compute- vs bandwidth-bound and
+# bounds the achievable node-eval rate.
+DEFAULT_PEAK_VECTOR_OPS = 4.0e13   # int/f32 elementwise ops/s (VPU+MXU)
+DEFAULT_PEAK_HBM_BYTES = 8.0e11    # HBM bytes/s
+
+
+def pairs_of(machines: int) -> int:
+    """Number of two-machine pairs (reference: P_of, PFSP_gpu_lib.cu:262)."""
+    return machines * (machines - 1) // 2
+
+
+def flops_per_child(lb_kind: int, jobs: int, machines: int) -> float:
+    """Integer-op count to bound one child (reference: flop_lb1/flop_lb2,
+    PFSP_gpu_lib.cu:213-233, restated for the incremental TPU kernels)."""
+    m = machines
+    if lb_kind == 0:      # LB1_d: add_front_and_bound
+        return 5.0 * m
+    if lb_kind == 1:      # LB1: child-front chain + remain + combine
+        return 2.0 * m + m + 3.0 * m
+    if lb_kind == 2:      # LB2: child-front chain + all-pairs Johnson sweep
+        return 2.0 * m + 5.0 * jobs * pairs_of(m) + 2.0 * pairs_of(m)
+    raise ValueError(f"unknown lb_kind {lb_kind}")
+
+
+def bytes_per_child(lb_kind: int, jobs: int, machines: int) -> float:
+    """Pool-row HBM traffic per child slot (reference: bytes_per_inv_*,
+    PFSP_gpu_lib.cu:236-259). A pushed child writes its permutation
+    (int16), depth (int16) and [front | remain] tables (2M int32); a pop
+    re-reads them. Amortized per dense child slot."""
+    row = 2 * jobs + 2 + 4 * 2 * machines
+    # pop read + push write (+ the compaction pass reads and rewrites the
+    # row once more)
+    return 3.0 * row
+
+
+@dataclasses.dataclass
+class RooflinePoint:
+    lb_kind: int
+    jobs: int
+    machines: int
+    flops_per_child: float
+    bytes_per_child: float
+    intensity: float                 # ops per HBM byte
+    bound_compute: float             # children/s ceiling, compute roof
+    bound_memory: float              # children/s ceiling, bandwidth roof
+    bound: float                     # min of the two
+
+    @property
+    def regime(self) -> str:
+        return ("compute-bound" if self.bound_compute < self.bound_memory
+                else "bandwidth-bound")
+
+
+def analyze(lb_kind: int, jobs: int, machines: int,
+            peak_ops: float = DEFAULT_PEAK_VECTOR_OPS,
+            peak_bytes: float = DEFAULT_PEAK_HBM_BYTES) -> RooflinePoint:
+    """Roofline ceiling for one (bound, instance-class) point."""
+    f = flops_per_child(lb_kind, jobs, machines)
+    b = bytes_per_child(lb_kind, jobs, machines)
+    bc = peak_ops / f
+    bm = peak_bytes / b
+    return RooflinePoint(
+        lb_kind=lb_kind, jobs=jobs, machines=machines,
+        flops_per_child=f, bytes_per_child=b, intensity=f / b,
+        bound_compute=bc, bound_memory=bm, bound=min(bc, bm),
+    )
+
+
+def report(lb_kind: int, jobs: int, machines: int,
+           measured_rate: float | None = None) -> str:
+    """Human-readable roofline summary (optionally vs a measured rate)."""
+    pt = analyze(lb_kind, jobs, machines)
+    lines = [
+        f"roofline lb{lb_kind} ({jobs} jobs x {machines} machines): "
+        f"{pt.flops_per_child:.0f} ops/child, {pt.bytes_per_child:.0f} "
+        f"B/child, intensity {pt.intensity:.2f} ops/B -> {pt.regime}",
+        f"  ceiling: {pt.bound:.3e} children/s "
+        f"(compute roof {pt.bound_compute:.3e}, "
+        f"memory roof {pt.bound_memory:.3e})",
+    ]
+    if measured_rate is not None:
+        lines.append(f"  measured: {measured_rate:.3e} children/s "
+                     f"({measured_rate / pt.bound:.1%} of ceiling)")
+    return "\n".join(lines)

@@ -38,8 +38,12 @@ def median_sorted(v: np.ndarray) -> float:
 
 def quartiles_sorted(v: np.ndarray) -> tuple[float, float]:
     """Tukey hinges: median of lower/upper half, halves excluding the
-    middle element for odd n (the reference's convention, util.c:128-145)."""
+    middle element for odd n (the reference's convention, util.c:128-145).
+    A single sample is its own hinge (the reference never hits n == 1;
+    the analysis scripts do, for unreplicated runs)."""
     n = len(v)
+    if n == 1:
+        return float(v[0]), float(v[0])
     half = n // 2
     lower = v[:half]
     upper = v[half + (n % 2):]
