@@ -27,7 +27,7 @@ def test_save_load_roundtrip(tmp_path):
     for f, a, b in zip(state._fields, state, restored):  # rows are garbage
         a, b = np.asarray(a), np.asarray(b)
         if f in checkpoint.POOL_FIELDS:
-            a, b = a[:n], b[:n]
+            a, b = a[..., :n], b[..., :n]
         np.testing.assert_array_equal(a, b)
     assert restored.prmu.shape == state.prmu.shape  # capacity re-homed
 
@@ -147,8 +147,10 @@ def test_load_pre_aux_checkpoint(tmp_path):
     inst, opt, tables = _setup()
     state = device.init_state(inst.jobs, 1 << 10, opt, p_times=inst.p_times)
     state = device.run(tables, state, 1, 8, max_iters=4)
+    # legacy files were row-major full-pool snapshots without aux or meta
     arrays = {f: np.asarray(x) for f, x in zip(state._fields, state)
               if f != "aux"}
+    arrays["prmu"] = arrays["prmu"].T.copy()
     np.savez_compressed(tmp_path / "old.npz", **arrays)
 
     with pytest.raises(ValueError, match="pre-aux"):
@@ -157,8 +159,8 @@ def test_load_pre_aux_checkpoint(tmp_path):
     restored, _ = checkpoint.load(tmp_path / "old.npz",
                                   p_times=inst.p_times)
     n = int(state.size)   # rows above the cursor are garbage, not compared
-    np.testing.assert_array_equal(np.asarray(restored.aux)[:n],
-                                  np.asarray(state.aux)[:n])
+    np.testing.assert_array_equal(np.asarray(restored.aux)[:, :n],
+                                  np.asarray(state.aux)[:, :n])
     final = device.run(tables, restored, 1, 8)
     want = seq.pfsp_search(inst, lb=1, init_ub=opt)
     assert (int(final.tree), int(final.sol), int(final.best)) == \

@@ -42,12 +42,11 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     n = int(sizes.max())
     arrays = {}
     for f, x in zip(SearchState._fields, state):
-        if f == "depth":
-            x = x[..., :n]               # row axis is last
-        elif f in POOL_FIELDS:
-            x = x[..., :n, :]            # (/, row, feature)
+        if f in POOL_FIELDS:
+            x = x[..., :n]               # feature-major: row axis is last
         arrays[f] = np.asarray(x)
-    arrays["meta_capacity"] = np.asarray(state.prmu.shape[-2])
+    arrays["meta_capacity"] = np.asarray(state.prmu.shape[-1])
+    arrays["meta_pool_layout"] = np.asarray(1)   # 1 = feature-major
     if meta:
         if "capacity" in meta:
             raise ValueError("meta key 'capacity' is reserved for the "
@@ -69,6 +68,18 @@ def load(path: str | pathlib.Path,
     with np.load(pathlib.Path(path)) as z:
         arrays = {f: z[f] for f in SearchState._fields if f in z.files}
         meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    feature_major = bool(meta.pop("pool_layout", 0))
+    if not feature_major:
+        # legacy row-major snapshot: transpose pool matrices on load; a
+        # legacy aux held [front | remain] — the pool now carries only
+        # front (remain is reconstructed in-kernel), so keep the first
+        # half of its rows
+        for f in ("prmu", "aux"):
+            if f in arrays:
+                arrays[f] = np.swapaxes(arrays[f], -1, -2).copy()
+        if "aux" in arrays and arrays["aux"].shape[-2] > 0:
+            m = arrays["aux"].shape[-2] // 2
+            arrays["aux"] = arrays["aux"][..., :m, :].copy()
     if "capacity" in meta:
         # live-row snapshot: re-home into the declared capacity
         capacity = int(meta.pop("capacity"))
@@ -76,11 +87,9 @@ def load(path: str | pathlib.Path,
             if f not in arrays:
                 continue
             x = arrays[f]
-            row_ax = x.ndim - 1 if f == "depth" else x.ndim - 2
-            pad = capacity - x.shape[row_ax]
+            pad = capacity - x.shape[-1]
             if pad > 0:
-                widths = [(0, 0)] * x.ndim
-                widths[row_ax] = (0, pad)
+                widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
                 arrays[f] = np.pad(x, widths)
     if "aux" not in arrays:
         if p_times is None:
@@ -88,15 +97,20 @@ def load(path: str | pathlib.Path,
                 f"{path} is a pre-aux checkpoint; pass p_times to load() "
                 "so the per-node pool tables can be reconstructed")
         from ..ops import reference as ref
-        prmu = arrays["prmu"]
+        prmu = arrays["prmu"]            # feature-major (/, jobs, rows)
         depth = arrays["depth"]
         size = np.atleast_1d(arrays["size"])
         stacked = prmu.ndim == 3
-        aux = np.zeros(prmu.shape[:-1] + (2 * p_times.shape[0],), np.int32)
+        m = p_times.shape[0]
+        aux = np.zeros(prmu.shape[:-2] + (m, prmu.shape[-1]), np.int32)
         for d in range(prmu.shape[0] if stacked else 1):
             n = int(size[d if stacked else 0])
-            sl = (d, slice(0, n)) if stacked else slice(0, n)
-            aux[sl] = ref.prefix_front_remain(p_times, prmu[sl], depth[sl])
+            if stacked:
+                aux[d, :, :n] = ref.prefix_front_remain(
+                    p_times, prmu[d, :, :n].T, depth[d, :n])[:, :m].T
+            else:
+                aux[:, :n] = ref.prefix_front_remain(
+                    p_times, prmu[:, :n].T, depth[:n])[:, :m].T
         arrays["aux"] = aux
     state = SearchState(*(jnp.asarray(arrays[f])
                           for f in SearchState._fields))
@@ -118,16 +132,16 @@ def grow(state: SearchState, new_capacity: int) -> SearchState:
     prmu = np.asarray(state.prmu)
     if prmu.ndim != 2:
         raise ValueError("grow() supports single-device states only")
-    capacity, jobs = prmu.shape
+    jobs, capacity = prmu.shape
     if new_capacity < capacity:
         raise ValueError(f"new_capacity {new_capacity} < current {capacity}")
-    new_prmu = np.zeros((new_capacity, jobs), dtype=prmu.dtype)
+    new_prmu = np.zeros((jobs, new_capacity), dtype=prmu.dtype)
     new_depth = np.zeros(new_capacity, dtype=np.asarray(state.depth).dtype)
     aux = np.asarray(state.aux)
-    new_aux = np.zeros((new_capacity, aux.shape[1]), dtype=aux.dtype)
-    new_prmu[:capacity] = prmu
+    new_aux = np.zeros((aux.shape[0], new_capacity), dtype=aux.dtype)
+    new_prmu[:, :capacity] = prmu
     new_depth[:capacity] = np.asarray(state.depth)
-    new_aux[:capacity] = aux
+    new_aux[:, :capacity] = aux
     return state._replace(prmu=jnp.asarray(new_prmu),
                           depth=jnp.asarray(new_depth),
                           aux=jnp.asarray(new_aux),

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import batched, reference as ref
+from ..ops import batched, pallas_expand, reference as ref
 from ..ops.batched import BoundTables
 
 I32_MAX = jnp.int32(2**31 - 1)
@@ -54,14 +54,21 @@ def row_limit(capacity: int, chunk: int, jobs: int) -> int:
 
 
 class SearchState(NamedTuple):
-    """Carried through the `lax.while_loop`; all arrays device-resident."""
+    """Carried through the `lax.while_loop`; all arrays device-resident.
 
-    prmu: jax.Array      # (capacity, jobs) int16
+    Pool arrays are FEATURE-MAJOR — the row (node) axis is last, so it
+    rides the 128-wide vector lanes. Row-major `(capacity, jobs)` pools
+    put jobs~20 on the lanes (84% waste) and force layout conversions
+    around every push/pop; feature-major matches the expand kernel's
+    native layout (ops/pallas_expand.py) end to end."""
+
+    prmu: jax.Array      # (jobs, capacity) int16
     depth: jax.Array     # (capacity,) int16
-    aux: jax.Array       # (capacity, A) int32 per-node tables; PFSP stores
-                         # [front | remain] (A = 2*machines) so bounds never
-                         # rescan the prefix; problems without per-node
-                         # tables (N-Queens) use A = 0
+    aux: jax.Array       # (A, capacity) int32 per-node tables; PFSP stores
+                         # the node's machine-completion vector `front`
+                         # (A = machines) so bounds never rescan the
+                         # prefix; problems without per-node tables
+                         # (N-Queens) use A = 0
     size: jax.Array      # int32 live-row cursor
     best: jax.Array      # int32 incumbent makespan
     tree: jax.Array      # int64 explored (= pushed) internal nodes
@@ -91,15 +98,16 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     n = prmu0.shape[0]
     assert n <= capacity
 
-    prmu = np.zeros((capacity, jobs), dtype=np.int16)
+    prmu = np.zeros((jobs, capacity), dtype=np.int16)
     depth = np.zeros(capacity, dtype=np.int16)
-    prmu[:n] = prmu0
+    prmu[:, :n] = prmu0.T
     depth[:n] = depth0
     if p_times is not None:
-        aux = np.zeros((capacity, 2 * p_times.shape[0]), dtype=np.int32)
-        aux[:n] = ref.prefix_front_remain(p_times, prmu0, depth0)
+        m = p_times.shape[0]
+        aux = np.zeros((m, capacity), dtype=np.int32)
+        aux[:, :n] = ref.prefix_front_remain(p_times, prmu0, depth0)[:, :m].T
     else:
-        aux = np.zeros((capacity, 0), dtype=np.int32)
+        aux = np.zeros((0, capacity), dtype=np.int32)
     best = 2**31 - 1 if init_ub is None else int(init_ub)
     return SearchState(
         prmu=jnp.asarray(prmu),
@@ -141,18 +149,27 @@ def make_children(prmu: jax.Array, depth: jax.Array) -> jax.Array:
     return child.astype(jnp.int16)
 
 
+def _col_major(x, G: int, J: int, TB: int):
+    """(1, B) per-parent row -> (1, N) per-child-slot row in the expand
+    kernel's column order (c = (g*J + i)*TB + b)."""
+    return jnp.broadcast_to(x.reshape(G, 1, TB), (G, J, TB)).reshape(1, -1)
+
+
 def step(tables: BoundTables, lb_kind: int, chunk: int,
-         state: SearchState) -> SearchState:
+         state: SearchState, tile: int = 1024) -> SearchState:
     """One pop->bound->prune->branch cycle (the compiled analogue of the
     reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320)."""
-    capacity, J = state.prmu.shape
+    J, capacity = state.prmu.shape
     B = chunk
     assert capacity >= B, f"pool capacity {capacity} < chunk {B}"
     M = tables.p.shape[0]
-    assert state.aux.shape[1] == 2 * M, (
-        f"pool aux width {state.aux.shape[1]} != 2*machines {2 * M}: "
+    assert state.aux.shape[0] == M, (
+        f"pool aux width {state.aux.shape[0]} != machines {M}: "
         "seed the state with init_state(..., p_times=...) so it carries "
-        "the per-node [front | remain] tables")
+        "the per-node front tables")
+    TB = tile if B % tile == 0 else B
+    G = B // TB
+    N = B * J
 
     # --- pop up to B parents off the top (popBackBulk analogue); the pop
     # window [start, start+B) is contiguous, so dynamic_slice beats a gather
@@ -160,58 +177,47 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     start = state.size - n
     valid = jnp.arange(B) < n
     zero = jnp.zeros((), start.dtype)
-    p_prmu = jax.lax.dynamic_slice(state.prmu, (start, zero), (B, J))
+    p_prmu = jax.lax.dynamic_slice(state.prmu, (zero, start), (J, B))
     p_depth = jax.lax.dynamic_slice(state.depth, (start,), (B,)) \
         .astype(jnp.int32)
-    p_depth = jnp.where(valid, p_depth, 0)
-    p_aux = jax.lax.dynamic_slice(state.aux, (start, zero), (B, 2 * M))
-    p_front = p_aux[:, :M]
-    p_remain = p_aux[:, M:]
+    p_depth = jnp.where(valid, p_depth, 0)[None, :]            # (1, B)
+    p_aux = jax.lax.dynamic_slice(state.aux, (zero, start), (M, B))
 
-    # --- bound the dense child grid from the pooled parent tables
-    child_front, child_p = batched._child_fronts(tables, p_prmu, p_front)
-    mask = batched.child_mask(p_prmu, p_depth, valid)
-    bounds = batched.bounds_from_parts(lb_kind, tables, p_prmu, p_depth,
-                                       valid, p_front, p_remain,
-                                       child_front, child_p, mask)
+    # --- expand: children, child pool tables, bounds (Pallas on TPU)
+    children, child_aux, bounds = pallas_expand.expand(
+        tables, p_prmu, p_depth, p_aux, lb_kind=lb_kind, tile=TB)
+
+    # --- masks in the kernel's child-slot column order
+    depth_c = _col_major(p_depth, G, J, TB)                    # (1, N)
+    valid_c = _col_major(valid[None, :], G, J, TB)
+    slot_c = jnp.broadcast_to(
+        jnp.arange(J, dtype=jnp.int32)[None, :, None], (G, J, TB)
+    ).reshape(1, N)
+    mask = (slot_c >= depth_c) & valid_c
 
     # --- leaves: complete schedules; count + tighten incumbent
     # (reference: the depth==jobs branch of decompose, PFSP_lib.c:24-32)
-    is_leaf = ((p_depth + 1) == J)[:, None] & mask
+    is_leaf = ((depth_c + 1) == J) & mask
     sol = state.sol + is_leaf.sum(dtype=jnp.int64)
     leaf_best = jnp.where(is_leaf, bounds, I32_MAX).min()
     best = jnp.minimum(state.best, leaf_best)
 
     # --- prune + push surviving internal children
-    push = mask & ~is_leaf & (bounds < best)
-    flat_push = push.reshape(-1)
-    n_push = flat_push.sum(dtype=jnp.int32)
+    push = (mask & ~is_leaf & (bounds < best)).reshape(-1)
+    n_push = push.sum(dtype=jnp.int32)
     tree = state.tree + n_push.astype(jnp.int64)
 
-    children = make_children(p_prmu, p_depth).reshape(B * J, J)
-    # depth rides as an extra aux column: 1-D (element) gathers are far
-    # slower than row gathers on TPU, so compaction moves [front | remain |
-    # depth] in one row-gather and splits afterwards
-    child_aux = jnp.concatenate(
-        [child_front.astype(jnp.int32),
-         (p_remain[:, None, :] - child_p).astype(jnp.int32),
-         jnp.broadcast_to((p_depth + 1)[:, None, None], (B, J, 1))],
-        axis=-1,
-    ).reshape(B * J, 2 * M + 1)
-
-    # Compaction: stable-partition survivors to the front (child order
-    # preserved, so tree traversal matches the reference exactly), then
-    # write the whole B*J block contiguously at `start`. A row-wise
-    # compacting scatter here costs ~100x more than sort+block-write on
-    # TPU (scatter serializes row updates); the garbage rows past n_push
-    # land above the cursor and are never read. The top chunk*J rows of
-    # the pool are a scratch margin (see row_limit) so the block write
-    # stays in bounds even when the live region is full.
-    order = jnp.argsort(~flat_push, stable=True)
-    children = jnp.take(children, order, axis=0)
-    child_aux = jnp.take(child_aux, order, axis=0)
-    child_depth = child_aux[:, 2 * M].astype(jnp.int16)
-    child_aux = child_aux[:, :2 * M]
+    # Compaction: stable-partition surviving columns to the front, then
+    # write the whole block contiguously at `start`. A per-node
+    # compacting scatter costs ~100x more on TPU (it serializes row
+    # updates); the garbage columns past n_push land above the cursor
+    # and are never read. The top chunk*J rows of the pool are a scratch
+    # margin (see row_limit) so the block write stays in bounds even
+    # when the live region is full.
+    order = jnp.argsort(~push, stable=True)
+    children = jnp.take(children, order, axis=1)
+    child_aux = jnp.take(child_aux, order, axis=1)
+    child_depth = child_aux[M].astype(jnp.int16)
 
     limit = row_limit(capacity, B, J)
     new_size = start + n_push
@@ -225,13 +231,12 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     # resume continues the search losslessly.
     overflow = new_size > limit
     write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
-    zero = jnp.zeros((), start.dtype)
     prmu = jax.lax.dynamic_update_slice(state.prmu, children,
-                                        (write_at, zero))
+                                        (zero, write_at))
     depth = jax.lax.dynamic_update_slice(state.depth, child_depth,
                                          (write_at,))
-    aux = jax.lax.dynamic_update_slice(state.aux, child_aux,
-                                       (write_at, zero))
+    aux = jax.lax.dynamic_update_slice(state.aux, child_aux[:M],
+                                       (zero, write_at))
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     return state._replace(
         prmu=prmu,
@@ -263,7 +268,7 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
     pop+decompose). `max_iters` is a traced scalar, NOT a static argument:
     segmented drivers pass a new ceiling every segment and must hit the
     compile cache."""
-    capacity, jobs = state.prmu.shape
+    jobs, capacity = state.prmu.shape[-2:]
     if int(np.asarray(state.size).max()) > row_limit(capacity, chunk, jobs):
         # Pool already fuller than the usable limit (e.g. capacity < the
         # chunk*jobs scratch margin): report overflow without touching

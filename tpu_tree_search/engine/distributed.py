@@ -128,7 +128,7 @@ def _balance_round(s: SearchState, transfer_cap: int,
     """One collective steal-half exchange (see parallel/balance.py).
     `limit` is the usable-row bound (device.row_limit) every commit must
     respect so the engine's block writes stay in bounds."""
-    capacity, J = s.prmu.shape
+    J, capacity = s.prmu.shape
     D = jax.lax.psum(1, AX)
     sizes = jax.lax.all_gather(s.size, AX)                  # (D,)
     plan = bal.exchange_plan(sizes, transfer_cap, min_transfer)
@@ -142,34 +142,51 @@ def _balance_round(s: SearchState, transfer_cap: int,
     k = jnp.arange(transfer_cap, dtype=jnp.int32)
     rows = base + offs[:, None] + k[None, :]                # (D, cap)
     send_mask = k[None, :] < my_out[:, None]
-    rows_c = jnp.clip(rows, 0, capacity - 1)
-    buf_prmu = s.prmu[rows_c]                               # (D, cap, J)
-    buf_aux = s.aux[rows_c]                                 # (D, cap, A)
-    buf_depth = jnp.where(send_mask, s.depth[rows_c], -1)   # -1 = hole
+    rows_c = jnp.clip(rows, 0, capacity - 1).reshape(-1)    # (D*cap,)
+    buf_prmu = jnp.take(s.prmu, rows_c, axis=1)             # (J, D*cap)
+    buf_aux = jnp.take(s.aux, rows_c, axis=1)               # (A, D*cap)
+    buf_depth = jnp.where(send_mask.reshape(-1),
+                          s.depth[rows_c], -1)[None, :]     # -1 = hole
 
-    rbuf_prmu = jax.lax.all_to_all(buf_prmu, AX, 0, 0)
-    rbuf_aux = jax.lax.all_to_all(buf_aux, AX, 0, 0)
-    rbuf_depth = jax.lax.all_to_all(buf_depth, AX, 0, 0)
+    # all_to_all exchanges the per-receiver blocks (the D axis must be
+    # the split axis exactly)
+    def exchange(x):
+        rows = x.shape[0]
+        blocks = x.reshape(rows, D, transfer_cap)
+        return jax.lax.all_to_all(blocks, AX, 1, 1) \
+            .reshape(rows, D * transfer_cap)
 
-    # push received nodes (compacting scatter onto the new top)
+    rbuf_prmu = exchange(buf_prmu)
+    rbuf_aux = exchange(buf_aux)
+    rbuf_depth = exchange(buf_depth)
+
+    # push received nodes (compacting column gather + block write onto
+    # the new top, same scatter-free scheme as device.step)
     flat_depth = rbuf_depth.reshape(-1)
-    flat_prmu = rbuf_prmu.reshape(-1, J)
-    flat_aux = rbuf_aux.reshape(
-        rbuf_aux.shape[0] * rbuf_aux.shape[1], s.aux.shape[1])
     push = flat_depth >= 0
     n_push = push.sum(dtype=jnp.int32)
-    dest = jnp.where(push, base + jnp.cumsum(push, dtype=jnp.int32) - 1,
-                     capacity)
+    order = jnp.argsort(~push, stable=True)
+    recv_prmu = jnp.take(rbuf_prmu, order, axis=1)
+    recv_aux = jnp.take(rbuf_aux, order, axis=1)
+    recv_depth = jnp.take(flat_depth, order).astype(jnp.int16)
     new_size = base + n_push
+    n_recv = flat_depth.shape[0]
+    # The block write needs n_recv free columns above `base`; when it
+    # would clamp (or the cursor would pass the limit) the overflow flag
+    # aborts the round and the caller restarts with a larger pool — a
+    # distributed overflow always restarts from the frontier, so the
+    # clamped write never feeds a resumed search.
+    ovf = (base + n_recv > capacity) | (new_size > limit)
+    zero = jnp.zeros((), base.dtype)
     return s._replace(
-        prmu=s.prmu.at[dest].set(flat_prmu, mode="drop"),
-        depth=s.depth.at[dest].set(flat_depth.astype(jnp.int16), mode="drop"),
-        aux=s.aux.at[dest].set(flat_aux, mode="drop"),
-        size=new_size,
+        prmu=jax.lax.dynamic_update_slice(s.prmu, recv_prmu, (zero, base)),
+        depth=jax.lax.dynamic_update_slice(s.depth, recv_depth, (base,)),
+        aux=jax.lax.dynamic_update_slice(s.aux, recv_aux, (zero, base)),
+        size=jnp.where(ovf, s.size, new_size),
         sent=s.sent + total_out.astype(jnp.int64),
         recv=s.recv + n_push.astype(jnp.int64),
         steals=s.steals + (n_push > 0).astype(jnp.int64),
-        overflow=s.overflow | (new_size > limit),
+        overflow=s.overflow | ovf,
     )
 
 
@@ -208,7 +225,7 @@ def build_dist_loop(mesh, tables, make_local_step,
             s = jax.lax.fori_loop(0, balance_period,
                                   lambda _, x: local_step(x), s)
             s = s._replace(best=jax.lax.pmin(s.best, AX))
-            row_bound = s.prmu.shape[0] if limit is None else limit
+            row_bound = s.prmu.shape[-1] if limit is None else limit
             return _balance_round(s, transfer_cap, min_transfer, row_bound)
 
         return _expand(jax.lax.while_loop(cond, body, s))
@@ -247,19 +264,19 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
     if limit is None:
         limit = capacity
     aux_w = 0 if fr.aux is None else fr.aux.shape[1]
-    prmu = np.zeros((n_dev, capacity, jobs), np.int16)
+    prmu = np.zeros((n_dev, jobs, capacity), np.int16)
     depth = np.zeros((n_dev, capacity), np.int16)
-    aux = np.zeros((n_dev, capacity, aux_w), np.int32)
+    aux = np.zeros((n_dev, aux_w, capacity), np.int32)
     sizes = np.zeros(n_dev, np.int32)
     for d in range(n_dev):
         stripe_p = fr.prmu[d::n_dev]
         stripe_d = fr.depth[d::n_dev]
         n = len(stripe_d)
         assert n <= limit
-        prmu[d, :n] = stripe_p
+        prmu[d, :, :n] = stripe_p.T
         depth[d, :n] = stripe_d
         if aux_w:
-            aux[d, :n] = fr.aux[d::n_dev]
+            aux[d, :, :n] = fr.aux[d::n_dev].T
         sizes[d] = n
     return (
         jnp.asarray(prmu), jnp.asarray(depth), jnp.asarray(aux),
@@ -352,7 +369,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     min_transfer = min_transfer or 2 * chunk
 
     fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
-    fr.aux = ref.prefix_front_remain(p_times, fr.prmu, fr.depth)
+    fr.aux = ref.prefix_front_remain(
+        p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
     init_best = fr.best if init_ub is None else min(fr.best, int(init_ub))
 
     def make_local_step(t):

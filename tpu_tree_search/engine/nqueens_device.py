@@ -32,15 +32,19 @@ I32_MAX = jnp.int32(2**31 - 1)
 
 
 def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
-    """One pop -> safety-check -> branch cycle."""
-    capacity, N = state.prmu.shape
+    """One pop -> safety-check -> branch cycle.
+
+    The pool is feature-major (device.SearchState); the safety kernel is
+    row-major, so the popped block is transposed in and the child block
+    transposed out — at N-Queens batch sizes that cost is noise."""
+    N, capacity = state.prmu.shape
     B = chunk
 
     n_pop = jnp.minimum(state.size, B)
     start = state.size - n_pop
     valid = jnp.arange(B) < n_pop
     zero = jnp.zeros((), start.dtype)
-    board = jax.lax.dynamic_slice(state.prmu, (start, zero), (B, N))
+    board = jax.lax.dynamic_slice(state.prmu, (zero, start), (N, B)).T
     depth = jnp.where(
         valid,
         jax.lax.dynamic_slice(state.depth, (start,), (B,)).astype(jnp.int32),
@@ -62,7 +66,7 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
     # `start` (scatter-free push), route an overflowing write to the
     # scratch margin so the state stays resumable.
     order = jnp.argsort(~flat_push, stable=True)
-    children = jnp.take(children, order, axis=0)
+    children = jnp.take(children, order, axis=0).T        # (N, B*N)
     child_depth = jnp.take(child_depth, order)
 
     limit = row_limit(capacity, B, N)
@@ -74,7 +78,7 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
                            & valid[:, None]).sum(dtype=jnp.int64)
     return state._replace(
         prmu=jax.lax.dynamic_update_slice(state.prmu, children,
-                                          (write_at, zero)),
+                                          (zero, write_at)),
         depth=jax.lax.dynamic_update_slice(state.depth, child_depth,
                                            (write_at,)),
         size=keep(new_size, state.size),
@@ -100,7 +104,7 @@ def run(state: SearchState, n: int, g: int, chunk: int,
         max_iters: int | None = None) -> SearchState:
     """`max_iters` is a traced scalar (see device.run): segmented callers
     pass a new ceiling per segment without recompiling."""
-    capacity = state.prmu.shape[0]
+    capacity = state.prmu.shape[-1]
     if int(np.asarray(state.size).max()) > row_limit(capacity, chunk, n):
         # as in device.run: overflow-flag, don't touch anything
         return state._replace(overflow=jnp.asarray(True))

@@ -1,0 +1,278 @@
+"""Pallas TPU kernel for the B&B expand step: parents -> bounded children.
+
+This is the hand-scheduled replacement for the XLA elementwise pipeline in
+`ops/batched.py` (itself the TPU re-expression of the reference's CUDA
+bound kernels, pfsp/lib/bounds_gpu.cu:174-248 and PFSP_gpu_lib.cu:43-102).
+Two observations motivate hand-scheduling:
+
+1. **Lane utilization.** The natural `(batch, jobs)` arrays put jobs=20
+   on the 128-wide lane axis — 84% of every vector register wasted. The
+   kernel works feature-major: the batch rides the lanes, features ride
+   the sublanes, every register full.
+2. **Fusion boundaries.** Compiled as one XLA graph, the expand step's
+   producers/consumers force layout conversions (reshapes/copies) that
+   cost more than the math. A pallas_call is an opaque fusion barrier
+   with exactly the layouts we choose.
+
+Contract (all feature-major, `c = i*TB + b` columns within a grid tile —
+slot-major within a tile of TB parents):
+
+    expand(tables, lb_kind, prmu_T (J,B) i16, depth (1,B) i32,
+           front_T (M,B) i32)
+      -> children_T (J, B*J) i16     child permutations
+         aux_T (M+1, B*J) i32       [child front | depth+1]
+         bounds (1, B*J) i32        LB of every child slot (garbage on
+                                     masked slots — caller masks)
+
+The per-machine unscheduled work (`remain`) is reconstructed inside the
+kernel from the permutation with a masked one-hot matmul, so the pool
+only carries each node's front vector.
+
+The caller derives masks/pruning/compaction from `bounds` plus the parent
+depths; the kernel is pure expand+bound math (the reference splits this
+the same way: evaluate_gpu writes bounds[], generate_children prunes,
+PFSP_gpu_lib.cu:129-152 / PFSP_lib.h:51-95).
+
+On non-TPU backends the same math runs as the `expand_xla` fallback
+(also used for LB2 until its pair-sweep kernel lands).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .batched import BoundTables
+
+I32_MAX = jnp.int32(2**31 - 1)
+
+
+def _tile_lanes(x: jax.Array, reps: int) -> jax.Array:
+    """(R, T) -> (R, reps*T) by concatenation along lanes (jnp.tile)."""
+    return jnp.concatenate([x] * reps, axis=1)
+
+
+
+
+def _expand_kernel(lb_kind: int, J: int, M: int, TB: int,
+                   p_ref, tails_ref, prmu_ref, depth_ref, front_ref,
+                   children_ref, aux_ref, bounds_ref):
+    """One tile: TB parents -> J*TB dense child slots (slot-major)."""
+    N = J * TB
+    prmu = prmu_ref[:].astype(jnp.int32)          # (J, TB)
+    depth = depth_ref[:]                          # (1, TB)
+
+    # --- flat views over the child axis: column c = i*TB + b
+    prmu_flat = prmu.reshape(1, N)                # value prmu[i, b] at c
+    depth_flat = _tile_lanes(depth, J)            # depth[b] at c
+
+    # --- child processing times via one-hot matmul on the MXU:
+    # child_p[k, c] = p[k, prmu_flat[c]]
+    onehot = (prmu_flat == jax.lax.broadcasted_iota(
+        jnp.int32, (J, 1), 0)).astype(jnp.float32)             # (J, N)
+    child_p = jax.lax.dot_general(
+        p_ref[:], onehot, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,   # default rounds via bf16,
+        preferred_element_type=jnp.float32,    # corrupting p_times > 256
+    ).astype(jnp.int32)                                        # (M, N)
+
+    # --- parent remain (unscheduled work per machine) reconstructed from
+    # the permutation: remain[k, b] = sum_{i >= depth_b} p[k, prmu[i, b]]
+    # as one masked one-hot matmul — the pool does not store remain (it
+    # would double the aux traffic through compaction; the reference
+    # recomputes it per bound too, c_bound_simple.c:108-124)
+    iota_v = jax.lax.broadcasted_iota(jnp.int32, (J, 1), 0)    # values
+    mh = jnp.zeros((J, TB), jnp.float32)
+    zero_f = jnp.zeros((), jnp.float32)   # explicit f32: a python-float
+    for i in range(J):                    # literal is weak f64 under x64
+        sched = (depth <= i).astype(jnp.float32)               # (1, TB)
+        mh = mh + jnp.where(prmu[i:i + 1, :] == iota_v,
+                            sched, zero_f)                     # (J, TB)
+    remain = jax.lax.dot_general(
+        p_ref[:], mh, (((1,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)                                        # (M, TB)
+
+    # --- child front chain (add_forward, c_bound_simple.c:31-38)
+    front_rep = _tile_lanes(front_ref[:], J)      # (M, N)
+    remain_rep = _tile_lanes(remain, J)
+
+    cf = front_rep[0:1] + child_p[0:1]
+    cf_rows = [cf]
+    for k in range(1, M):
+        cf = jnp.maximum(cf, front_rep[k:k + 1]) + child_p[k:k + 1]
+        cf_rows.append(cf)
+
+    # --- children permutations: position row by position row
+    # child(i, b)[pos] = prmu[i,b] if pos==depth[b]; prmu[depth[b],b] if
+    # pos==i; else prmu[pos,b]   (prefix-swap branching, PFSP_lib.c:13-16)
+    # at_depth[b] = prmu[depth[b], b] (the job being displaced)
+    at_depth = prmu[0:1, :]
+    for pos in range(1, J):
+        at_depth = jnp.where(depth == pos, prmu[pos:pos + 1, :], at_depth)
+    # slot index i at column c = i*TB + b, as a concat of constants
+    # (NOT `lane // TB` — a python-int divisor becomes a weak i64 under
+    # x64 and mosaic's i32<->i64 convert recurses; NOT a reshaped sublane
+    # iota — mosaic fails to legalize the sublane->lane iota relayout)
+    slot_flat = jnp.concatenate(
+        [jnp.full((1, TB), i, jnp.int32) for i in range(J)], axis=1)
+    at_depth_flat = _tile_lanes(at_depth, J)
+    for pos in range(J):
+        base = _tile_lanes(prmu[pos:pos + 1, :], J)
+        row = jnp.where(depth_flat == pos, prmu_flat,
+                        jnp.where(slot_flat == pos, at_depth_flat, base))
+        children_ref[pos:pos + 1, :] = row.astype(jnp.int16)
+
+    # --- child pool tables [front | depth+1]
+    for k in range(M):
+        aux_ref[k:k + 1, :] = cf_rows[k]
+    aux_ref[M:M + 1, :] = depth_flat + 1
+
+    # --- bound chains last (write order matters to mosaic's scheduler:
+    # bounds-first failed to legalize, see module docstring)
+    if lb_kind == 1:
+        # machine_bound_from_parts on the child (c_bound_simple.c:126-141)
+        cr = remain_rep[0:1] - child_p[0:1]
+        tmp0 = cf_rows[0] + cr
+        lb = tmp0 + tails_ref[0, 0]
+        for k in range(1, M):
+            crk = remain_rep[k:k + 1] - child_p[k:k + 1]
+            tmp1 = jnp.maximum(tmp0, cf_rows[k] + crk)
+            lb = jnp.maximum(lb, tmp1 + tails_ref[0, k])
+            tmp0 = tmp1
+    else:
+        # add_front_and_bound from the parent (c_bound_simple.c:218-244)
+        lb = front_rep[0:1] + remain_rep[0:1] + tails_ref[0, 0]
+        tmp0 = front_rep[0:1] + child_p[0:1]
+        for k in range(1, M):
+            tmp1 = jnp.maximum(tmp0, front_rep[k:k + 1])
+            lb = jnp.maximum(
+                lb, tmp1 + remain_rep[k:k + 1] + tails_ref[0, k])
+            tmp0 = tmp1 + child_p[k:k + 1]
+    bounds_ref[:] = lb
+
+
+@functools.partial(jax.jit, static_argnames=("lb_kind", "tile"))
+def expand_tpu(tables: BoundTables, prmu_T, depth2, front_T,
+               lb_kind: int = 1, tile: int = 1024):
+    """Pallas path (TPU). Shapes: prmu_T (J,B) i16, depth2 (1,B) i32,
+    front_T (M,B) i32; B must be a multiple of `tile`.
+
+    One grid-free pallas_call per tile, inputs statically sliced and
+    outputs concatenated in XLA. A gridded kernel would be the natural
+    shape, but under 64-bit mode (which the package enables for its tree
+    counters) mosaic fails to legalize ANY grid index_map on this JAX
+    version — grid-free full-block kernels compile fine, and at ~20
+    fused vector ops per tile the per-call overhead is noise.
+    """
+    J, B = prmu_T.shape
+    M = front_T.shape[0]
+    TB = tile
+    assert B % TB == 0, (B, TB)
+    G = B // TB
+
+    p_f32 = tables.p.astype(jnp.float32)           # (M, J)
+    tails = tables.min_tails.reshape(1, M)
+
+    kernel = functools.partial(_expand_kernel, lb_kind, J, M, TB)
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 3,
+        out_shape=[
+            jax.ShapeDtypeStruct((J, J * TB), jnp.int16),
+            jax.ShapeDtypeStruct((M + 1, J * TB), jnp.int32),
+            jax.ShapeDtypeStruct((1, J * TB), jnp.int32),
+        ],
+    )
+    pieces = []
+    for g in range(G):
+        sl = slice(g * TB, (g + 1) * TB)
+        pieces.append(call(p_f32, tails, prmu_T[:, sl], depth2[:, sl],
+                           front_T[:, sl]))
+    if G == 1:
+        return pieces[0]
+    return tuple(jnp.concatenate([p[k] for p in pieces], axis=1)
+                 for k in range(3))
+
+
+def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
+               lb_kind: int = 1, tile: int | None = None):
+    """Pure-XLA fallback with the identical contract (feature-major,
+    slot-major columns with the given tile size — tile defaults to B so
+    the column order matches a single-tile kernel).
+
+    Used on CPU (tests / host debugging) and for LB2.
+    """
+    J, B = prmu_T.shape
+    M = front_T.shape[0]
+    TB = B if tile is None else tile
+    assert B % TB == 0
+    G = B // TB
+
+    from . import batched
+
+    prmu = prmu_T.T                                 # (B, J)
+    depth = depth2.reshape(B)
+    front = front_T.T
+
+    # remain reconstructed from the permutation (kernel-parity)
+    sched_mask = jnp.arange(J)[None, :] >= depth[:, None]      # (B, J)
+    onehot = (prmu[..., None].astype(jnp.int32)
+              == jnp.arange(J, dtype=jnp.int32)) & sched_mask[..., None]
+    remain = jnp.einsum("bjv,mv->bm", onehot.astype(jnp.int32),
+                        tables.p,
+                        preferred_element_type=jnp.int32)      # (B, M)
+
+    child_front, child_p = batched._child_fronts(tables, prmu, front)
+    mask = jnp.ones((B, J), bool)
+    if lb_kind == 2:
+        bounds = batched.lb2_from_parts(tables, prmu, depth, child_front,
+                                        mask)
+    elif lb_kind == 1:
+        bounds = batched.lb1_from_parts(
+            tables, child_front, remain[:, None, :] - child_p, mask)
+    else:
+        bounds = batched.lb1d_from_parts(tables, front, remain, child_p,
+                                         mask)
+
+    from ..engine.device import make_children
+    children = make_children(prmu, depth)           # (B, J, J)
+    child_aux = jnp.concatenate(
+        [child_front.astype(jnp.int32),
+         jnp.broadcast_to((depth + 1)[:, None, None], (B, J, 1))],
+        axis=-1)                                    # (B, J, M+1)
+
+    # reorder (B, J, X) -> (X, tile-slot-major columns): within each tile
+    # of TB parents, column c = i*TB + b
+    def to_cols(x):                                 # (B, J, X) -> (X, B*J)
+        x = x.reshape(G, TB, J, x.shape[-1])
+        x = x.transpose(3, 0, 2, 1)                 # (X, G, J, TB)
+        return x.reshape(x.shape[0], G * J * TB)
+
+    children_T = to_cols(children.astype(jnp.int32)).astype(jnp.int16)
+    aux_T = to_cols(child_aux)
+    bounds_row = to_cols(bounds[:, :, None]).astype(jnp.int32)
+    return children_T, aux_T, bounds_row
+
+
+MIN_PALLAS_TILE = 256   # below this mosaic rejects the lane reshapes
+
+
+def expand(tables: BoundTables, prmu_T, depth2, front_T,
+           lb_kind: int = 1, tile: int = 1024):
+    """Dispatch: Pallas on TPU for LB1/LB1_d (batches of at least
+    MIN_PALLAS_TILE), XLA otherwise."""
+    on_tpu = jax.default_backend() == "tpu"
+    B = prmu_T.shape[1]
+    eff_tile = tile if B % tile == 0 else B
+    if on_tpu and lb_kind in (0, 1) and eff_tile >= MIN_PALLAS_TILE:
+        return expand_tpu(tables, prmu_T, depth2, front_T,
+                          lb_kind=lb_kind, tile=eff_tile)
+    return expand_xla(tables, prmu_T, depth2, front_T,
+                      lb_kind=lb_kind, tile=eff_tile)

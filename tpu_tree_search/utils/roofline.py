@@ -56,9 +56,10 @@ def flops_per_child(lb_kind: int, jobs: int, machines: int) -> float:
 def bytes_per_child(lb_kind: int, jobs: int, machines: int) -> float:
     """Pool-row HBM traffic per child slot (reference: bytes_per_inv_*,
     PFSP_gpu_lib.cu:236-259). A pushed child writes its permutation
-    (int16), depth (int16) and [front | remain] tables (2M int32); a pop
-    re-reads them. Amortized per dense child slot."""
-    row = 2 * jobs + 2 + 4 * 2 * machines
+    (int16), depth (int16) and front vector (M int32; remain is
+    reconstructed in-kernel); a pop re-reads them. Amortized per dense
+    child slot."""
+    row = 2 * jobs + 2 + 4 * machines
     # pop read + push write (+ the compaction pass reads and rewrites the
     # row once more)
     return 3.0 * row
