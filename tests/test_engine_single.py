@@ -61,3 +61,21 @@ def test_max_iters_truncation():
     got = device.search(inst.p_times, lb_kind=1, init_ub=None,
                         chunk=4, capacity=1 << 12, max_iters=3)
     assert got.iters == 3
+
+
+def test_tile_partition_invariance():
+    """The expand tile size changes only the internal child-column order;
+    with a fixed UB the explored set — and so tree/sol/best — must be
+    identical across tile choices (guards the step/expand column-order
+    contract when default_tile shrinks tiles for big instances)."""
+    from tpu_tree_search.problems.pfsp import PFSPInstance
+
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=5)
+    ub = inst.brute_force_optimum()   # fixed-point UB => order-independent
+    base = device.search(inst.p_times, lb_kind=1, init_ub=ub,
+                         chunk=512, capacity=1 << 12, tile=512)
+    for tile in (256, 128):
+        out = device.search(inst.p_times, lb_kind=1, init_ub=ub,
+                            chunk=512, capacity=1 << 12, tile=tile)
+        assert (out.explored_tree, out.explored_sol, out.best) == \
+               (base.explored_tree, base.explored_sol, base.best)

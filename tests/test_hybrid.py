@@ -1,0 +1,47 @@
+"""Heterogeneous CPU+device co-processing (`-C 1`): warm-up + device loop
++ native multi-threaded host drain must reproduce the oracle exactly."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import hybrid, sequential as seq
+from tpu_tree_search.problems.pfsp import PFSPInstance
+
+native = pytest.importorskip("tpu_tree_search.native")
+try:
+    native.lib()
+except Exception:  # no toolchain in the environment
+    pytest.skip("native runtime unavailable", allow_module_level=True)
+
+
+@pytest.mark.parametrize("lb", [0, 1, 2])
+def test_hybrid_matches_oracle(lb):
+    inst = PFSPInstance.synthetic(jobs=9, machines=4, seed=3)
+    opt = inst.brute_force_optimum()
+    want = seq.pfsp_search(inst, lb=lb, init_ub=opt)
+    # small chunk + large drain threshold => a real host hand-off happens
+    res = hybrid.search(inst.p_times, lb_kind=lb, init_ub=opt,
+                        chunk=32, capacity=1 << 12, drain_min=64,
+                        host_threads=2)
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+    assert res.per_device["host_drained"][0] >= 0
+
+
+def test_hybrid_drains_on_host():
+    """On an instance whose frontier outlives the device loop the host
+    does real work, and the combined totals equal the pure-device run
+    (explored set is UB-fixed, so traversal split cannot change it)."""
+    from tpu_tree_search.engine import device
+    from tpu_tree_search.problems import taillard
+
+    p = taillard.processing_times(3)            # ta003, 20x5, tree=80062
+    opt = taillard.optimal_makespan(3)
+    want = device.search(p, lb_kind=2, init_ub=opt, chunk=256,
+                         capacity=1 << 16)
+    res = hybrid.search(p, lb_kind=2, init_ub=opt,
+                        chunk=256, capacity=1 << 16, drain_min=400,
+                        host_threads=3)
+    assert res.per_device["host_drained"][0] > 0
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)

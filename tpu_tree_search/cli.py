@@ -108,6 +108,24 @@ def run_pfsp(args) -> int:
             return 1
         tree, sol, best = int(out.tree), int(out.sol), int(out.best)
         complete = int(np.asarray(out.size).sum()) == 0
+    elif n_dev == 1 and args.C:
+        # heterogeneous co-processing (-C 1): native host warm-up + the
+        # compiled device loop while the pool feeds >= m parents (the
+        # reference's -m offload threshold) + native multi-threaded drain
+        # of the residue (reference: the CPU-worker tier and final drain
+        # of pfsp_multigpu_cuda.c)
+        from .engine import hybrid
+
+        if args.max_iters is not None:
+            print("error: --max-iters is not supported with -C 1",
+                  file=sys.stderr)
+            return 2
+        res = hybrid.search(p, lb_kind=args.lb, init_ub=init_ub,
+                            chunk=args.chunk, capacity=args.capacity,
+                            drain_min=max(args.m, 1))
+        tree, sol, best = res.explored_tree, res.explored_sol, res.best
+        complete = res.complete
+        per_device = {k: list(v) for k, v in res.per_device.items()}
     elif n_dev == 1:
         out = device.search(p, lb_kind=args.lb, init_ub=init_ub,
                             chunk=args.chunk, capacity=args.capacity,

@@ -167,7 +167,9 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         f"pool aux width {state.aux.shape[0]} != machines {M}: "
         "seed the state with init_state(..., p_times=...) so it carries "
         "the per-node front tables")
-    TB = tile if B % tile == 0 else B
+    # the tile ALSO defines the expand outputs' column order — derived
+    # through the same single function expand() uses
+    TB = pallas_expand.effective_tile(J, B, tile)
     G = B // TB
     N = B * J
 
@@ -251,18 +253,20 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         overflow=state.overflow | overflow)
 
 
-@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk"))
+@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "tile"))
 def _run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
-         max_iters: jax.Array) -> SearchState:
+         max_iters: jax.Array, drain_min: jax.Array,
+         tile: int = 1024) -> SearchState:
     def cond(s: SearchState):
-        return (s.size > 0) & ~s.overflow & (s.iters < max_iters)
+        return (s.size >= drain_min) & ~s.overflow & (s.iters < max_iters)
 
-    return jax.lax.while_loop(cond, functools.partial(step, tables, lb_kind, chunk),
-                              state)
+    body = functools.partial(step, tables, lb_kind, chunk, tile=tile)
+    return jax.lax.while_loop(cond, lambda s: body(state=s), state)
 
 
 def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
-        max_iters: int | None = None) -> SearchState:
+        max_iters: int | None = None, tile: int = 1024,
+        drain_min: int = 1) -> SearchState:
     """Run the search to exhaustion (or up to a cumulative `max_iters`) in
     one compiled loop (the analogue of pfsp_c.c:55-63's while(1)
     pop+decompose). `max_iters` is a traced scalar, NOT a static argument:
@@ -277,7 +281,8 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
     ceiling = (jnp.iinfo(state.iters.dtype).max if max_iters is None
                else max_iters)
     return _run(tables, state, lb_kind, chunk,
-                jnp.asarray(ceiling, dtype=state.iters.dtype))
+                jnp.asarray(ceiling, dtype=state.iters.dtype),
+                jnp.asarray(max(drain_min, 1), dtype=jnp.int32), tile=tile)
 
 
 class SearchResult(NamedTuple):
@@ -293,7 +298,8 @@ class SearchResult(NamedTuple):
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            chunk: int = 64, capacity: int = 1 << 18,
            max_iters: int | None = None,
-           tables: BoundTables | None = None) -> SearchResult:
+           tables: BoundTables | None = None,
+           tile: int = 1024) -> SearchResult:
     """Host entry point: build tables, run, fetch counters.
 
     Retries with doubled capacity on overflow rather than failing — the
@@ -304,7 +310,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     jobs = p_times.shape[1]
     while True:
         state = init_state(jobs, capacity, init_ub, p_times=p_times)
-        out = run(tables, state, lb_kind, chunk, max_iters)
+        out = run(tables, state, lb_kind, chunk, max_iters, tile=tile)
         if not bool(out.overflow):
             return SearchResult(
                 explored_tree=int(out.tree), explored_sol=int(out.sol),

@@ -1,0 +1,88 @@
+"""Heterogeneous CPU+TPU co-processing for the single-device engine.
+
+The reference's `-C 1` mode runs CPU worker threads next to each GPU
+manager and finishes with a serial CPU drain (pfsp_multigpu_cuda.c:61-69,
+236-263, 487-495; its device loop only pops full chunks while
+`pool.size >= m`, PFSP_lib.c:175/Pool_atom.c:154-178). The TPU analogue:
+
+1. the native C++ runtime grows the warm-up frontier (step 1),
+2. the compiled device loop explores while the pool can still feed full
+   chunks (`size >= m`, the reference's `-m` threshold),
+3. the residual pool is handed to native host threads which finish it
+   with a multi-threaded DFS sharing the incumbent through an atomic
+   (`tts_search_from` — checkBest semantics).
+
+With the UB fixed the explored set is traversal-order independent, so the
+combined counters equal the pure-device run exactly (the same invariant
+the golden-parity tests rely on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import batched, reference as ref
+from . import device, distributed
+
+
+class HybridResult(distributed.DistResult):
+    pass
+
+
+def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
+           chunk: int = 1024, capacity: int = 1 << 20,
+           drain_min: int | None = None, host_threads: int = 0,
+           tile: int = 1024):
+    """Single-chip search with host warm-up and host drain (`-C 1`).
+
+    `drain_min` (default: the chunk size) is the reference's `-m`: the
+    device loop runs while the pool can feed at least that many parents;
+    the leftovers go to the native host runtime.
+    """
+    from .. import native
+
+    jobs = p_times.shape[1]
+    tables = batched.make_tables(p_times)
+    drain_min = chunk if drain_min is None else max(1, drain_min)
+
+    # step 1: native warm-up so the device starts with full chunks
+    fr = distributed.bfs_warmup(p_times, lb_kind, init_ub,
+                                target=max(4 * chunk, 2 * drain_min))
+    best0 = fr.best if init_ub is None else min(fr.best, int(init_ub))
+
+    # step 2: compiled device loop while chunks stay full
+    while True:
+        state = device.init_state(jobs, capacity, best0,
+                                  prmu0=fr.prmu, depth0=fr.depth,
+                                  p_times=p_times)
+        out = device.run(tables, state, lb_kind, chunk, tile=tile,
+                         drain_min=drain_min)
+        if not bool(out.overflow):
+            break
+        capacity *= 2
+
+    # step 3: native drain of the residual pool (host threads)
+    n_left = int(out.size)
+    d_tree, d_sol = int(out.tree), int(out.sol)
+    best = int(out.best)
+    drained = 0
+    if n_left > 0:
+        res_prmu = np.asarray(out.prmu[:, :n_left]).T
+        res_depth = np.asarray(out.depth[:n_left])
+        h_tree, h_sol, best, drained = native.search_from(
+            p_times, res_prmu, res_depth, lb_kind=lb_kind,
+            init_ub=best, n_threads=host_threads)
+        d_tree += h_tree
+        d_sol += h_sol
+
+    return HybridResult(
+        explored_tree=d_tree + fr.tree,
+        explored_sol=d_sol + fr.sol,
+        best=best,
+        per_device={"tree": [d_tree], "sol": [d_sol],
+                    "evals": [int(out.evals)],
+                    "steals": [0], "recv": [0],
+                    "host_drained": [drained]},
+        warmup_tree=fr.tree, warmup_sol=fr.sol,
+        complete=True,
+    )

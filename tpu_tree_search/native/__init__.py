@@ -24,7 +24,7 @@ def build(force: bool = False) -> pathlib.Path:
     if force or not _LIB.exists() or _LIB.stat().st_mtime < _SRC.stat().st_mtime:
         subprocess.run(
             ["g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
-             str(_SRC), "-o", str(_LIB)],
+             "-pthread", str(_SRC), "-o", str(_LIB)],
             check=True, capture_output=True,
         )
     return _LIB
@@ -35,6 +35,7 @@ def lib() -> ctypes.CDLL:
     if _lib is None:
         handle = ctypes.CDLL(str(build()))
         handle.tts_search.restype = ctypes.c_longlong
+        handle.tts_search_from.restype = ctypes.c_longlong
         handle.tts_bfs_frontier.restype = ctypes.c_longlong
         handle.tts_nqueens.restype = ctypes.c_longlong
         _lib = handle
@@ -64,6 +65,32 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     expanded = lib().tts_search(
         p.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n, m, lb_kind,
         0 if init_ub is None else int(init_ub), ctypes.c_longlong(max_nodes),
+        ctypes.byref(tree), ctypes.byref(sol), ctypes.byref(best))
+    return int(tree.value), int(sol.value), int(best.value), int(expanded)
+
+
+def search_from(p_times: np.ndarray, prmu: np.ndarray, depth: np.ndarray,
+                lb_kind: int = 1, init_ub: int | None = None,
+                n_threads: int = 0):
+    """Multi-threaded DFS from a seed set — the heterogeneous hand-off
+    path (device residual pool -> host threads). Returns
+    (tree, sol, best, expanded)."""
+    import os
+    p = np.ascontiguousarray(p_times, dtype=np.int32)
+    m, n = p.shape
+    prmu = np.ascontiguousarray(prmu, dtype=np.int16).reshape(-1, n)
+    depth = np.ascontiguousarray(depth, dtype=np.int16).reshape(-1)
+    if n_threads <= 0:
+        n_threads = max(1, (os.cpu_count() or 2) - 1)
+    tree = ctypes.c_ulonglong()
+    sol = ctypes.c_ulonglong()
+    best = ctypes.c_int()
+    expanded = lib().tts_search_from(
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n, m, lb_kind,
+        0 if init_ub is None else int(init_ub),
+        prmu.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        depth.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        ctypes.c_longlong(prmu.shape[0]), int(n_threads),
         ctypes.byref(tree), ctypes.byref(sol), ctypes.byref(best))
     return int(tree.value), int(sol.value), int(best.value), int(expanded)
 

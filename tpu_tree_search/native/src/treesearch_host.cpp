@@ -18,9 +18,11 @@
 //   - N-Queens safety + branching (nqueens/nqueens_c.c:80-117)
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <limits>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -403,6 +405,68 @@ long long tts_bfs_frontier(const int* p, int jobs, int machines, int lbKind,
   *sol = c.sol;
   *best = c.best;
   return n;
+}
+
+// Depth-first B&B from a given seed set — the heterogeneous hand-off
+// path: the device engine pops its residual pool to the host and native
+// threads finish it (the analogue of the reference's CPU workers and
+// final CPU drain, pfsp_multigpu_cuda.c:236-263 / 487-495). Threads own
+// round-robin stripes of the seeds (roundRobin_distribution semantics)
+// and share the incumbent through an atomic (checkBest,
+// pfsp_multigpu_cuda.c:30-50). Returns expanded-node count.
+long long tts_search_from(const int* p, int jobs, int machines, int lbKind,
+                          int initUb, const int16_t* seedPrmu,
+                          const int16_t* seedDepth, long long nSeeds,
+                          int nThreads, unsigned long long* tree,
+                          unsigned long long* sol, int* best) {
+  Bounds b(p, jobs, machines);
+  if (nThreads < 1) nThreads = 1;
+  std::atomic<int> sharedBest(initUb > 0 ? initUb : kIntMax);
+  std::vector<unsigned long long> trees(nThreads, 0), sols(nThreads, 0);
+  std::vector<long long> expandedPer(nThreads, 0);
+
+  auto worker = [&](int t) {
+    SearchCounters c;
+    c.best = sharedBest.load(std::memory_order_relaxed);
+    NodeStore pool(jobs);
+    for (long long i = t; i < nSeeds; i += nThreads)
+      pool.push(seedPrmu + i * jobs, seedDepth[i]);
+    std::vector<int16_t> perm(jobs);
+    int16_t d;
+    while (pool.count > 0) {
+      // refresh + publish the incumbent (checkBest both ways)
+      int g = sharedBest.load(std::memory_order_relaxed);
+      if (g < c.best) c.best = g;
+      pool.popBack(perm.data(), &d);
+      ++expandedPer[t];
+      expandNode(b, lbKind, perm.data(), d, c, pool);
+      if (c.best < g) {
+        int cur = g;
+        while (c.best < cur &&
+               !sharedBest.compare_exchange_weak(cur, c.best)) {
+        }
+      }
+    }
+    trees[t] = c.tree;
+    sols[t] = c.sol;
+  };
+
+  std::vector<std::thread> threads;
+  for (int t = 1; t < nThreads; ++t) threads.emplace_back(worker, t);
+  worker(0);
+  for (auto& th : threads) th.join();
+
+  unsigned long long tt = 0, ss = 0;
+  long long expanded = 0;
+  for (int t = 0; t < nThreads; ++t) {
+    tt += trees[t];
+    ss += sols[t];
+    expanded += expandedPer[t];
+  }
+  *tree = tt;
+  *sol = ss;
+  *best = sharedBest.load();
+  return expanded;
 }
 
 // N-Queens backtracking (reference semantics: nqueens_c.c:99-148).

@@ -262,6 +262,20 @@ def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
 
 
 MIN_PALLAS_TILE = 256   # below this mosaic rejects the lane reshapes
+MAX_TILE_LANES = 1 << 15  # J*tile cap keeping the tile's VMEM ~10 MB
+
+
+def effective_tile(jobs: int, batch: int, tile: int = 1024) -> int:
+    """The tile expand() will actually use — THE single source of truth
+    for the output column order. Shrinks the requested tile while the
+    (jobs x tile) working set exceeds the VMEM budget (20-job instances
+    run at 1024; 50 jobs -> 512; 100 -> 256), then falls back to one
+    batch-wide tile if the batch is not a multiple. step() derives its
+    mask column order from this same function; they must never diverge.
+    """
+    while tile >= MIN_PALLAS_TILE and jobs * tile > MAX_TILE_LANES:
+        tile //= 2
+    return tile if batch % tile == 0 else batch
 
 
 def expand(tables: BoundTables, prmu_T, depth2, front_T,
@@ -269,9 +283,10 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
     """Dispatch: Pallas on TPU for LB1/LB1_d (batches of at least
     MIN_PALLAS_TILE), XLA otherwise."""
     on_tpu = jax.default_backend() == "tpu"
-    B = prmu_T.shape[1]
-    eff_tile = tile if B % tile == 0 else B
-    if on_tpu and lb_kind in (0, 1) and eff_tile >= MIN_PALLAS_TILE:
+    J, B = prmu_T.shape
+    eff_tile = effective_tile(J, B, tile)
+    if (on_tpu and lb_kind in (0, 1) and eff_tile >= MIN_PALLAS_TILE
+            and J * eff_tile <= MAX_TILE_LANES):
         return expand_tpu(tables, prmu_T, depth2, front_T,
                           lb_kind=lb_kind, tile=eff_tile)
     return expand_xla(tables, prmu_T, depth2, front_T,

@@ -18,12 +18,14 @@ class PFSPConfig:
     inst: int = 14        # -i Taillard instance id
     lb: int = 1           # -l bound: 0=lb1_d, 1=lb1, 2=lb2
     ub: int = 1           # -u 1: seed incumbent with known optimum; 0: inf
-    m: int = 25           # -m min pool before offload -> min seed/worker
+    m: int = 25           # -m min pool before offload -> min seed/worker;
+                          #    with -C 1 also the host hand-off threshold
     M: int = 50000        # -M max offload chunk -> pop-chunk ceiling
-    T: int = 5000         # -T CPU-thread chunk (no CPU co-processing tier)
+    T: int = 5000         # -T CPU-thread chunk (native drain thread batch)
     D: int = 0            # -D devices (0 = all addressable)
-    C: int = 0            # -C multicore co-processing (N/A on TPU: the VPU
-                          #    lanes are the "extra cores"; accepted, ignored)
+    C: int = 0            # -C heterogeneous co-processing: native host
+                          #    warm-up + device loop + multi-threaded
+                          #    native host drain (engine/hybrid.py)
     ws: int = 1           # -w intra-mesh balancing on/off
     L: int = 1            # -L inter-node balancing on/off (same collective
                           #    tier on TPU; ws==0 and L==0 disable balance)
