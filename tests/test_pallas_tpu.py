@@ -56,3 +56,19 @@ def test_engine_on_tpu_matches_oracle():
                         capacity=1 << 18)
     assert (out.explored_tree, out.explored_sol, out.best) == \
            (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_lb2_kernel_matches_xla_fallback():
+    """The TPU LB2 path (expand kernel for children/aux + the pair-sweep
+    kernel for bounds) must equal the XLA fallback bit-for-bit."""
+    import jax.numpy as jnp
+
+    p = taillard.processing_times(21)
+    tables = batched.make_tables(p)
+    args = _random_parents(p, 2048, seed=11)
+    eff = pallas_expand.effective_tile(20, 2048, 1024, 2)
+    t = pallas_expand.expand(tables, *args, lb_kind=2, tile=eff)
+    x = pallas_expand.expand_xla(tables, *args, lb_kind=2, tile=eff)
+    for a, b, name in zip(t, x, ("children", "aux", "bounds")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
+                                      err_msg=name)

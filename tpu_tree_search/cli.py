@@ -108,6 +108,12 @@ def run_pfsp(args) -> int:
             return 1
         tree, sol, best = int(out.tree), int(out.sol), int(out.best)
         complete = int(np.asarray(out.size).sum()) == 0
+    elif args.C and n_dev != 1:
+        print("warning: -C heterogeneous co-processing requires -D 1; "
+              "running the distributed engine without a host tier",
+              file=sys.stderr)
+        args.C = 0
+        return run_pfsp(args)
     elif n_dev == 1 and args.C:
         # heterogeneous co-processing (-C 1): native host warm-up + the
         # compiled device loop while the pool feeds >= m parents (the

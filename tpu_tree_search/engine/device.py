@@ -169,7 +169,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         "the per-node front tables")
     # the tile ALSO defines the expand outputs' column order — derived
     # through the same single function expand() uses
-    TB = pallas_expand.effective_tile(J, B, tile)
+    TB = pallas_expand.effective_tile(J, B, tile, lb_kind)
     G = B // TB
     N = B * J
 

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..ops import batched, reference as ref
+from ..ops import batched
 from . import device, distributed
 
 

@@ -201,6 +201,129 @@ def expand_tpu(tables: BoundTables, prmu_T, depth2, front_T,
                  for k in range(3))
 
 
+def lb2_cols(tables: BoundTables, sched_mask, child_front_cols):
+    """Feature-major LB2: the Johnson all-pairs sweep on (P, N) lanes.
+
+    The reference's per-child pair loop with early exit
+    (c_bound_johnson.c:211-237) becomes an unrolled J-step chain over all
+    P = M(M-1)/2 pairs at once — children on the lanes, pairs on the
+    sublanes, so every register is full (the row-major scan fallback in
+    batched.lb2_from_parts leaves most lanes idle and materializes its
+    scan carries every step).
+
+    The child-unscheduled test is one shift of a scheduled-set bitmask
+    (one int32 per child; requires jobs <= 31 — true for every 20-job
+    benchmark class), so no job-position gathers are needed.
+
+    sched_mask: (1, N) int32, bit v set iff job v is scheduled in the
+    child (parent prefix + appended job); child_front_cols: (M, N) int32.
+    Returns (1, N) int32 bounds.
+    """
+    t = tables
+    J = t.js.shape[1]
+    one = jnp.int32(1)
+
+    # pair-machine selection as one-hot matmuls (dynamic row gathers of
+    # (P, N) from (M, N) serialize on TPU; the MXU does this in microseconds)
+    M = t.p.shape[0]
+    sel0 = (t.ma0[:, None] == jnp.arange(M)).astype(jnp.float32)  # (P, M)
+    sel1 = (t.ma1[:, None] == jnp.arange(M)).astype(jnp.float32)
+    cf_f = child_front_cols.astype(jnp.float32)
+    tmp0 = jnp.dot(sel0, cf_f, precision=jax.lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32).astype(jnp.int32)
+    tmp1 = jnp.dot(sel1, cf_f, precision=jax.lax.Precision.HIGHEST,
+                   preferred_element_type=jnp.float32).astype(jnp.int32)
+    for j in range(J):
+        jsj = t.js[:, j][:, None]                       # (P, 1)
+        active = ((sched_mask >> jsj) & one) == 0       # (P, N)
+        new0 = tmp0 + t.ptm0_js[:, j][:, None]
+        new1 = jnp.maximum(tmp1, new0 + t.lag_js[:, j][:, None]) \
+            + t.ptm1_js[:, j][:, None]
+        tmp0 = jnp.where(active, new0, tmp0)
+        tmp1 = jnp.where(active, new1, tmp1)
+    back0 = jnp.take(t.min_tails, t.ma0)[:, None]       # (P, 1)
+    back1 = jnp.take(t.min_tails, t.ma1)[:, None]
+    per_pair = jnp.maximum(tmp1 + back1, tmp0 + back0)
+    return per_pair.max(axis=0, keepdims=True)          # (1, N)
+
+
+def _lb2_kernel(J: int, M: int, P: int, PB: int,
+                sel0_ref, sel1_ref, js1h_ref, pt0_ref, pt1_ref, lag_ref,
+                tails0_ref, tails1_ref, cf_ref, unsched_ref, bounds_ref):
+    """All-pairs Johnson sweep for one column tile: pairs ride the
+    sublanes in blocks of PB, children ride the lanes. Machine selection
+    and the per-step active test are one-hot matmuls on the MXU (dynamic
+    row indexing inside mosaic is either unsupported or serializes).
+
+    cf_ref (M, NT) child fronts; unsched_ref (J, NT) f32 0/1 per job;
+    tables: sel0/sel1 (P, M) f32 pair-machine one-hots, js1h (J, P, J)
+    f32 per-step job one-hots, pt0/pt1/lag (P, J) i32, tails (P, 1) i32.
+    Output bounds (1, NT) i32.
+    """
+    cf_f = cf_ref[:].astype(jnp.float32)            # (M, NT)
+    unsched = unsched_ref[:]                        # (J, NT) f32
+    hi = jax.lax.Precision.HIGHEST
+    lb = None
+    for lo in range(0, P, PB):
+        nrows = min(PB, P - lo)
+        sl = slice(lo, lo + nrows)
+        t0 = jnp.dot(sel0_ref[sl, :], cf_f, precision=hi,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+        t1 = jnp.dot(sel1_ref[sl, :], cf_f, precision=hi,
+                     preferred_element_type=jnp.float32).astype(jnp.int32)
+        for j in range(J):
+            act = jnp.dot(js1h_ref[j, sl, :], unsched, precision=hi,
+                          preferred_element_type=jnp.float32) > 0.5
+            new0 = t0 + pt0_ref[sl, j:j + 1]
+            new1 = jnp.maximum(t1, new0 + lag_ref[sl, j:j + 1]) \
+                + pt1_ref[sl, j:j + 1]
+            t0 = jnp.where(act, new0, t0)
+            t1 = jnp.where(act, new1, t1)
+        per_pair = jnp.maximum(t1 + tails1_ref[sl, :], t0 + tails0_ref[sl, :])
+        blk = jnp.max(per_pair, axis=0, keepdims=True)
+        lb = blk if lb is None else jnp.maximum(lb, blk)
+    bounds_ref[:] = lb
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
+                   tile: int = 8192):
+    """Pallas LB2 over child columns: child_front_cols (M, N) i32,
+    unsched_cols (J, N) f32 — returns (1, N) i32 bounds."""
+    M, N = child_front_cols.shape
+    J = unsched_cols.shape[0]
+    P = tables.ma0.shape[0]
+    PB = 64
+    NT = tile
+    assert N % NT == 0, (N, NT)
+
+    sel0 = (tables.ma0[:, None] == jnp.arange(M)).astype(jnp.float32)
+    sel1 = (tables.ma1[:, None] == jnp.arange(M)).astype(jnp.float32)
+    js1h = (tables.js.T[:, :, None]
+            == jnp.arange(J)).astype(jnp.float32)       # (J, P, J)
+    pt0 = tables.ptm0_js
+    pt1 = tables.ptm1_js
+    lag = tables.lag_js
+    tails0 = jnp.take(tables.min_tails, tables.ma0)[:, None]
+    tails1 = jnp.take(tables.min_tails, tables.ma1)[:, None]
+
+    kernel = functools.partial(_lb2_kernel, J, M, P, PB)
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 10,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, NT), jnp.int32),
+    )
+    pieces = []
+    for g in range(N // NT):
+        sl = slice(g * NT, (g + 1) * NT)
+        pieces.append(call(sel0, sel1, js1h, pt0, pt1, lag, tails0, tails1,
+                           child_front_cols[:, sl], unsched_cols[:, sl]))
+    if len(pieces) == 1:
+        return pieces[0]
+    return jnp.concatenate(pieces, axis=1)
+
+
 def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
                lb_kind: int = 1, tile: int | None = None):
     """Pure-XLA fallback with the identical contract (feature-major,
@@ -231,9 +354,14 @@ def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
 
     child_front, child_p = batched._child_fronts(tables, prmu, front)
     mask = jnp.ones((B, J), bool)
+    bounds = None
     if lb_kind == 2:
-        bounds = batched.lb2_from_parts(tables, prmu, depth, child_front,
-                                        mask)
+        if J > 31:
+            # bitmask fast path needs one bit per job; wide instances
+            # keep the scan-based fallback
+            bounds = batched.lb2_from_parts(tables, prmu, depth,
+                                            child_front, mask)
+        # else: computed column-major below (lb2_cols)
     elif lb_kind == 1:
         bounds = batched.lb1_from_parts(
             tables, child_front, remain[:, None, :] - child_p, mask)
@@ -257,7 +385,22 @@ def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
 
     children_T = to_cols(children.astype(jnp.int32)).astype(jnp.int16)
     aux_T = to_cols(child_aux)
-    bounds_row = to_cols(bounds[:, :, None]).astype(jnp.int32)
+    if bounds is not None:
+        bounds_row = to_cols(bounds[:, :, None]).astype(jnp.int32)
+    else:
+        # LB2 bitmask fast path on (pairs, children) lanes; aux rows
+        # [0:M] are exactly the child fronts in column order
+        M = tables.p.shape[0]
+        one = jnp.int32(1)
+        parent_mask = jnp.sum(
+            jnp.where(jnp.arange(J)[None, :] < depth[:, None],
+                      (one << prmu.astype(jnp.int32)), 0),
+            axis=1, dtype=jnp.int32)                     # (B,)
+        pm_cols = to_cols(jnp.broadcast_to(
+            parent_mask[:, None, None], (B, J, 1)))      # (1, N)
+        appended = to_cols(prmu.astype(jnp.int32)[:, :, None])
+        sched = pm_cols | (one << appended)
+        bounds_row = lb2_cols(tables, sched, aux_T[:M])
     return children_T, aux_T, bounds_row
 
 
@@ -265,29 +408,68 @@ MIN_PALLAS_TILE = 256   # below this mosaic rejects the lane reshapes
 MAX_TILE_LANES = 1 << 15  # J*tile cap keeping the tile's VMEM ~10 MB
 
 
-def effective_tile(jobs: int, batch: int, tile: int = 1024) -> int:
+def effective_tile(jobs: int, batch: int, tile: int = 1024,
+                   lb_kind: int = 1) -> int:
     """The tile expand() will actually use — THE single source of truth
     for the output column order. Shrinks the requested tile while the
     (jobs x tile) working set exceeds the VMEM budget (20-job instances
     run at 1024; 50 jobs -> 512; 100 -> 256), then falls back to one
-    batch-wide tile if the batch is not a multiple. step() derives its
-    mask column order from this same function; they must never diverge.
+    batch-wide tile if the batch is not a multiple. LB2 halves the
+    budget — its pair-sweep kernel shares the program's VMEM headroom.
+    step() derives its mask column order from this same function; they
+    must never diverge.
     """
-    while tile >= MIN_PALLAS_TILE and jobs * tile > MAX_TILE_LANES:
+    cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
+    while tile >= MIN_PALLAS_TILE and jobs * tile > cap:
         tile //= 2
     return tile if batch % tile == 0 else batch
 
 
 def expand(tables: BoundTables, prmu_T, depth2, front_T,
            lb_kind: int = 1, tile: int = 1024):
-    """Dispatch: Pallas on TPU for LB1/LB1_d (batches of at least
-    MIN_PALLAS_TILE), XLA otherwise."""
+    """Dispatch: Pallas on TPU (LB1/LB1_d directly; LB2 as the expand
+    kernel for children/aux + the pair-sweep kernel for bounds, when the
+    job count fits the scheduled-set bitmask), XLA otherwise."""
     on_tpu = jax.default_backend() == "tpu"
     J, B = prmu_T.shape
-    eff_tile = effective_tile(J, B, tile)
-    if (on_tpu and lb_kind in (0, 1) and eff_tile >= MIN_PALLAS_TILE
-            and J * eff_tile <= MAX_TILE_LANES):
+    # A tile that divides the batch is trusted as-is: step() derives it
+    # through effective_tile and builds its masks in that column order,
+    # so re-deriving here could silently diverge from the caller
+    # (kernel_ok below still gates hardware limits — an oversized trusted
+    # tile falls back to XLA, never to a different column order).
+    eff_tile = (tile if B % tile == 0
+                else effective_tile(J, B, tile, lb_kind))
+    lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
+    kernel_ok = (on_tpu and eff_tile >= MIN_PALLAS_TILE
+                 and J * eff_tile <= lane_cap)
+    if kernel_ok and lb_kind in (0, 1):
         return expand_tpu(tables, prmu_T, depth2, front_T,
                           lb_kind=lb_kind, tile=eff_tile)
+    if kernel_ok and lb_kind == 2 and J <= 31:
+        N = B * J
+        nt = N & -N                      # largest power-of-two divisor
+        nt = min(nt, 4096)
+        if nt >= MIN_PALLAS_TILE:
+            children, aux, _ = expand_tpu(tables, prmu_T, depth2, front_T,
+                                          lb_kind=1, tile=eff_tile)
+            G = B // eff_tile
+            # child-column order: c = (g*J + i)*TB + b
+            appended = prmu_T.reshape(J, G, eff_tile).transpose(1, 0, 2) \
+                .reshape(1, N).astype(jnp.int32)
+            one = jnp.int32(1)
+            pmask = jnp.sum(
+                jnp.where(jax.lax.broadcasted_iota(jnp.int32, (J, B), 0)
+                          < depth2,
+                          one << prmu_T.astype(jnp.int32), 0),
+                axis=0, dtype=jnp.int32)[None, :]          # (1, B)
+            pmask_c = jnp.broadcast_to(
+                pmask.reshape(G, 1, eff_tile), (G, J, eff_tile)
+            ).reshape(1, N)
+            sched = pmask_c | (one << appended)            # (1, N)
+            unsched = (((sched >> jnp.arange(J, dtype=jnp.int32)[:, None])
+                        & one) == 0).astype(jnp.float32)   # (J, N)
+            M = tables.p.shape[0]
+            bounds = lb2_bounds_tpu(tables, aux[:M], unsched, tile=nt)
+            return children, aux, bounds
     return expand_xla(tables, prmu_T, depth2, front_T,
                       lb_kind=lb_kind, tile=eff_tile)

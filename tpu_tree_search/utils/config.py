@@ -21,7 +21,8 @@ class PFSPConfig:
     m: int = 25           # -m min pool before offload -> min seed/worker;
                           #    with -C 1 also the host hand-off threshold
     M: int = 50000        # -M max offload chunk -> pop-chunk ceiling
-    T: int = 5000         # -T CPU-thread chunk (native drain thread batch)
+    T: int = 5000         # -T CPU-thread chunk (accepted for CLI parity;
+                          #    the native drain sizes itself from cpu_count)
     D: int = 0            # -D devices (0 = all addressable)
     C: int = 0            # -C heterogeneous co-processing: native host
                           #    warm-up + device loop + multi-threaded
