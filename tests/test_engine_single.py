@@ -79,3 +79,19 @@ def test_tile_partition_invariance():
                             chunk=512, capacity=1 << 12, tile=tile)
         assert (out.explored_tree, out.explored_sol, out.best) == \
                (base.explored_tree, base.explored_sol, base.best)
+
+
+@pytest.mark.parametrize("inst,chunk", [(31, 256), (111, 64)])
+def test_wide_instance_classes_run(inst, chunk):
+    """Every Taillard shape class compiles and searches: 50-job (adaptive
+    tile shrink) and 500-job (beyond the kernel's bitmask/lane budget,
+    XLA fallback) — the reference needs a macro.h edit + rebuild for
+    these (pfsp/README.md:52)."""
+    from tpu_tree_search.problems import taillard
+
+    p = taillard.processing_times(inst)
+    opt = taillard.optimal_makespan(inst)
+    out = device.search(p, lb_kind=1, init_ub=opt, chunk=chunk,
+                        capacity=1 << 16, max_iters=4)
+    assert out.explored_tree > 0
+    assert out.best == opt

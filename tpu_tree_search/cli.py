@@ -93,6 +93,11 @@ def run_pfsp(args) -> int:
     jobs, machines = p.shape[1], p.shape[0]
     init_ub = taillard.optimal_makespan(args.inst) if args.ub == 1 else None
     n_dev = args.D if args.D > 0 else len(jax.devices())
+    if args.C and n_dev != 1:
+        print("warning: -C heterogeneous co-processing requires -D 1; "
+              "running the distributed engine without a host tier",
+              file=sys.stderr)
+        args.C = 0
     _print_pfsp_settings(args, machines, jobs, n_dev)
 
     t0 = time.perf_counter()
@@ -108,12 +113,6 @@ def run_pfsp(args) -> int:
             return 1
         tree, sol, best = int(out.tree), int(out.sol), int(out.best)
         complete = int(np.asarray(out.size).sum()) == 0
-    elif args.C and n_dev != 1:
-        print("warning: -C heterogeneous co-processing requires -D 1; "
-              "running the distributed engine without a host tier",
-              file=sys.stderr)
-        args.C = 0
-        return run_pfsp(args)
     elif n_dev == 1 and args.C:
         # heterogeneous co-processing (-C 1): native host warm-up + the
         # compiled device loop while the pool feeds >= m parents (the

@@ -185,10 +185,6 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     p_depth = jnp.where(valid, p_depth, 0)[None, :]            # (1, B)
     p_aux = jax.lax.dynamic_slice(state.aux, (zero, start), (M, B))
 
-    # --- expand: children, child pool tables, bounds (Pallas on TPU)
-    children, child_aux, bounds = pallas_expand.expand(
-        tables, p_prmu, p_depth, p_aux, lb_kind=lb_kind, tile=TB)
-
     # --- masks in the kernel's child-slot column order
     depth_c = _col_major(p_depth, G, J, TB)                    # (1, N)
     valid_c = _col_major(valid[None, :], G, J, TB)
@@ -197,29 +193,101 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     ).reshape(1, N)
     mask = (slot_c >= depth_c) & valid_c
 
-    # --- leaves: complete schedules; count + tighten incumbent
-    # (reference: the depth==jobs branch of decompose, PFSP_lib.c:24-32)
-    is_leaf = ((depth_c + 1) == J) & mask
-    sol = state.sol + is_leaf.sum(dtype=jnp.int64)
-    leaf_best = jnp.where(is_leaf, bounds, I32_MAX).min()
-    best = jnp.minimum(state.best, leaf_best)
+    two_phase = (lb_kind == 2 and jax.default_backend() == "tpu"
+                 and J <= 31 and TB >= pallas_expand.MIN_PALLAS_TILE
+                 and TB % 128 == 0                # lane-aligned reshapes
+                 and J * TB <= pallas_expand.MAX_TILE_LANES // 2)
+    if two_phase:
+        # Two-phase LB2 (TPU): bound every child with the near-free LB1
+        # first (LB1 <= LB2, so LB1-pruning is sound and the explored
+        # set stays the exact LB2 set), compact the survivors to the
+        # front, and run the expensive pair-sweep kernel only over the
+        # smallest power-of-two prefix that covers them. At UB=opt LB1
+        # removes ~85% of the child grid, so the sweep usually runs on
+        # an eighth of the columns. The reference gets its version of
+        # this saving from the per-child early exit the vector unit
+        # cannot take (c_bound_johnson.c:231-233).
+        children, child_aux, lb1b = pallas_expand.expand(
+            tables, p_prmu, p_depth, p_aux, lb_kind=1, tile=TB)
 
-    # --- prune + push surviving internal children
-    push = (mask & ~is_leaf & (bounds < best)).reshape(-1)
-    n_push = push.sum(dtype=jnp.int32)
-    tree = state.tree + n_push.astype(jnp.int64)
+        is_leaf = ((depth_c + 1) == J) & mask
+        sol = state.sol + is_leaf.sum(dtype=jnp.int64)
+        # a complete schedule's LB1 == LB2 == its makespan
+        leaf_best = jnp.where(is_leaf, lb1b, I32_MAX).min()
+        best = jnp.minimum(state.best, leaf_best)
 
-    # Compaction: stable-partition surviving columns to the front, then
-    # write the whole block contiguously at `start`. A per-node
-    # compacting scatter costs ~100x more on TPU (it serializes row
-    # updates); the garbage columns past n_push land above the cursor
-    # and are never read. The top chunk*J rows of the pool are a scratch
-    # margin (see row_limit) so the block write stays in bounds even
-    # when the live region is full.
-    order = jnp.argsort(~push, stable=True)
-    children = jnp.take(children, order, axis=1)
-    child_aux = jnp.take(child_aux, order, axis=1)
-    child_depth = child_aux[M].astype(jnp.int16)
+        cand = (mask & ~is_leaf & (lb1b < best)).reshape(-1)
+        ncand = cand.sum(dtype=jnp.int32)
+
+        # the scheduled-set bitmask rides the compaction as an aux row
+        sched = pallas_expand.sched_mask_cols(p_prmu, p_depth, TB)
+        aux_plus = jnp.concatenate([child_aux, sched], axis=0)  # (M+2, N)
+        order1 = jnp.argsort(~cand, stable=True)
+        children = jnp.take(children, order1, axis=1)
+        aux_plus = jnp.take(aux_plus, order1, axis=1)
+        cf_cols = aux_plus[:M]
+        sched_s = aux_plus[M + 1:M + 2]
+
+        tiers = [t for t in (N // 8, N // 4, N // 2)
+                 if t > 0 and min(4096, t & -t) >= pallas_expand.MIN_PALLAS_TILE]
+        tiers.append(N)
+
+        def lb2_prefix(prefix):
+            def f(_):
+                b = pallas_expand.lb2_bounds(
+                    tables, cf_cols[:, :prefix], sched_s[:, :prefix])
+                if prefix < N:
+                    b = jnp.concatenate(
+                        [b, jnp.full((1, N - prefix), I32_MAX, jnp.int32)],
+                        axis=1)
+                return b
+            return f
+
+        def tier_chain(idx):
+            t = tiers[idx]
+            if idx == len(tiers) - 1:
+                return lb2_prefix(t)
+            return lambda _: jax.lax.cond(ncand <= t, lb2_prefix(t),
+                                          tier_chain(idx + 1), 0)
+
+        lb2b = tier_chain(0)(0)
+
+        push = (jnp.arange(N) < ncand) & (lb2b.reshape(-1) < best)
+        n_push = push.sum(dtype=jnp.int32)
+        tree = state.tree + n_push.astype(jnp.int64)
+
+        order = jnp.argsort(~push, stable=True)
+        children = jnp.take(children, order, axis=1)
+        child_aux = jnp.take(aux_plus[:M + 1], order, axis=1)
+        child_depth = child_aux[M].astype(jnp.int16)
+    else:
+        # --- expand: children, child pool tables, bounds (Pallas on TPU)
+        children, child_aux, bounds = pallas_expand.expand(
+            tables, p_prmu, p_depth, p_aux, lb_kind=lb_kind, tile=TB)
+
+        # --- leaves: complete schedules; count + tighten incumbent
+        # (reference: the depth==jobs branch of decompose, PFSP_lib.c:24-32)
+        is_leaf = ((depth_c + 1) == J) & mask
+        sol = state.sol + is_leaf.sum(dtype=jnp.int64)
+        leaf_best = jnp.where(is_leaf, bounds, I32_MAX).min()
+        best = jnp.minimum(state.best, leaf_best)
+
+        # --- prune + push surviving internal children
+        push = (mask & ~is_leaf & (bounds < best)).reshape(-1)
+        n_push = push.sum(dtype=jnp.int32)
+        tree = state.tree + n_push.astype(jnp.int64)
+
+        # Compaction: stable-partition surviving columns to the front,
+        # then write the whole block contiguously at `start`. A per-node
+        # compacting scatter costs ~100x more on TPU (it serializes row
+        # updates); the garbage columns past n_push land above the
+        # cursor and are never read. The top chunk*J rows of the pool
+        # are a scratch margin (see row_limit) so the block write stays
+        # in bounds even when the live region is full.
+        order = jnp.argsort(~push, stable=True)
+        children = jnp.take(children, order, axis=1)
+        child_aux = jnp.take(child_aux, order, axis=1)
+        child_depth = child_aux[M].astype(jnp.int16)
 
     limit = row_limit(capacity, B, J)
     new_size = start + n_push

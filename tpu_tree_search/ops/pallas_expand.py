@@ -285,9 +285,28 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
     bounds_ref[:] = lb
 
 
+def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
+    """LB2 over child columns from the scheduled-set bitmask: Pallas
+    pair-sweep kernel when a legal column tile exists, the XLA bitmask
+    path (lb2_cols) otherwise. child_front_cols (M, N) i32,
+    sched_mask (1, N) i32 -> (1, N) i32 bounds.
+
+    THE single entry point for column-major LB2 — both device.step's
+    two-phase tiers and expand()'s one-shot path go through here, so the
+    tile rule and the fallback cannot diverge."""
+    N = child_front_cols.shape[1]
+    J = tables.js.shape[1]
+    nt = min(4096, N & -N)
+    if jax.default_backend() != "tpu" or nt < MIN_PALLAS_TILE:
+        return lb2_cols(tables, sched_mask, child_front_cols)
+    unsched = (((sched_mask >> jnp.arange(J, dtype=jnp.int32)[:, None])
+                & jnp.int32(1)) == 0).astype(jnp.float32)
+    return lb2_bounds_tpu(tables, child_front_cols, unsched, tile=nt)
+
+
 @functools.partial(jax.jit, static_argnames=("tile",))
 def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
-                   tile: int = 8192):
+                   tile: int = 4096):
     """Pallas LB2 over child columns: child_front_cols (M, N) i32,
     unsched_cols (J, N) f32 — returns (1, N) i32 bounds."""
     M, N = child_front_cols.shape
@@ -425,6 +444,25 @@ def effective_tile(jobs: int, batch: int, tile: int = 1024,
     return tile if batch % tile == 0 else batch
 
 
+def sched_mask_cols(prmu_T, depth2, tile: int):
+    """(1, N) int32 per-child scheduled-set bitmask in the expand column
+    order (c = (g*J + i)*TB + b): the parent's prefix bits plus the
+    appended job's bit. Requires jobs <= 31."""
+    J, B = prmu_T.shape
+    G = B // tile
+    N = B * J
+    one = jnp.int32(1)
+    appended = prmu_T.reshape(J, G, tile).transpose(1, 0, 2) \
+        .reshape(1, N).astype(jnp.int32)
+    pmask = jnp.sum(
+        jnp.where(jax.lax.broadcasted_iota(jnp.int32, (J, B), 0) < depth2,
+                  one << prmu_T.astype(jnp.int32), 0),
+        axis=0, dtype=jnp.int32)[None, :]              # (1, B)
+    pmask_c = jnp.broadcast_to(
+        pmask.reshape(G, 1, tile), (G, J, tile)).reshape(1, N)
+    return pmask_c | (one << appended)
+
+
 def expand(tables: BoundTables, prmu_T, depth2, front_T,
            lb_kind: int = 1, tile: int = 1024):
     """Dispatch: Pallas on TPU (LB1/LB1_d directly; LB2 as the expand
@@ -441,6 +479,7 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
                 else effective_tile(J, B, tile, lb_kind))
     lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
     kernel_ok = (on_tpu and eff_tile >= MIN_PALLAS_TILE
+                 and eff_tile % 128 == 0          # lane-aligned reshapes
                  and J * eff_tile <= lane_cap)
     if kernel_ok and lb_kind in (0, 1):
         return expand_tpu(tables, prmu_T, depth2, front_T,
@@ -452,24 +491,9 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
         if nt >= MIN_PALLAS_TILE:
             children, aux, _ = expand_tpu(tables, prmu_T, depth2, front_T,
                                           lb_kind=1, tile=eff_tile)
-            G = B // eff_tile
-            # child-column order: c = (g*J + i)*TB + b
-            appended = prmu_T.reshape(J, G, eff_tile).transpose(1, 0, 2) \
-                .reshape(1, N).astype(jnp.int32)
-            one = jnp.int32(1)
-            pmask = jnp.sum(
-                jnp.where(jax.lax.broadcasted_iota(jnp.int32, (J, B), 0)
-                          < depth2,
-                          one << prmu_T.astype(jnp.int32), 0),
-                axis=0, dtype=jnp.int32)[None, :]          # (1, B)
-            pmask_c = jnp.broadcast_to(
-                pmask.reshape(G, 1, eff_tile), (G, J, eff_tile)
-            ).reshape(1, N)
-            sched = pmask_c | (one << appended)            # (1, N)
-            unsched = (((sched >> jnp.arange(J, dtype=jnp.int32)[:, None])
-                        & one) == 0).astype(jnp.float32)   # (J, N)
+            sched = sched_mask_cols(prmu_T, depth2, eff_tile)  # (1, N)
             M = tables.p.shape[0]
-            bounds = lb2_bounds_tpu(tables, aux[:M], unsched, tile=nt)
+            bounds = lb2_bounds(tables, aux[:M], sched)
             return children, aux, bounds
     return expand_xla(tables, prmu_T, depth2, front_T,
                       lb_kind=lb_kind, tile=eff_tile)
