@@ -155,6 +155,120 @@ def _col_major(x, G: int, J: int, TB: int):
     return jnp.broadcast_to(x.reshape(G, 1, TB), (G, J, TB)).reshape(1, -1)
 
 
+def _partition(push: jax.Array) -> jax.Array:
+    """Stable-partition permutation: indices of all True columns first (in
+    order), then the False ones. One single-operand unstable sort of a
+    packed u32 key — the flag rides bit 31, the column index the low bits,
+    so every key is unique and the unstable sort is deterministic. ~4x
+    cheaper than argsort on TPU (no hidden payload operands)."""
+    n = push.shape[0]
+    assert n < 2**31
+    key = (jnp.where(push, jnp.uint32(0), jnp.uint32(1) << 31)
+           | jnp.arange(n, dtype=jnp.uint32))
+    return (jax.lax.sort(key, is_stable=False)
+            & jnp.uint32(0x7FFFFFFF)).astype(jnp.int32)
+
+
+def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
+              TB: int, with_sched: bool = False):
+    """Rebuild the first `t` compacted children directly from the popped
+    parent arrays (sources are only `chunk` wide, so these gathers move a
+    fraction of what gathering the dense (features, chunk*jobs) child
+    block would; the children's permutations and front chains are
+    recomputed — O(jobs + machines) vector ops per survivor, far cheaper
+    on TPU than the avoided HBM traffic).
+
+    `idx` (t,) are child-column indices in expand()'s slot-major order
+    (c = (g*J + i)*TB + b). Returns (child (J,t) int16,
+    caux (M+1,t) int32 = [child front | depth+1][, sched (1,t) int32
+    scheduled-set bitmask, jobs <= 31 only])."""
+    J, B = p_prmu.shape
+    M = p_aux.shape[0]
+    t = idx.shape[0]
+    JTB = J * TB
+    g = idx // JTB
+    r = idx - g * JTB
+    slot = r // TB
+    b = r - slot * TB
+    pcol = g * TB + b                               # parent column in [0, B)
+    # barriers: without them XLA fuses the index arithmetic into the
+    # gathers and the fused kernels run ~5x slower (measured on v5e)
+    pcol, slot = jax.lax.optimization_barrier((pcol, slot))
+    src = jnp.concatenate([p_aux, p_depth2], axis=0)      # (M+1, B)
+    pp = jnp.take(p_prmu, pcol, axis=1)                   # (J, t) int16
+    pfd = jnp.take(src, pcol, axis=1)                     # (M+1, t) int32
+    pp, pfd = jax.lax.optimization_barrier((pp, pfd))
+    pf = pfd[:M]
+    pd = pfd[M:]                                          # (1, t) depth
+
+    ppi = pp.astype(jnp.int32)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (J, t), 0)
+    appended = jnp.sum(jnp.where(rows == slot[None, :], ppi, 0),
+                       axis=0, dtype=jnp.int32)[None, :]  # prmu[slot]
+    at_depth = jnp.sum(jnp.where(rows == pd, ppi, 0),
+                       axis=0, dtype=jnp.int32)[None, :]  # prmu[depth]
+    child = jnp.where(rows == pd, appended,
+                      jnp.where(rows == slot[None, :], at_depth,
+                                ppi)).astype(jnp.int16)
+
+    # child_p[k] = p[k, appended] (J-step select: dynamic column gathers
+    # of the tiny (M, J) table serialize on TPU, selects vectorize)
+    cp = jnp.zeros((M, t), jnp.int32)
+    for j in range(J):
+        cp = jnp.where(appended == j, tables.p[:, j:j + 1], cp)
+
+    # add_forward chain (c_bound_simple.c:31-38) from the parent front
+    cf = pf[0:1] + cp[0:1]
+    cf_rows = [cf]
+    for k in range(1, M):
+        cf = jnp.maximum(cf, pf[k:k + 1]) + cp[k:k + 1]
+        cf_rows.append(cf)
+    caux = jnp.concatenate(cf_rows + [pd + 1], axis=0)    # (M+1, t)
+
+    if not with_sched:
+        return child, caux
+    one = jnp.int32(1)
+    sched = jnp.sum(jnp.where(rows < pd, one << ppi, 0),
+                    axis=0, dtype=jnp.int32)[None, :] | (one << appended)
+    return child, caux, sched
+
+
+def _tiered_compact(gather, perm, n_keep, N: int):
+    """Full-width (N-column) compacted block: the first S = N//4 columns
+    are always gathered via `gather(idx) -> tuple of (rows, len(idx))
+    blocks`; the tail is only materialized when more than S columns
+    survive (rare past the warm-up), otherwise it is zeros. The
+    `lax.cond` carries only these small blocks — threading the HBM pools
+    through a cond copies them (measured: ~4x step cost), which is why
+    the caller writes the block into the pool outside."""
+    S = max(N // 4, min(N, 128))
+    head = gather(jax.lax.slice(perm, (0,), (S,)))
+    if S == N:
+        return head
+
+    def tail_zero(_):
+        return tuple(jnp.zeros(h.shape[:-1] + (N - S,), h.dtype)
+                     for h in head)
+
+    def tail_full(_):
+        return gather(jax.lax.slice(perm, (S,), (N,)))
+
+    tail = jax.lax.cond(n_keep <= S, tail_zero, tail_full, 0)
+    return tuple(jnp.concatenate([h, tl], axis=1)
+                 for h, tl in zip(head, tail))
+
+
+def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
+                          perm, n_keep, TB: int, N: int,
+                          with_sched: bool = False):
+    """Compacted child block rebuilt from the popped parents (see
+    _regather), tiered by survivor count (see _tiered_compact)."""
+    def gather(idx):
+        return _regather(tables, p_prmu, p_depth2, p_aux, idx, TB,
+                         with_sched)
+    return _tiered_compact(gather, perm, n_keep, N)
+
+
 def step(tables: BoundTables, lb_kind: int, chunk: int,
          state: SearchState, tile: int = 1024) -> SearchState:
     """One pop->bound->prune->branch cycle (the compiled analogue of the
@@ -193,21 +307,17 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     ).reshape(1, N)
     mask = (slot_c >= depth_c) & valid_c
 
-    two_phase = (lb_kind == 2 and jax.default_backend() == "tpu"
-                 and J <= 31 and TB >= pallas_expand.MIN_PALLAS_TILE
-                 and TB % 128 == 0                # lane-aligned reshapes
-                 and J * TB <= pallas_expand.MAX_TILE_LANES // 2)
+    two_phase = lb_kind == 2 and pallas_expand.kernel_ok(J, TB, lb_kind)
     if two_phase:
         # Two-phase LB2 (TPU): bound every child with the near-free LB1
         # first (LB1 <= LB2, so LB1-pruning is sound and the explored
-        # set stays the exact LB2 set), compact the survivors to the
-        # front, and run the expensive pair-sweep kernel only over the
-        # smallest power-of-two prefix that covers them. At UB=opt LB1
-        # removes ~85% of the child grid, so the sweep usually runs on
-        # an eighth of the columns. The reference gets its version of
-        # this saving from the per-child early exit the vector unit
-        # cannot take (c_bound_johnson.c:231-233).
-        children, child_aux, lb1b = pallas_expand.expand(
+        # set stays the exact LB2 set), rebuild only the survivors from
+        # their parents (regather), and run the expensive pair-sweep
+        # kernel only over the smallest prefix tier that covers them. At
+        # UB=opt LB1 removes ~85% of the child grid. The reference gets
+        # its version of this saving from the per-child early exit the
+        # vector unit cannot take (c_bound_johnson.c:231-233).
+        lb1b = pallas_expand.expand_bounds(
             tables, p_prmu, p_depth, p_aux, lb_kind=1, tile=TB)
 
         is_leaf = ((depth_c + 1) == J) & mask
@@ -219,14 +329,11 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         cand = (mask & ~is_leaf & (lb1b < best)).reshape(-1)
         ncand = cand.sum(dtype=jnp.int32)
 
-        # the scheduled-set bitmask rides the compaction as an aux row
-        sched = pallas_expand.sched_mask_cols(p_prmu, p_depth, TB)
-        aux_plus = jnp.concatenate([child_aux, sched], axis=0)  # (M+2, N)
-        order1 = jnp.argsort(~cand, stable=True)
-        children = jnp.take(children, order1, axis=1)
-        aux_plus = jnp.take(aux_plus, order1, axis=1)
-        cf_cols = aux_plus[:M]
-        sched_s = aux_plus[M + 1:M + 2]
+        perm1 = _partition(cand)
+        children, aux_sched, sched = _compact_from_parents(
+            tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
+            with_sched=True)
+        cf_cols = aux_sched[:M]
 
         tiers = [t for t in (N // 8, N // 4, N // 2)
                  if t > 0 and min(4096, t & -t) >= pallas_expand.MIN_PALLAS_TILE]
@@ -235,7 +342,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         def lb2_prefix(prefix):
             def f(_):
                 b = pallas_expand.lb2_bounds(
-                    tables, cf_cols[:, :prefix], sched_s[:, :prefix])
+                    tables, cf_cols[:, :prefix], sched[:, :prefix])
                 if prefix < N:
                     b = jnp.concatenate(
                         [b, jnp.full((1, N - prefix), I32_MAX, jnp.int32)],
@@ -256,13 +363,23 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         n_push = push.sum(dtype=jnp.int32)
         tree = state.tree + n_push.astype(jnp.int64)
 
-        order = jnp.argsort(~push, stable=True)
-        children = jnp.take(children, order, axis=1)
-        child_aux = jnp.take(aux_plus[:M + 1], order, axis=1)
+        # second compaction: direct prefix gather of the already-built
+        # block (sources are the compacted (features, N) arrays)
+        perm2 = _partition(push)
+
+        def take2(idx):
+            idx = jax.lax.optimization_barrier(idx)
+            ch = jnp.take(children, idx, axis=1)
+            ax = jnp.take(aux_sched, idx, axis=1)
+            return jax.lax.optimization_barrier((ch, ax))
+
+        children, child_aux = _tiered_compact(take2, perm2, n_push, N)
         child_depth = child_aux[M].astype(jnp.int16)
     else:
-        # --- expand: children, child pool tables, bounds (Pallas on TPU)
-        children, child_aux, bounds = pallas_expand.expand(
+        # --- bounds of the dense child grid (Pallas on TPU; the children
+        # themselves are never materialized — survivors are rebuilt from
+        # their parents below)
+        bounds = pallas_expand.expand_bounds(
             tables, p_prmu, p_depth, p_aux, lb_kind=lb_kind, tile=TB)
 
         # --- leaves: complete schedules; count + tighten incumbent
@@ -277,16 +394,18 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         n_push = push.sum(dtype=jnp.int32)
         tree = state.tree + n_push.astype(jnp.int64)
 
-        # Compaction: stable-partition surviving columns to the front,
-        # then write the whole block contiguously at `start`. A per-node
-        # compacting scatter costs ~100x more on TPU (it serializes row
-        # updates); the garbage columns past n_push land above the
-        # cursor and are never read. The top chunk*J rows of the pool
-        # are a scratch margin (see row_limit) so the block write stays
-        # in bounds even when the live region is full.
-        order = jnp.argsort(~push, stable=True)
-        children = jnp.take(children, order, axis=1)
-        child_aux = jnp.take(child_aux, order, axis=1)
+        # Compaction: stable-partition the surviving column indices to
+        # the front (_partition), rebuild those children from their
+        # parents (_compact_from_parents), then write the whole block
+        # contiguously at `start`. A per-node compacting scatter costs
+        # ~100x more on TPU (it serializes row updates); the garbage
+        # columns past n_push land above the cursor and are never read.
+        # The top chunk*J rows of the pool are a scratch margin (see
+        # row_limit) so the block write stays in bounds even when the
+        # live region is full.
+        perm = _partition(push)
+        children, child_aux = _compact_from_parents(
+            tables, p_prmu, p_depth, p_aux, perm, n_push, TB, N)
         child_depth = child_aux[M].astype(jnp.int16)
 
     limit = row_limit(capacity, B, J)

@@ -62,6 +62,27 @@ def _expand_kernel(lb_kind: int, J: int, M: int, TB: int,
                    p_ref, tails_ref, prmu_ref, depth_ref, front_ref,
                    children_ref, aux_ref, bounds_ref):
     """One tile: TB parents -> J*TB dense child slots (slot-major)."""
+    _expand_math(lb_kind, J, M, TB, p_ref, tails_ref, prmu_ref, depth_ref,
+                 front_ref, children_ref, aux_ref, bounds_ref)
+
+
+def _bounds_kernel(lb_kind: int, J: int, M: int, TB: int,
+                   p_ref, tails_ref, prmu_ref, depth_ref, front_ref,
+                   bounds_ref):
+    """Bounds-only variant: same math, no children/aux materialization.
+
+    The regather step architecture (engine/device.step) only consumes the
+    bound of every child slot here; surviving children are rebuilt from
+    their parents after pruning, so writing the full (J+M+2, N) child
+    block from the kernel would be pure wasted HBM traffic."""
+    _expand_math(lb_kind, J, M, TB, p_ref, tails_ref, prmu_ref, depth_ref,
+                 front_ref, None, None, bounds_ref)
+
+
+def _expand_math(lb_kind: int, J: int, M: int, TB: int,
+                 p_ref, tails_ref, prmu_ref, depth_ref, front_ref,
+                 children_ref, aux_ref, bounds_ref):
+    emit = children_ref is not None
     N = J * TB
     prmu = prmu_ref[:].astype(jnp.int32)          # (J, TB)
     depth = depth_ref[:]                          # (1, TB)
@@ -108,30 +129,34 @@ def _expand_kernel(lb_kind: int, J: int, M: int, TB: int,
         cf = jnp.maximum(cf, front_rep[k:k + 1]) + child_p[k:k + 1]
         cf_rows.append(cf)
 
-    # --- children permutations: position row by position row
-    # child(i, b)[pos] = prmu[i,b] if pos==depth[b]; prmu[depth[b],b] if
-    # pos==i; else prmu[pos,b]   (prefix-swap branching, PFSP_lib.c:13-16)
-    # at_depth[b] = prmu[depth[b], b] (the job being displaced)
-    at_depth = prmu[0:1, :]
-    for pos in range(1, J):
-        at_depth = jnp.where(depth == pos, prmu[pos:pos + 1, :], at_depth)
-    # slot index i at column c = i*TB + b, as a concat of constants
-    # (NOT `lane // TB` — a python-int divisor becomes a weak i64 under
-    # x64 and mosaic's i32<->i64 convert recurses; NOT a reshaped sublane
-    # iota — mosaic fails to legalize the sublane->lane iota relayout)
-    slot_flat = jnp.concatenate(
-        [jnp.full((1, TB), i, jnp.int32) for i in range(J)], axis=1)
-    at_depth_flat = _tile_lanes(at_depth, J)
-    for pos in range(J):
-        base = _tile_lanes(prmu[pos:pos + 1, :], J)
-        row = jnp.where(depth_flat == pos, prmu_flat,
-                        jnp.where(slot_flat == pos, at_depth_flat, base))
-        children_ref[pos:pos + 1, :] = row.astype(jnp.int16)
+    if emit:
+        # --- children permutations: position row by position row
+        # child(i, b)[pos] = prmu[i,b] if pos==depth[b]; prmu[depth[b],b]
+        # if pos==i; else prmu[pos,b] (prefix-swap, PFSP_lib.c:13-16)
+        # at_depth[b] = prmu[depth[b], b] (the job being displaced)
+        at_depth = prmu[0:1, :]
+        for pos in range(1, J):
+            at_depth = jnp.where(depth == pos, prmu[pos:pos + 1, :],
+                                 at_depth)
+        # slot index i at column c = i*TB + b, as a concat of constants
+        # (NOT `lane // TB` — a python-int divisor becomes a weak i64
+        # under x64 and mosaic's i32<->i64 convert recurses; NOT a
+        # reshaped sublane iota — mosaic fails to legalize the
+        # sublane->lane iota relayout)
+        slot_flat = jnp.concatenate(
+            [jnp.full((1, TB), i, jnp.int32) for i in range(J)], axis=1)
+        at_depth_flat = _tile_lanes(at_depth, J)
+        for pos in range(J):
+            base = _tile_lanes(prmu[pos:pos + 1, :], J)
+            row = jnp.where(depth_flat == pos, prmu_flat,
+                            jnp.where(slot_flat == pos, at_depth_flat,
+                                      base))
+            children_ref[pos:pos + 1, :] = row.astype(jnp.int16)
 
-    # --- child pool tables [front | depth+1]
-    for k in range(M):
-        aux_ref[k:k + 1, :] = cf_rows[k]
-    aux_ref[M:M + 1, :] = depth_flat + 1
+        # --- child pool tables [front | depth+1]
+        for k in range(M):
+            aux_ref[k:k + 1, :] = cf_rows[k]
+        aux_ref[M:M + 1, :] = depth_flat + 1
 
     # --- bound chains last (write order matters to mosaic's scheduler:
     # bounds-first failed to legalize, see module docstring)
@@ -199,6 +224,70 @@ def expand_tpu(tables: BoundTables, prmu_T, depth2, front_T,
         return pieces[0]
     return tuple(jnp.concatenate([p[k] for p in pieces], axis=1)
                  for k in range(3))
+
+
+@functools.partial(jax.jit, static_argnames=("lb_kind", "tile"))
+def expand_bounds_tpu(tables: BoundTables, prmu_T, depth2, front_T,
+                      lb_kind: int = 1, tile: int = 1024):
+    """Pallas bounds-only expand: (1, B*J) int32 child bounds in the same
+    slot-major column order as expand_tpu, without materializing the
+    children (see _bounds_kernel)."""
+    J, B = prmu_T.shape
+    M = front_T.shape[0]
+    TB = tile
+    assert B % TB == 0, (B, TB)
+    G = B // TB
+
+    p_f32 = tables.p.astype(jnp.float32)
+    tails = tables.min_tails.reshape(1, M)
+    kernel = functools.partial(_bounds_kernel, lb_kind, J, M, TB)
+    call = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 5,
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, J * TB), jnp.int32),
+    )
+    pieces = []
+    for g in range(G):
+        sl = slice(g * TB, (g + 1) * TB)
+        pieces.append(call(p_f32, tails, prmu_T[:, sl], depth2[:, sl],
+                           front_T[:, sl]))
+    return pieces[0] if G == 1 else jnp.concatenate(pieces, axis=1)
+
+
+def kernel_ok(jobs: int, eff_tile: int, lb_kind: int) -> bool:
+    """THE eligibility rule for the Pallas expand kernels — shared by
+    expand(), expand_bounds() and device.step's two-phase gate so the
+    dispatch can never diverge between them. LB2 additionally requires
+    jobs <= 31 (the scheduled-set bitmask carries one bit per job)."""
+    if jax.default_backend() != "tpu":
+        return False
+    lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
+    ok = (eff_tile >= MIN_PALLAS_TILE
+          and eff_tile % 128 == 0          # lane-aligned reshapes
+          and jobs * eff_tile <= lane_cap)
+    if lb_kind == 2:
+        ok = ok and jobs <= 31
+    return ok
+
+
+def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
+                  lb_kind: int = 1, tile: int = 1024):
+    """Bounds of every child slot, (1, B*J) int32, slot-major columns:
+    the Pallas bounds kernel on TPU for LB1/LB1_d when the tile is legal,
+    the XLA fallback otherwise — including ALL of LB2, whose TPU fast
+    path needs the child fronts this function never materializes
+    (device.step's two-phase route owns that case: LB1 kernel for the
+    pre-prune, then lb2_bounds over the regathered survivors). The column
+    order is identical to expand()'s for the same tile."""
+    J, B = prmu_T.shape
+    eff_tile = (tile if B % tile == 0
+                else effective_tile(J, B, tile, lb_kind))
+    if kernel_ok(J, eff_tile, lb_kind) and lb_kind in (0, 1):
+        return expand_bounds_tpu(tables, prmu_T, depth2, front_T,
+                                 lb_kind=lb_kind, tile=eff_tile)
+    return expand_bounds_xla(tables, prmu_T, depth2, front_T,
+                             lb_kind=lb_kind, tile=eff_tile)
 
 
 def lb2_cols(tables: BoundTables, sched_mask, child_front_cols):
@@ -343,6 +432,56 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
     return jnp.concatenate(pieces, axis=1)
 
 
+def _to_cols(x, G: int, TB: int, J: int):
+    """Reorder (B, J, X) -> (X, tile-slot-major columns): within each
+    tile of TB parents, column c = i*TB + b."""
+    x = x.reshape(G, TB, J, x.shape[-1])
+    x = x.transpose(3, 0, 2, 1)                     # (X, G, J, TB)
+    return x.reshape(x.shape[0], G * J * TB)
+
+
+def _xla_parts(tables: BoundTables, prmu_T, depth2, front_T):
+    """Shared row-major intermediates of the XLA expand paths: parent
+    views, per-machine remain (reconstructed from the permutation,
+    kernel-parity), and the child front chains."""
+    from . import batched
+
+    J, B = prmu_T.shape
+    prmu = prmu_T.T                                 # (B, J)
+    depth = depth2.reshape(B)
+    front = front_T.T
+    sched_mask = jnp.arange(J)[None, :] >= depth[:, None]      # (B, J)
+    onehot = (prmu[..., None].astype(jnp.int32)
+              == jnp.arange(J, dtype=jnp.int32)) & sched_mask[..., None]
+    remain = jnp.einsum("bjv,mv->bm", onehot.astype(jnp.int32),
+                        tables.p,
+                        preferred_element_type=jnp.int32)      # (B, M)
+    child_front, child_p = batched._child_fronts(tables, prmu, front)
+    return prmu, depth, front, remain, child_front, child_p
+
+
+def _bounds_rows(tables: BoundTables, lb_kind: int, prmu, depth, front,
+                 remain, child_front, child_p):
+    """(B, J) bounds from the row-major parts, or None for the LB2
+    bitmask fast path (J <= 31), which the callers evaluate column-major
+    via lb2_cols on the child fronts."""
+    from . import batched
+
+    B, J = prmu.shape
+    mask = jnp.ones((B, J), bool)
+    if lb_kind == 2:
+        if J > 31:
+            # bitmask fast path needs one bit per job; wide instances
+            # keep the scan-based fallback
+            return batched.lb2_from_parts(tables, prmu, depth,
+                                          child_front, mask)
+        return None
+    if lb_kind == 1:
+        return batched.lb1_from_parts(
+            tables, child_front, remain[:, None, :] - child_p, mask)
+    return batched.lb1d_from_parts(tables, front, remain, child_p, mask)
+
+
 def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
                lb_kind: int = 1, tile: int | None = None):
     """Pure-XLA fallback with the identical contract (feature-major,
@@ -357,36 +496,10 @@ def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
     assert B % TB == 0
     G = B // TB
 
-    from . import batched
-
-    prmu = prmu_T.T                                 # (B, J)
-    depth = depth2.reshape(B)
-    front = front_T.T
-
-    # remain reconstructed from the permutation (kernel-parity)
-    sched_mask = jnp.arange(J)[None, :] >= depth[:, None]      # (B, J)
-    onehot = (prmu[..., None].astype(jnp.int32)
-              == jnp.arange(J, dtype=jnp.int32)) & sched_mask[..., None]
-    remain = jnp.einsum("bjv,mv->bm", onehot.astype(jnp.int32),
-                        tables.p,
-                        preferred_element_type=jnp.int32)      # (B, M)
-
-    child_front, child_p = batched._child_fronts(tables, prmu, front)
-    mask = jnp.ones((B, J), bool)
-    bounds = None
-    if lb_kind == 2:
-        if J > 31:
-            # bitmask fast path needs one bit per job; wide instances
-            # keep the scan-based fallback
-            bounds = batched.lb2_from_parts(tables, prmu, depth,
-                                            child_front, mask)
-        # else: computed column-major below (lb2_cols)
-    elif lb_kind == 1:
-        bounds = batched.lb1_from_parts(
-            tables, child_front, remain[:, None, :] - child_p, mask)
-    else:
-        bounds = batched.lb1d_from_parts(tables, front, remain, child_p,
-                                         mask)
+    prmu, depth, front, remain, child_front, child_p = _xla_parts(
+        tables, prmu_T, depth2, front_T)
+    bounds = _bounds_rows(tables, lb_kind, prmu, depth, front, remain,
+                          child_front, child_p)
 
     from ..engine.device import make_children
     children = make_children(prmu, depth)           # (B, J, J)
@@ -395,32 +508,40 @@ def expand_xla(tables: BoundTables, prmu_T, depth2, front_T,
          jnp.broadcast_to((depth + 1)[:, None, None], (B, J, 1))],
         axis=-1)                                    # (B, J, M+1)
 
-    # reorder (B, J, X) -> (X, tile-slot-major columns): within each tile
-    # of TB parents, column c = i*TB + b
-    def to_cols(x):                                 # (B, J, X) -> (X, B*J)
-        x = x.reshape(G, TB, J, x.shape[-1])
-        x = x.transpose(3, 0, 2, 1)                 # (X, G, J, TB)
-        return x.reshape(x.shape[0], G * J * TB)
-
-    children_T = to_cols(children.astype(jnp.int32)).astype(jnp.int16)
-    aux_T = to_cols(child_aux)
+    children_T = _to_cols(children.astype(jnp.int32), G, TB, J) \
+        .astype(jnp.int16)
+    aux_T = _to_cols(child_aux, G, TB, J)
     if bounds is not None:
-        bounds_row = to_cols(bounds[:, :, None]).astype(jnp.int32)
+        bounds_row = _to_cols(bounds[:, :, None], G, TB, J) \
+            .astype(jnp.int32)
     else:
         # LB2 bitmask fast path on (pairs, children) lanes; aux rows
         # [0:M] are exactly the child fronts in column order
-        M = tables.p.shape[0]
-        one = jnp.int32(1)
-        parent_mask = jnp.sum(
-            jnp.where(jnp.arange(J)[None, :] < depth[:, None],
-                      (one << prmu.astype(jnp.int32)), 0),
-            axis=1, dtype=jnp.int32)                     # (B,)
-        pm_cols = to_cols(jnp.broadcast_to(
-            parent_mask[:, None, None], (B, J, 1)))      # (1, N)
-        appended = to_cols(prmu.astype(jnp.int32)[:, :, None])
-        sched = pm_cols | (one << appended)
+        sched = sched_mask_cols(prmu_T, depth2, TB)
         bounds_row = lb2_cols(tables, sched, aux_T[:M])
     return children_T, aux_T, bounds_row
+
+
+def expand_bounds_xla(tables: BoundTables, prmu_T, depth2, front_T,
+                      lb_kind: int = 1, tile: int | None = None):
+    """Bounds-only XLA fallback: same column order and bound math as
+    expand_xla, but never materializes the children/aux block — the
+    regather step architecture rebuilds survivors from their parents, so
+    building the dense child block here would be pure wasted work."""
+    J, B = prmu_T.shape
+    TB = B if tile is None else tile
+    assert B % TB == 0
+    G = B // TB
+
+    prmu, depth, front, remain, child_front, child_p = _xla_parts(
+        tables, prmu_T, depth2, front_T)
+    bounds = _bounds_rows(tables, lb_kind, prmu, depth, front, remain,
+                          child_front, child_p)
+    if bounds is not None:
+        return _to_cols(bounds[:, :, None], G, TB, J).astype(jnp.int32)
+    cf_cols = _to_cols(child_front.astype(jnp.int32), G, TB, J)
+    sched = sched_mask_cols(prmu_T, depth2, TB)
+    return lb2_cols(tables, sched, cf_cols)
 
 
 MIN_PALLAS_TILE = 256   # below this mosaic rejects the lane reshapes
@@ -468,7 +589,6 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
     """Dispatch: Pallas on TPU (LB1/LB1_d directly; LB2 as the expand
     kernel for children/aux + the pair-sweep kernel for bounds, when the
     job count fits the scheduled-set bitmask), XLA otherwise."""
-    on_tpu = jax.default_backend() == "tpu"
     J, B = prmu_T.shape
     # A tile that divides the batch is trusted as-is: step() derives it
     # through effective_tile and builds its masks in that column order,
@@ -477,14 +597,11 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
     # tile falls back to XLA, never to a different column order).
     eff_tile = (tile if B % tile == 0
                 else effective_tile(J, B, tile, lb_kind))
-    lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
-    kernel_ok = (on_tpu and eff_tile >= MIN_PALLAS_TILE
-                 and eff_tile % 128 == 0          # lane-aligned reshapes
-                 and J * eff_tile <= lane_cap)
-    if kernel_ok and lb_kind in (0, 1):
+    ok = kernel_ok(J, eff_tile, lb_kind)
+    if ok and lb_kind in (0, 1):
         return expand_tpu(tables, prmu_T, depth2, front_T,
                           lb_kind=lb_kind, tile=eff_tile)
-    if kernel_ok and lb_kind == 2 and J <= 31:
+    if ok and lb_kind == 2:
         N = B * J
         nt = N & -N                      # largest power-of-two divisor
         nt = min(nt, 4096)
