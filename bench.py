@@ -35,7 +35,9 @@ from tpu_tree_search.problems import taillard  # noqa: E402
 def main():
     inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
     lb_kind = int(os.environ.get("TTS_BENCH_LB", "1"))
-    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "8192"))
+    # 32768 parents/step measured best on v5e (25% over 8192: the
+    # remaining per-step costs amortize over more lanes; 65536 regresses)
+    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "32768"))
     # long window: a single dispatch through the runtime costs O(100 ms)
     # host-side; the compiled loop itself is ~0.6 ms/iteration, so short
     # windows under-report the sustained rate real runs see

@@ -86,9 +86,9 @@ def test_bounds_kernel_matches_xla_fallback(lb_kind):
 
 def test_two_phase_lb2_engine_matches_golden():
     """End-to-end on hardware through the two-phase LB2 step (LB1
-    pre-prune -> regather -> tiered pair sweep -> second compaction):
-    ta003 with UB=opt must reproduce the golden totals exactly
-    (tests/golden/pfsp_lb2_ub1.jsonl: tree=80062, Cmax=1081)."""
+    pre-prune -> regather -> strong-pair prefilter -> tiered pair sweep
+    -> final compaction): ta003 with UB=opt must reproduce the golden
+    totals exactly (tests/golden/pfsp_lb2_ub1.jsonl: tree=80062)."""
     from tpu_tree_search.engine import device
 
     p = taillard.processing_times(3)
@@ -97,6 +97,53 @@ def test_two_phase_lb2_engine_matches_golden():
                         capacity=1 << 18)
     assert (out.explored_tree, out.explored_sol, out.best) == \
            (80062, 0, opt)
+
+
+def test_two_phase_lb2_engine_matches_golden_large():
+    """Same, on the largest small-class golden (ta008: a 13.9M-node LB2
+    tree) at a production chunk — hundreds of steps through every sweep
+    and compaction tier. Segmented like real long runs (one unbounded
+    dispatch would trip the remote-worker watchdog)."""
+    import functools
+
+    from tpu_tree_search.engine import checkpoint, device
+    from tpu_tree_search.ops import batched
+
+    p = taillard.processing_times(8)
+    opt = taillard.optimal_makespan(8)
+    tables = batched.make_tables(p)
+    state = device.init_state(20, 1 << 22, opt, p_times=p)
+    run_fn = functools.partial(device.run, tables, lb_kind=2, chunk=8192)
+
+    def run(state, target):
+        return run_fn(state=state, max_iters=target)
+
+    out = checkpoint.run_segmented(run, state, segment_iters=2000,
+                                   heartbeat=lambda r: None)
+    assert (int(out.tree), int(out.sol), int(out.best)) == \
+           (13940189, 0, opt)
+
+
+def test_prefilter_branch_matches_oracle():
+    """The strong-pair prefilter only compiles in when P > 2*32 pairs —
+    i.e. >= 12 machines — which no small-class golden reaches (20x5 has
+    P=10). This synthetic 8-job x 15-machine instance (P=105) forces the
+    prefilter path end-to-end on hardware and checks the full search
+    against the sequential oracle."""
+    from tpu_tree_search.engine import device, sequential as seq
+    from tpu_tree_search.problems.pfsp import PFSPInstance
+
+    rng = np.random.default_rng(42)
+    p = rng.integers(1, 100, (15, 8)).astype(np.int32)
+    inst = PFSPInstance(inst_id=0, jobs=8, machines=15, p_times=p)
+    opt = seq.pfsp_search(inst, lb=2).best
+    # UB=opt makes the explored set traversal-order-invariant, so the
+    # oracle's totals must match exactly
+    want = seq.pfsp_search(inst, lb=2, init_ub=opt)
+    out = device.search(p, lb_kind=2, init_ub=opt, chunk=1024,
+                        capacity=1 << 18)
+    assert (out.explored_tree, out.explored_sol, out.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
 
 
 def test_lb2_kernel_matches_xla_fallback():

@@ -234,28 +234,31 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
 
 
 def _tiered_compact(gather, perm, n_keep, N: int):
-    """Full-width (N-column) compacted block: the first S = N//4 columns
-    are always gathered via `gather(idx) -> tuple of (rows, len(idx))
-    blocks`; the tail is only materialized when more than S columns
-    survive (rare past the warm-up), otherwise it is zeros. The
-    `lax.cond` carries only these small blocks — threading the HBM pools
-    through a cond copies them (measured: ~4x step cost), which is why
-    the caller writes the block into the pool outside."""
-    S = max(N // 4, min(N, 128))
-    head = gather(jax.lax.slice(perm, (0,), (S,)))
-    if S == N:
-        return head
+    """Full-width (N-column) compacted block, built by the smallest tier
+    that covers the `n_keep` survivors: a switch branch gathers only its
+    tier's prefix via `gather(idx) -> tuple of (rows, len(idx)) blocks`
+    and zero-pads the rest (a cheap sequential write; the garbage columns
+    land above the pool cursor and are never read). Steady-state LB1
+    steps take the N//4 tier, the post-prefilter LB2 rounds the N//16
+    one. The switch carries only these blocks — threading the HBM pools
+    through conditional branches copies them (measured: ~4x step cost),
+    which is why the caller writes the block into the pool outside."""
+    tiers = [t for t in (N // 16, N // 4) if t >= 128] + [N]
 
-    def tail_zero(_):
-        return tuple(jnp.zeros(h.shape[:-1] + (N - S,), h.dtype)
-                     for h in head)
+    def branch(t):
+        def f(_):
+            out = gather(jax.lax.slice(perm, (0,), (t,)))
+            if t < N:
+                out = tuple(jnp.concatenate(
+                    [o, jnp.zeros(o.shape[:-1] + (N - t,), o.dtype)],
+                    axis=-1) for o in out)
+            return out
+        return f
 
-    def tail_full(_):
-        return gather(jax.lax.slice(perm, (S,), (N,)))
-
-    tail = jax.lax.cond(n_keep <= S, tail_zero, tail_full, 0)
-    return tuple(jnp.concatenate([h, tl], axis=1)
-                 for h, tl in zip(head, tail))
+    if len(tiers) == 1:
+        return branch(tiers[0])(0)
+    sel = sum((n_keep > t).astype(jnp.int32) for t in tiers[:-1])
+    return jax.lax.switch(sel, [branch(t) for t in tiers], 0)
 
 
 def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
@@ -330,50 +333,91 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         ncand = cand.sum(dtype=jnp.int32)
 
         perm1 = _partition(cand)
-        children, aux_sched, sched = _compact_from_parents(
+        children, caux, sched = _compact_from_parents(
             tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
             with_sched=True)
-        cf_cols = aux_sched[:M]
 
-        tiers = [t for t in (N // 8, N // 4, N // 2)
-                 if t > 0 and min(4096, t & -t) >= pallas_expand.MIN_PALLAS_TILE]
-        tiers.append(N)
+        def sweep_tiers(tbl, cf_cols, sched_cols, count):
+            """Pair sweep over the smallest prefix tier covering `count`
+            live columns; columns past the tier read I32_MAX."""
+            tiers = [t for t in (N // 64, N // 32, N // 16, N // 8,
+                                 N // 4, N // 2)
+                     if t > 0 and min(4096, t & -t)
+                     >= pallas_expand.MIN_PALLAS_TILE]
+            tiers.append(N)
 
-        def lb2_prefix(prefix):
-            def f(_):
-                b = pallas_expand.lb2_bounds(
-                    tables, cf_cols[:, :prefix], sched[:, :prefix])
-                if prefix < N:
-                    b = jnp.concatenate(
-                        [b, jnp.full((1, N - prefix), I32_MAX, jnp.int32)],
-                        axis=1)
-                return b
-            return f
+            def prefix(width):
+                def f(_):
+                    b = pallas_expand.lb2_bounds(
+                        tbl, cf_cols[:, :width], sched_cols[:, :width])
+                    if width < N:
+                        b = jnp.concatenate(
+                            [b, jnp.full((1, N - width), I32_MAX,
+                                         jnp.int32)], axis=1)
+                    return b
+                return f
 
-        def tier_chain(idx):
-            t = tiers[idx]
-            if idx == len(tiers) - 1:
-                return lb2_prefix(t)
-            return lambda _: jax.lax.cond(ncand <= t, lb2_prefix(t),
-                                          tier_chain(idx + 1), 0)
+            if len(tiers) == 1:
+                return prefix(tiers[0])(0)
+            # one switch, not a nested cond ladder: every cond level
+            # copies its (1, N) result, so a 7-deep ladder pays 7 copies
+            sel = sum((count > t).astype(jnp.int32) for t in tiers[:-1])
+            return jax.lax.switch(sel, [prefix(t) for t in tiers], 0)
 
-        lb2b = tier_chain(0)(0)
+        def take_block(*rows_arrays):
+            """prefix-gather closure over the given (rows, N) arrays."""
+            def take(idx):
+                idx = jax.lax.optimization_barrier(idx)
+                out = tuple(jnp.take(a, idx, axis=1) for a in rows_arrays)
+                return jax.lax.optimization_barrier(out)
+            return take
 
-        push = (jnp.arange(N) < ncand) & (lb2b.reshape(-1) < best)
+        # Strong-pair prefilter (the reference's unimplemented LB2_LEARN,
+        # c_bound_johnson.h:29): sweep only the PAIR_PREFILTER
+        # strongest pairs (tables store pairs strongest-first), prune on
+        # that partial max (partial max <= LB2, so pruning on it is
+        # sound), and pay for the remaining pairs only on the children
+        # the prefix failed to prune (<10% on the 20x20 class). The
+        # total bound stays exactly max(head, tail) = full LB2, so
+        # explored trees are bit-identical to the single-sweep path.
+        P = int(tables.ma0.shape[0])
+        KH = batched.PAIR_PREFILTER
+        if P > 2 * KH:
+            head_t, tail_t = batched.pair_split(tables, KH)
+            lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
+            keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
+            nkeep = keep.sum(dtype=jnp.int32)
+            permh = _partition(keep)
+            # the partial bound rides the compaction as an extra row
+            aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
+            children, aux_plus = _tiered_compact(
+                take_block(children, aux_plus), permh, nkeep, N)
+            caux = aux_plus[:M + 1]
+            sched = aux_plus[M + 1:M + 2]
+            lb2h_c = aux_plus[M + 2:M + 3]
+            lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
+            lb2b = jnp.maximum(lb2h_c, lb2t)
+            live = nkeep
+        else:
+            lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
+            live = ncand
+
+        push = (jnp.arange(N) < live) & (lb2b.reshape(-1) < best)
         n_push = push.sum(dtype=jnp.int32)
         tree = state.tree + n_push.astype(jnp.int64)
+        if __debug__ and __import__("os").environ.get("TTS_DEBUG_STEP"):
+            # smuggle intermediates out via the balance counters
+            lv = jnp.arange(N) < live
+            hsum = jnp.where(lv, lb2h_c.reshape(-1), 0).sum(dtype=jnp.int64)
+            tsum = jnp.where(lv, lb2t.reshape(-1), 0).sum(dtype=jnp.int64)
+            state = state._replace(sent=hsum, recv=tsum,
+                                   steals=n_push.astype(jnp.int64))
 
-        # second compaction: direct prefix gather of the already-built
+        # final compaction: direct prefix gather of the already-built
         # block (sources are the compacted (features, N) arrays)
         perm2 = _partition(push)
-
-        def take2(idx):
-            idx = jax.lax.optimization_barrier(idx)
-            ch = jnp.take(children, idx, axis=1)
-            ax = jnp.take(aux_sched, idx, axis=1)
-            return jax.lax.optimization_barrier((ch, ax))
-
-        children, child_aux = _tiered_compact(take2, perm2, n_push, N)
+        children, child_aux = _tiered_compact(
+            take_block(children, caux), perm2, n_push, N)
         child_depth = child_aux[M].astype(jnp.int16)
     else:
         # --- bounds of the dense child grid (Pallas on TPU; the children
