@@ -107,6 +107,38 @@ def test_leaf_child_bound_is_makespan():
         assert got[b, 7] == inst.makespan(prmu[b])
 
 
+@pytest.mark.parametrize("jobs,machines", [(40, 8), (50, 10), (50, 20)])
+def test_lb2_multiword_bitmask_matches_scalar(jobs, machines):
+    """Wide instances (jobs > 31) take the multi-word scheduled-set
+    bitmask through the column-major LB2 path (sched_mask_cols +
+    lb2_cols) — the generalization of the single-int32 fast path that
+    previously dropped 50-job instances to the slow row-major scan."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pallas_expand
+
+    rng = np.random.default_rng(jobs + machines)
+    inst = PFSPInstance.synthetic(jobs=jobs, machines=machines, seed=jobs)
+    lb1 = ref.make_lb1_data(inst.p_times)
+    lb2 = ref.make_lb2_data(lb1)
+    tables = batched.make_tables(inst.p_times)
+    assert pallas_expand.sched_words(jobs) == 2
+
+    B = 8
+    prmu, depth = random_parents(jobs, B, rng)
+    front, _ = batched.parent_tables(tables, prmu, depth)
+    got = np.asarray(pallas_expand.expand_bounds_xla(
+        tables, jnp.asarray(prmu.T),
+        jnp.asarray(depth, dtype=jnp.int32)[None, :],
+        jnp.asarray(front).T, lb_kind=2))
+    got = got.reshape(jobs, B).T          # column c = i*B + b -> (B, J)
+    for b in range(B):
+        want = scalar_child_bounds(lb1, lb2, prmu[b], int(depth[b]), 2, jobs)
+        d = int(depth[b])
+        np.testing.assert_array_equal(got[b, d:], want[d:],
+                                      err_msg=f"parent {b}")
+
+
 def test_taillard_oracle_table_spotchecks():
     assert taillard.optimal_makespan(14) == 1377
     assert taillard.optimal_makespan(21) == 2297

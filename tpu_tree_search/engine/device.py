@@ -180,8 +180,8 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
 
     `idx` (t,) are child-column indices in expand()'s slot-major order
     (c = (g*J + i)*TB + b). Returns (child (J,t) int16,
-    caux (M+1,t) int32 = [child front | depth+1][, sched (1,t) int32
-    scheduled-set bitmask, jobs <= 31 only])."""
+    caux (M+1,t) int32 = [child front | depth+1][, sched (W,t) int32
+    multi-word scheduled-set bitmask, W = ceil(J/32)])."""
     J, B = p_prmu.shape
     M = p_aux.shape[0]
     t = idx.shape[0]
@@ -228,9 +228,17 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
     if not with_sched:
         return child, caux
     one = jnp.int32(1)
-    sched = jnp.sum(jnp.where(rows < pd, one << ppi, 0),
-                    axis=0, dtype=jnp.int32)[None, :] | (one << appended)
-    return child, caux, sched
+    words = []
+    for w in range(pallas_expand.sched_words(J)):
+        inw = (ppi >= 32 * w) & (ppi < 32 * (w + 1))
+        bit = one << jnp.where(inw, ppi - 32 * w, 0)
+        pmask = jnp.sum(jnp.where((rows < pd) & inw, bit, 0),
+                        axis=0, dtype=jnp.int32)[None, :]
+        ainw = (appended >= 32 * w) & (appended < 32 * (w + 1))
+        abit = jnp.where(
+            ainw, one << jnp.where(ainw, appended - 32 * w, 0), 0)
+        words.append(pmask | abit)
+    return child, caux, jnp.concatenate(words, axis=0)
 
 
 def _tiered_compact(gather, perm, n_keep, N: int):
@@ -272,10 +280,35 @@ def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
     return _tiered_compact(gather, perm, n_keep, N)
 
 
+def pop_chunk(state: SearchState, B: int, M: int):
+    """Pop window of up to B parents off the stack top (no commit; the
+    caller owns the cursor): the popBackBulk analogue. The window
+    [start, start+B) is contiguous, so dynamic_slice beats a gather.
+    Returns (p_prmu (J,B) i16, p_depth (1,B) i32, p_aux (M,B) i32,
+    n, start, valid)."""
+    J, capacity = state.prmu.shape
+    n = jnp.minimum(state.size, B)
+    start = state.size - n
+    valid = jnp.arange(B) < n
+    zero = jnp.zeros((), start.dtype)
+    p_prmu = jax.lax.dynamic_slice(state.prmu, (zero, start), (J, B))
+    p_depth = jax.lax.dynamic_slice(state.depth, (start,), (B,)) \
+        .astype(jnp.int32)
+    p_depth = jnp.where(valid, p_depth, 0)[None, :]            # (1, B)
+    p_aux = jax.lax.dynamic_slice(state.aux, (zero, start), (M, B))
+    return p_prmu, p_depth, p_aux, n, start, valid
+
+
 def step(tables: BoundTables, lb_kind: int, chunk: int,
-         state: SearchState, tile: int = 1024) -> SearchState:
+         state: SearchState, tile: int = 1024,
+         limit: int | None = None) -> SearchState:
     """One pop->bound->prune->branch cycle (the compiled analogue of the
-    reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320)."""
+    reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320).
+
+    `limit` tightens the usable-row bound below the default
+    row_limit(capacity, chunk, jobs) — the distributed loop reserves
+    extra headroom above it so balance-round block writes stay in bounds
+    (engine/distributed._balance_round)."""
     J, capacity = state.prmu.shape
     B = chunk
     assert capacity >= B, f"pool capacity {capacity} < chunk {B}"
@@ -290,17 +323,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     G = B // TB
     N = B * J
 
-    # --- pop up to B parents off the top (popBackBulk analogue); the pop
-    # window [start, start+B) is contiguous, so dynamic_slice beats a gather
-    n = jnp.minimum(state.size, B)
-    start = state.size - n
-    valid = jnp.arange(B) < n
+    p_prmu, p_depth, p_aux, n, start, valid = pop_chunk(state, B, M)
     zero = jnp.zeros((), start.dtype)
-    p_prmu = jax.lax.dynamic_slice(state.prmu, (zero, start), (J, B))
-    p_depth = jax.lax.dynamic_slice(state.depth, (start,), (B,)) \
-        .astype(jnp.int32)
-    p_depth = jnp.where(valid, p_depth, 0)[None, :]            # (1, B)
-    p_aux = jax.lax.dynamic_slice(state.aux, (zero, start), (M, B))
 
     # --- masks in the kernel's child-slot column order
     depth_c = _col_major(p_depth, G, J, TB)                    # (1, N)
@@ -382,6 +406,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # explored trees are bit-identical to the single-sweep path.
         P = int(tables.ma0.shape[0])
         KH = batched.PAIR_PREFILTER
+        SW = pallas_expand.sched_words(J)
         if P > 2 * KH:
             head_t, tail_t = batched.pair_split(tables, KH)
             lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
@@ -393,13 +418,14 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             children, aux_plus = _tiered_compact(
                 take_block(children, aux_plus), permh, nkeep, N)
             caux = aux_plus[:M + 1]
-            sched = aux_plus[M + 1:M + 2]
-            lb2h_c = aux_plus[M + 2:M + 3]
+            sched = aux_plus[M + 1:M + 1 + SW]
+            lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
             lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
             lb2b = jnp.maximum(lb2h_c, lb2t)
             live = nkeep
         else:
             lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
+            lb2h_c = lb2t = lb2b    # debug-block fallbacks (no prefilter)
             live = ncand
 
         push = (jnp.arange(N) < live) & (lb2b.reshape(-1) < best)
@@ -452,7 +478,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             tables, p_prmu, p_depth, p_aux, perm, n_push, TB, N)
         child_depth = child_aux[M].astype(jnp.int16)
 
-    limit = row_limit(capacity, B, J)
+    if limit is None:
+        limit = row_limit(capacity, B, J)
     new_size = start + n_push
 
     # An overflowing step must NOT commit: advancing the cursor past the
@@ -533,14 +560,18 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            tile: int = 1024) -> SearchResult:
     """Host entry point: build tables, run, fetch counters.
 
-    Retries with doubled capacity on overflow rather than failing — the
-    static-shape replacement for the reference's realloc-on-push.
+    On overflow the pool is re-homed into double the capacity and the
+    search RESUMES from exactly where it stopped (checkpoint.grow) — the
+    lossless static-shape replacement for the reference's
+    realloc-on-push (round 1 restarted from scratch here).
     """
+    from . import checkpoint
+
     if tables is None:
         tables = batched.make_tables(p_times)
     jobs = p_times.shape[1]
+    state = init_state(jobs, capacity, init_ub, p_times=p_times)
     while True:
-        state = init_state(jobs, capacity, init_ub, p_times=p_times)
         out = run(tables, state, lb_kind, chunk, max_iters, tile=tile)
         if not bool(out.overflow):
             return SearchResult(
@@ -550,3 +581,4 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 complete=int(out.size) == 0,
             )
         capacity *= 2
+        state = checkpoint.grow(out, capacity)

@@ -81,7 +81,8 @@ def _calibrate_pair_order(p, ma0, ma1, js, pt0, pt1, lag, min_tails,
     P = len(ma0)
     rng = np.random.default_rng(seed)
     prmu = np.argsort(rng.random((n_samples, J)), axis=1)
-    depth = rng.integers(max(1, J // 4), J - 1, n_samples)
+    lo = max(1, J // 4)
+    depth = rng.integers(lo, max(lo + 1, J - 1), n_samples)
 
     front = np.zeros((n_samples, M), np.int64)
     for q in range(J - 1):
@@ -92,15 +93,14 @@ def _calibrate_pair_order(p, ma0, ma1, js, pt0, pt1, lag, min_tails,
         for k in range(1, M):
             c[:, k] = np.maximum(c[:, k - 1], front[:, k]) + pj[:, k]
         front = np.where(act[:, None], c, front)
-    sched = np.zeros(n_samples, np.int64)
-    for q in range(J):
-        sched |= np.where(q < depth,
-                          1 << prmu[:, q].astype(np.int64), 0)
+    # job v is scheduled iff its position in the permutation < depth
+    # (a bool matrix, not a bitmask — no word-size cliff at any J)
+    sched = np.argsort(prmu, axis=1) < depth[:, None]   # (n, J)
 
     t0 = front[:, ma0].T.astype(np.int64).copy()      # (P, n)
     t1 = front[:, ma1].T.astype(np.int64).copy()
     for j in range(J):
-        active = ((sched[None, :] >> js[:, j][:, None]) & 1) == 0
+        active = ~sched[:, js[:, j]].T                # (P, n)
         n0 = t0 + pt0[:, j][:, None]
         n1 = np.maximum(t1, n0 + lag[:, j][:, None]) + pt1[:, j][:, None]
         t0 = np.where(active, n0, t0)
@@ -135,10 +135,9 @@ def make_tables(p_times: np.ndarray) -> BoundTables:
     pt0 = p[ma0[:, None], js]
     pt1 = p[ma1[:, None], js]
     lag = np.take_along_axis(lb2.lags, lb2.johnson_schedules, axis=1)
-    # calibrate only when the prefilter can consume the order: it needs
-    # the scheduled-set bitmask (jobs <= 31; the int64 shifts here would
-    # silently overflow past 64 jobs) and enough pairs to split
-    if p.shape[1] <= 31 and len(ma0) > 2 * PAIR_PREFILTER:
+    # calibrate only when the prefilter can consume the order (enough
+    # pairs to split into a strong head and a tail)
+    if len(ma0) > 2 * PAIR_PREFILTER and p.shape[1] >= 3:
         order = _calibrate_pair_order(p, ma0, ma1, js, pt0, pt1, lag,
                                       np.asarray(lb1.min_tails))
     else:

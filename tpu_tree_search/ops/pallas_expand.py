@@ -258,17 +258,32 @@ def expand_bounds_tpu(tables: BoundTables, prmu_T, depth2, front_T,
 def kernel_ok(jobs: int, eff_tile: int, lb_kind: int) -> bool:
     """THE eligibility rule for the Pallas expand kernels — shared by
     expand(), expand_bounds() and device.step's two-phase gate so the
-    dispatch can never diverge between them. LB2 additionally requires
-    jobs <= 31 (the scheduled-set bitmask carries one bit per job)."""
+    dispatch can never diverge between them. The scheduled-set bitmask is
+    multi-word (ceil(jobs/32) int32 rows) so LB2 has no job-count cliff;
+    whether the pair sweep itself runs as the Pallas kernel or the XLA
+    bitmask path is lb2_bounds' own VMEM decision (lb2_kernel_fits)."""
     if jax.default_backend() != "tpu":
         return False
     lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
-    ok = (eff_tile >= MIN_PALLAS_TILE
-          and eff_tile % 128 == 0          # lane-aligned reshapes
-          and jobs * eff_tile <= lane_cap)
-    if lb_kind == 2:
-        ok = ok and jobs <= 31
-    return ok
+    return (eff_tile >= MIN_PALLAS_TILE
+            and eff_tile % 128 == 0          # lane-aligned reshapes
+            and jobs * eff_tile <= lane_cap)
+
+
+def sched_words(jobs: int) -> int:
+    """Rows of the scheduled-set bitmask: one int32 word per 32 jobs."""
+    return (jobs + 31) // 32
+
+
+LB2_ONEHOT_VMEM = 4 << 20
+
+
+def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
+    """The pair-sweep kernel keeps its (J, P, J) f32 per-step job one-hot
+    resident in VMEM; past ~4 MB it cannot share VMEM with the column
+    tiles (covers every class through 50xM and 100x5/100x10; wider
+    instances take the XLA bitmask path, lb2_cols, instead)."""
+    return jobs * pairs * jobs * 4 <= LB2_ONEHOT_VMEM
 
 
 def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
@@ -300,16 +315,18 @@ def lb2_cols(tables: BoundTables, sched_mask, child_front_cols):
     batched.lb2_from_parts leaves most lanes idle and materializes its
     scan carries every step).
 
-    The child-unscheduled test is one shift of a scheduled-set bitmask
-    (one int32 per child; requires jobs <= 31 — true for every 20-job
-    benchmark class), so no job-position gathers are needed.
+    The child-unscheduled test is one shift of a multi-word scheduled-set
+    bitmask (ceil(J/32) int32 words per child), so no job-position
+    gathers are needed — one word row covers every 20-job class, two the
+    50-job north-star classes.
 
-    sched_mask: (1, N) int32, bit v set iff job v is scheduled in the
-    child (parent prefix + appended job); child_front_cols: (M, N) int32.
-    Returns (1, N) int32 bounds.
+    sched_mask: (W, N) int32, bit (v % 32) of word (v // 32) set iff job
+    v is scheduled in the child (parent prefix + appended job);
+    child_front_cols: (M, N) int32. Returns (1, N) int32 bounds.
     """
     t = tables
     J = t.js.shape[1]
+    W = sched_mask.shape[0]
     one = jnp.int32(1)
 
     # pair-machine selection as one-hot matmuls (dynamic row gathers of
@@ -324,7 +341,11 @@ def lb2_cols(tables: BoundTables, sched_mask, child_front_cols):
                    preferred_element_type=jnp.float32).astype(jnp.int32)
     for j in range(J):
         jsj = t.js[:, j][:, None]                       # (P, 1)
-        active = ((sched_mask >> jsj) & one) == 0       # (P, N)
+        if W == 1:
+            active = ((sched_mask >> jsj) & one) == 0   # (P, N)
+        else:
+            word = jnp.take(sched_mask, jsj[:, 0] // 32, axis=0)  # (P, N)
+            active = ((word >> (jsj % 32)) & one) == 0
         new0 = tmp0 + t.ptm0_js[:, j][:, None]
         new1 = jnp.maximum(tmp1, new0 + t.lag_js[:, j][:, None]) \
             + t.ptm1_js[:, j][:, None]
@@ -376,20 +397,25 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
 
 def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
     """LB2 over child columns from the scheduled-set bitmask: Pallas
-    pair-sweep kernel when a legal column tile exists, the XLA bitmask
-    path (lb2_cols) otherwise. child_front_cols (M, N) i32,
-    sched_mask (1, N) i32 -> (1, N) i32 bounds.
+    pair-sweep kernel when a legal column tile exists and the pair tables
+    fit VMEM, the XLA bitmask path (lb2_cols) otherwise.
+    child_front_cols (M, N) i32, sched_mask (W, N) i32 -> (1, N) i32.
 
     THE single entry point for column-major LB2 — both device.step's
     two-phase tiers and expand()'s one-shot path go through here, so the
     tile rule and the fallback cannot diverge."""
     N = child_front_cols.shape[1]
     J = tables.js.shape[1]
+    P = int(tables.ma0.shape[0])
     nt = min(4096, N & -N)
-    if jax.default_backend() != "tpu" or nt < MIN_PALLAS_TILE:
+    if (jax.default_backend() != "tpu" or nt < MIN_PALLAS_TILE
+            or not lb2_kernel_fits(J, P)):
         return lb2_cols(tables, sched_mask, child_front_cols)
-    unsched = (((sched_mask >> jnp.arange(J, dtype=jnp.int32)[:, None])
-                & jnp.int32(1)) == 0).astype(jnp.float32)
+    vj = jnp.arange(J, dtype=jnp.int32)
+    word = (sched_mask if sched_mask.shape[0] == 1
+            else jnp.take(sched_mask, vj // 32, axis=0))       # (J|1, N)
+    unsched = (((word >> (vj % 32)[:, None]) & jnp.int32(1)) == 0) \
+        .astype(jnp.float32)                                   # (J, N)
     return lb2_bounds_tpu(tables, child_front_cols, unsched, tile=nt)
 
 
@@ -462,19 +488,14 @@ def _xla_parts(tables: BoundTables, prmu_T, depth2, front_T):
 
 def _bounds_rows(tables: BoundTables, lb_kind: int, prmu, depth, front,
                  remain, child_front, child_p):
-    """(B, J) bounds from the row-major parts, or None for the LB2
-    bitmask fast path (J <= 31), which the callers evaluate column-major
-    via lb2_cols on the child fronts."""
+    """(B, J) bounds from the row-major parts, or None for LB2, which the
+    callers evaluate column-major via lb2_cols on the child fronts (the
+    multi-word bitmask covers any job count)."""
     from . import batched
 
     B, J = prmu.shape
     mask = jnp.ones((B, J), bool)
     if lb_kind == 2:
-        if J > 31:
-            # bitmask fast path needs one bit per job; wide instances
-            # keep the scan-based fallback
-            return batched.lb2_from_parts(tables, prmu, depth,
-                                          child_front, mask)
         return None
     if lb_kind == 1:
         return batched.lb1_from_parts(
@@ -566,22 +587,31 @@ def effective_tile(jobs: int, batch: int, tile: int = 1024,
 
 
 def sched_mask_cols(prmu_T, depth2, tile: int):
-    """(1, N) int32 per-child scheduled-set bitmask in the expand column
-    order (c = (g*J + i)*TB + b): the parent's prefix bits plus the
-    appended job's bit. Requires jobs <= 31."""
+    """(W, N) int32 per-child scheduled-set bitmask in the expand column
+    order (c = (g*J + i)*TB + b), W = ceil(J/32) words: the parent's
+    prefix bits plus the appended job's bit; bit (v % 32) of word
+    (v // 32) stands for job v."""
     J, B = prmu_T.shape
+    W = sched_words(J)
     G = B // tile
     N = B * J
     one = jnp.int32(1)
-    appended = prmu_T.reshape(J, G, tile).transpose(1, 0, 2) \
-        .reshape(1, N).astype(jnp.int32)
-    pmask = jnp.sum(
-        jnp.where(jax.lax.broadcasted_iota(jnp.int32, (J, B), 0) < depth2,
-                  one << prmu_T.astype(jnp.int32), 0),
-        axis=0, dtype=jnp.int32)[None, :]              # (1, B)
-    pmask_c = jnp.broadcast_to(
-        pmask.reshape(G, 1, tile), (G, J, tile)).reshape(1, N)
-    return pmask_c | (one << appended)
+    ppi = prmu_T.astype(jnp.int32)
+    appended = ppi.reshape(J, G, tile).transpose(1, 0, 2).reshape(1, N)
+    in_prefix = jax.lax.broadcasted_iota(jnp.int32, (J, B), 0) < depth2
+    words = []
+    for w in range(W):
+        inw = (ppi >= 32 * w) & (ppi < 32 * (w + 1))
+        bit = one << jnp.where(inw, ppi - 32 * w, 0)
+        pmask = jnp.sum(jnp.where(in_prefix & inw, bit, 0),
+                        axis=0, dtype=jnp.int32)[None, :]      # (1, B)
+        pmask_c = jnp.broadcast_to(
+            pmask.reshape(G, 1, tile), (G, J, tile)).reshape(1, N)
+        ainw = (appended >= 32 * w) & (appended < 32 * (w + 1))
+        abit = jnp.where(
+            ainw, one << jnp.where(ainw, appended - 32 * w, 0), 0)
+        words.append(pmask_c | abit)
+    return jnp.concatenate(words, axis=0)
 
 
 def expand(tables: BoundTables, prmu_T, depth2, front_T,
@@ -608,7 +638,7 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
         if nt >= MIN_PALLAS_TILE:
             children, aux, _ = expand_tpu(tables, prmu_T, depth2, front_T,
                                           lb_kind=1, tile=eff_tile)
-            sched = sched_mask_cols(prmu_T, depth2, eff_tile)  # (1, N)
+            sched = sched_mask_cols(prmu_T, depth2, eff_tile)  # (W, N)
             M = tables.p.shape[0]
             bounds = lb2_bounds(tables, aux[:M], sched)
             return children, aux, bounds
