@@ -1,0 +1,175 @@
+"""Distributed durability: lossless overflow growth, stacked
+checkpoint/resume, segmented driving with per-worker heartbeat, and the
+water-filling balance plan.
+
+This is the layer the reference lacks entirely (SURVEY.md §5:
+"Checkpoint/resume: none"; its only stall tooling is a 10-second
+"Still Idle" print, pfsp_dist_multigpu_cuda.c:663-668). Round 1 had it
+single-device only; a distributed overflow restarted from the warm-up
+frontier, discarding all explored work — these tests pin the lossless
+behavior that replaced it.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import checkpoint, distributed, sequential as seq
+from tpu_tree_search.parallel import balance as bal
+from tpu_tree_search.problems.pfsp import PFSPInstance
+
+
+def test_exchange_plan_multi_receiver():
+    """One hot worker must feed several starving workers in one round
+    (the round-1 pairing fed exactly one receiver per donor)."""
+    import jax.numpy as jnp
+
+    sizes = jnp.asarray([100, 0, 0, 0], jnp.int32)
+    plan = np.asarray(bal.exchange_plan(sizes, cap=64, min_transfer=4))
+    assert plan[0].sum() > 0
+    assert (plan[0] > 0).sum() >= 2        # multiple receivers
+    assert plan[0, 0] == 0                 # no self-flow
+    # donors never give more than half their surplus
+    assert plan[0].sum() <= (100 - 25) // 2
+
+
+def test_exchange_plan_balanced_is_empty():
+    import jax.numpy as jnp
+
+    sizes = jnp.asarray([50, 52, 49, 51], jnp.int32)
+    plan = np.asarray(bal.exchange_plan(sizes, cap=64, min_transfer=8))
+    assert plan.sum() == 0
+
+
+def _counting_grow(monkeypatch):
+    calls = []
+    orig_grow = checkpoint.grow
+
+    def counting(state, new_capacity):
+        calls.append(new_capacity)
+        return orig_grow(state, new_capacity)
+
+    monkeypatch.setattr(checkpoint, "grow", counting)
+    return calls
+
+
+def test_dist_overflow_grows_and_resumes_losslessly(monkeypatch):
+    """A pool that must overflow mid-run grows and RESUMES with no node
+    lost or duplicated. N-Queens is the exact oracle for this: no
+    incumbent, so tree/sol counts are invariant to exploration order —
+    any lost (or doubled) subtree would shift them. Balancing is
+    disabled (huge min_transfer) and the warm-up stripe sized near the
+    limit so the pools MUST overflow mid-run."""
+    from tpu_tree_search.engine import nqueens_device
+
+    calls = _counting_grow(monkeypatch)
+    kw = dict(chunk=4, n_devices=2, min_seed=200, min_transfer=10**6)
+    small = nqueens_device.search_distributed(10, capacity=1 << 8, **kw)
+    assert calls, "tiny pool never overflowed — capacity too generous " \
+                  "for the test to exercise the grow path"
+    big = nqueens_device.search_distributed(10, capacity=1 << 15, **kw)
+    assert (small.explored_tree, small.explored_sol) == \
+           (big.explored_tree, big.explored_sol) == (35538, 724)
+
+
+def test_dist_pfsp_overflow_grow_still_optimal(monkeypatch):
+    """PFSP with ub=inf through the overflow-grow path still proves the
+    optimum (with a live incumbent the exact tree shape is schedule-
+    dependent — as in the reference's threaded runs — so the invariant
+    checked is optimality + completion, not node counts)."""
+    inst = PFSPInstance.synthetic(jobs=11, machines=4, seed=11)
+    kw = dict(lb_kind=0, init_ub=None, chunk=8, transfer_cap=8, min_seed=8)
+    big = distributed.search(inst.p_times, capacity=1 << 14, **kw)
+    calls = _counting_grow(monkeypatch)
+    small = distributed.search(inst.p_times, capacity=1 << 8, **kw)
+    assert calls, "tiny pool never overflowed"
+    assert small.complete
+    assert small.best == big.best
+
+
+def test_dist_segmented_checkpoint_resume(tmp_path):
+    """Kill/resume a multi-device run: a checkpointed truncated run,
+    resumed to completion, reproduces the uninterrupted totals exactly."""
+    inst = PFSPInstance.synthetic(jobs=9, machines=4, seed=7)
+    kw = dict(lb_kind=1, init_ub=None, chunk=4, capacity=1 << 12,
+              min_seed=8)
+    full = distributed.search(inst.p_times, **kw)
+
+    ckpt = tmp_path / "dist.npz"
+    part = distributed.search(inst.p_times, **kw, segment_iters=3,
+                              checkpoint_path=str(ckpt), max_rounds=6,
+                              heartbeat=None)
+    assert ckpt.exists()
+    assert not part.complete
+
+    reports = []
+    res = distributed.search(inst.p_times, **kw, segment_iters=64,
+                             checkpoint_path=str(ckpt),
+                             heartbeat=reports.append)
+    assert res.complete
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (full.explored_tree, full.explored_sol, full.best)
+    # per-worker heartbeat surfaced (8 virtual workers)
+    assert reports and reports[0].per_worker is not None
+    assert len(reports[0].per_worker["size"]) == 8
+    assert len(reports[0].per_worker["steals"]) == 8
+
+
+def test_dist_checkpoint_resume_mesh_mismatch(tmp_path):
+    """Resuming on a different worker count fails loudly, not wrongly."""
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=5)
+    ckpt = tmp_path / "dist8.npz"
+    distributed.search(inst.p_times, lb_kind=1, init_ub=None, chunk=4,
+                       capacity=1 << 12, min_seed=8, segment_iters=2,
+                       checkpoint_path=str(ckpt), max_rounds=2,
+                       heartbeat=None)
+    assert ckpt.exists()
+    with pytest.raises(ValueError, match="worker count"):
+        distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                           n_devices=2, chunk=4, capacity=1 << 12,
+                           checkpoint_path=str(ckpt), heartbeat=None)
+
+
+def test_grow_stacked_state():
+    """checkpoint.grow re-homes stacked (D, jobs, cap) pools."""
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=3)
+    res = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             chunk=4, capacity=1 << 12, min_seed=8)
+    del res  # only needed the import path warm; build a tiny fake state
+    from tpu_tree_search.engine.device import SearchState
+
+    import jax.numpy as jnp
+    D, J, cap, M = 4, 8, 64, 4
+    s = SearchState(
+        prmu=jnp.zeros((D, J, cap), jnp.int16),
+        depth=jnp.zeros((D, cap), jnp.int16),
+        aux=jnp.zeros((D, M, cap), jnp.int32),
+        size=jnp.full((D,), 5, jnp.int32),
+        best=jnp.full((D,), 99, jnp.int32),
+        tree=jnp.full((D,), 7, jnp.int64),
+        sol=jnp.zeros((D,), jnp.int64),
+        iters=jnp.zeros((D,), jnp.int64),
+        evals=jnp.zeros((D,), jnp.int64),
+        sent=jnp.zeros((D,), jnp.int64),
+        recv=jnp.zeros((D,), jnp.int64),
+        steals=jnp.zeros((D,), jnp.int64),
+        overflow=jnp.ones((D,), bool),
+    )
+    g = checkpoint.grow(s, 256)
+    assert g.prmu.shape == (D, J, 256)
+    assert g.depth.shape == (D, 256)
+    assert g.aux.shape == (D, M, 256)
+    assert not np.asarray(g.overflow).any()
+    assert (np.asarray(g.tree) == 7).all()
+
+
+def test_dist_ub_opt_unchanged_counts():
+    """The new balance plan + transactional rounds keep the ub=opt
+    deterministic-tree invariant vs the sequential oracle."""
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=0)
+    opt = inst.brute_force_optimum()
+    want = seq.pfsp_search(inst, lb=2, init_ub=opt)
+    got = distributed.search(inst.p_times, lb_kind=2, init_ub=opt,
+                             chunk=8, capacity=1 << 12, min_seed=4,
+                             balance_period=2, min_transfer=2)
+    assert (got.explored_tree, got.explored_sol, got.best) == \
+           (want.explored_tree, want.explored_sol, want.best)

@@ -28,6 +28,16 @@ from .device import SearchState
 POOL_FIELDS = ("prmu", "depth", "aux")
 
 
+def _to_np(x) -> np.ndarray:
+    """Host copy of a (possibly multihost-sharded) array: plain asarray
+    single-controller; allgather the global value under multi-controller
+    (where np.asarray on non-addressable shards raises)."""
+    if not getattr(x, "is_fully_addressable", True):
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
 def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
     """Snapshot a search state (single-device or stacked distributed).
 
@@ -38,13 +48,13 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     than the segments they protected). The declared capacity is kept in
     the file so load() re-homes the rows into an identical pool.
     """
-    sizes = np.atleast_1d(np.asarray(state.size))
+    sizes = np.atleast_1d(_to_np(state.size))
     n = int(sizes.max())
     arrays = {}
     for f, x in zip(SearchState._fields, state):
         if f in POOL_FIELDS:
             x = x[..., :n]               # feature-major: row axis is last
-        arrays[f] = np.asarray(x)
+        arrays[f] = _to_np(x)
     arrays["meta_capacity"] = np.asarray(state.prmu.shape[-1])
     arrays["meta_pool_layout"] = np.asarray(1)   # 1 = feature-major
     if meta:
@@ -126,26 +136,26 @@ class PoolOverflow(RuntimeError):
 
 
 def grow(state: SearchState, new_capacity: int) -> SearchState:
-    """Re-home a (single-device) search state into a larger pool — the
-    recovery path after an overflow abort: load the checkpoint, grow, rerun.
-    """
-    prmu = np.asarray(state.prmu)
-    if prmu.ndim != 2:
-        raise ValueError("grow() supports single-device states only")
-    jobs, capacity = prmu.shape
+    """Re-home a search state — single-device (jobs, cap) or stacked
+    distributed (D, jobs, cap) — into a larger pool, clearing the
+    overflow flag(s): the recovery path after an overflow abort (load or
+    fetch, grow, resume). Rows above each cursor are garbage by the pool
+    invariant, so growth is zero-padding the row axis."""
+    capacity = np.asarray(state.prmu).shape[-1]
     if new_capacity < capacity:
         raise ValueError(f"new_capacity {new_capacity} < current {capacity}")
-    new_prmu = np.zeros((jobs, new_capacity), dtype=prmu.dtype)
-    new_depth = np.zeros(new_capacity, dtype=np.asarray(state.depth).dtype)
-    aux = np.asarray(state.aux)
-    new_aux = np.zeros((aux.shape[0], new_capacity), dtype=aux.dtype)
-    new_prmu[:, :capacity] = prmu
-    new_depth[:capacity] = np.asarray(state.depth)
-    new_aux[:, :capacity] = aux
-    return state._replace(prmu=jnp.asarray(new_prmu),
-                          depth=jnp.asarray(new_depth),
-                          aux=jnp.asarray(new_aux),
-                          overflow=jnp.asarray(False))
+    pad = new_capacity - capacity
+
+    def pad_rows(x):
+        x = np.asarray(x)
+        widths = [(0, 0)] * (x.ndim - 1) + [(0, pad)]
+        return jnp.asarray(np.pad(x, widths))
+
+    ovf = np.zeros_like(np.asarray(state.overflow))
+    return state._replace(prmu=pad_rows(state.prmu),
+                          depth=pad_rows(state.depth),
+                          aux=pad_rows(state.aux),
+                          overflow=jnp.asarray(ovf))
 
 
 @dataclasses.dataclass
@@ -157,6 +167,10 @@ class SegmentReport:
     best: int
     pool_size: int
     elapsed: float
+    # distributed runs: per-worker live sizes / cumulative steal counts /
+    # incumbents (the heartbeat surface the reference's "Still Idle"
+    # print, dist:663-668, only hints at); None on single-device runs
+    per_worker: dict | None = None
 
 
 def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
@@ -165,7 +179,8 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   heartbeat=print, max_segments: int | None = None,
                   max_total_iters: int | None = None,
                   stall_limit: int = 3,
-                  raise_on_overflow: bool = True):
+                  raise_on_overflow: bool = True,
+                  checkpoint_meta: dict | None = None):
     """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
     bounded segments.
 
@@ -189,28 +204,36 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     t0 = time.perf_counter()
     seg = 0
     stalls = 0
-    start_iters = int(np.asarray(state.iters).max())
+    start_iters = int(_to_np(state.iters).max())
     last = (start_iters, -1, -1)
+    meta_base = dict(checkpoint_meta or {})
     while True:
         target = start_iters + (seg + 1) * segment_iters
         if max_total_iters is not None:
             target = min(target, start_iters + max_total_iters)
         state = run_fn(state, target)
         seg += 1
-        iters = int(np.asarray(state.iters).max())
-        tree = int(np.asarray(state.tree).sum())
-        sol = int(np.asarray(state.sol).sum())
-        size = int(np.asarray(state.size).sum())
+        iters = int(_to_np(state.iters).max())
+        tree = int(_to_np(state.tree).sum())
+        sol = int(_to_np(state.sol).sum())
+        sizes = _to_np(state.size)
+        size = int(sizes.sum())
         if heartbeat is not None:
+            per_worker = None
+            if sizes.ndim:                      # stacked distributed state
+                per_worker = {"size": sizes.tolist(),
+                              "steals": _to_np(state.steals).tolist(),
+                              "best": _to_np(state.best).tolist()}
             heartbeat(SegmentReport(
                 segment=seg, iters=iters, tree=tree, sol=sol,
-                best=int(np.asarray(state.best).min()), pool_size=size,
-                elapsed=time.perf_counter() - t0))
+                best=int(_to_np(state.best).min()), pool_size=size,
+                elapsed=time.perf_counter() - t0, per_worker=per_worker))
         if checkpoint_path and seg % checkpoint_every == 0:
-            save(checkpoint_path, state, meta={"segment": seg})
-        if bool(np.asarray(state.overflow).any()):
+            save(checkpoint_path, state, meta={**meta_base, "segment": seg})
+        if bool(_to_np(state.overflow).any()):
             if checkpoint_path and seg % checkpoint_every != 0:
-                save(checkpoint_path, state, meta={"segment": seg})
+                save(checkpoint_path, state,
+                     meta={**meta_base, "segment": seg})
             if raise_on_overflow:
                 hint = (f"resume from {checkpoint_path} with a larger "
                         "capacity" if checkpoint_path else

@@ -49,6 +49,24 @@ AX = WORKER_AXIS
 # Step 1: host BFS warm-up (breadth generates parallelism; reference runs
 # this replicated on every rank, dist:198-205 — here once on the host)
 
+_native_warned = False
+
+
+def _warn_native_unavailable(e: Exception) -> None:
+    """A broken native toolchain must degrade LOUDLY, not silently — the
+    pure-Python warm-up produces identical results but is orders of
+    magnitude slower, which would otherwise look like a perf regression
+    with no cause."""
+    global _native_warned
+    if not _native_warned:
+        _native_warned = True
+        import warnings
+        warnings.warn(
+            f"native host runtime unavailable ({e!r}); falling back to "
+            "the pure-Python warm-up (identical results, much slower). "
+            "Check `g++` and tpu_tree_search/native/__init__.py:build.",
+            RuntimeWarning, stacklevel=3)
+
 
 @dataclasses.dataclass
 class Frontier:
@@ -76,8 +94,8 @@ def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
                 p_times, lb_kind, init_ub, target)
             return Frontier(prmu=prmu, depth=depth, tree=tree, sol=sol,
                             best=best)
-        except Exception:
-            pass  # fall through to the Python implementation
+        except Exception as e:
+            _warn_native_unavailable(e)  # loud fallback, same results
     jobs = p_times.shape[1]
     lb1 = ref.make_lb1_data(p_times)
     lb2 = ref.make_lb2_data(lb1) if lb_kind == seq.LB2 else None
@@ -126,66 +144,109 @@ def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
 def _balance_round(s: SearchState, transfer_cap: int,
                    min_transfer: int, limit: int) -> SearchState:
     """One collective steal-half exchange (see parallel/balance.py).
-    `limit` is the usable-row bound (device.row_limit) every commit must
-    respect so the engine's block writes stay in bounds."""
+
+    `limit` is the usable-row bound every commit must respect; the loop
+    builder reserves `D * transfer_cap` rows of headroom above it (and
+    runs the local steps against the same tightened limit), so the
+    receive block write is ALWAYS in bounds — an overflowing round never
+    clamps onto live rows.
+
+    The round is globally transactional: each worker's would-overflow
+    flag (known before any data moves — a worker receives exactly
+    plan[:, me].sum() nodes) is psum'd, and if any worker would
+    overflow, no worker exchanges or commits. The loop then exits on the
+    overflow flag and the driver grows every pool and RESUMES from this
+    state, losing nothing.
+
+    The pack/exchange/unpack (the gathers, the all_to_all, the sort) is
+    cond-gated on the plan being non-empty and fitting — a balanced
+    steady state pays one all_gather of the sizes, one tiny psum, and a
+    zero-block scratch write.
+    """
     J, capacity = s.prmu.shape
+    A = s.aux.shape[0]
     D = jax.lax.psum(1, AX)
     sizes = jax.lax.all_gather(s.size, AX)                  # (D,)
     plan = bal.exchange_plan(sizes, transfer_cap, min_transfer)
     me = jax.lax.axis_index(AX)
     my_out = plan[me]                                       # (D,)
     total_out = my_out.sum(dtype=jnp.int32)
-
-    # pack donated nodes (from the stack top) into per-receiver blocks
-    offs = jnp.cumsum(my_out, dtype=jnp.int32) - my_out     # exclusive prefix
+    total_in = plan[:, me].sum(dtype=jnp.int32)
     base = s.size - total_out
-    k = jnp.arange(transfer_cap, dtype=jnp.int32)
-    rows = base + offs[:, None] + k[None, :]                # (D, cap)
-    send_mask = k[None, :] < my_out[:, None]
-    rows_c = jnp.clip(rows, 0, capacity - 1).reshape(-1)    # (D*cap,)
-    buf_prmu = jnp.take(s.prmu, rows_c, axis=1)             # (J, D*cap)
-    buf_aux = jnp.take(s.aux, rows_c, axis=1)               # (A, D*cap)
-    buf_depth = jnp.where(send_mask.reshape(-1),
-                          s.depth[rows_c], -1)[None, :]     # -1 = hole
+    n_recv = plan.shape[0] * transfer_cap
+    # Would-overflow is known BEFORE the exchange (each worker receives
+    # exactly plan[:, me].sum() nodes) and is decided globally: if ANY
+    # worker would overflow, NO worker exchanges or commits — every node
+    # keeps living in exactly one pool, the loop exits on the flag, and
+    # the driver grows every pool and resumes losslessly (the round-1
+    # design restarted from the warm-up frontier, discarding all
+    # explored work).
+    ovf = jax.lax.psum((base + total_in > limit).astype(jnp.int32), AX) > 0
+    # identical on every worker (plan and ovf are pure functions of the
+    # all_gathered sizes), so the cond below cannot diverge across the
+    # mesh and the collectives inside it are safe
+    do_flow = (plan.sum() > 0) & ~ovf
 
-    # all_to_all exchanges the per-receiver blocks (the D axis must be
-    # the split axis exactly)
-    def exchange(x):
-        rows = x.shape[0]
-        blocks = x.reshape(rows, D, transfer_cap)
-        return jax.lax.all_to_all(blocks, AX, 1, 1) \
-            .reshape(rows, D * transfer_cap)
+    def do_exchange(_):
+        # pack donated nodes (from the stack top) into per-receiver blocks
+        offs = jnp.cumsum(my_out, dtype=jnp.int32) - my_out
+        k = jnp.arange(transfer_cap, dtype=jnp.int32)
+        rows = base + offs[:, None] + k[None, :]            # (D, cap)
+        send_mask = k[None, :] < my_out[:, None]
+        rows_c = jnp.clip(rows, 0, capacity - 1).reshape(-1)
+        buf_prmu = jnp.take(s.prmu, rows_c, axis=1)         # (J, D*cap)
+        buf_aux = jnp.take(s.aux, rows_c, axis=1)           # (A, D*cap)
+        buf_depth = jnp.where(send_mask.reshape(-1),
+                              s.depth[rows_c], -1)[None, :]  # -1 = hole
 
-    rbuf_prmu = exchange(buf_prmu)
-    rbuf_aux = exchange(buf_aux)
-    rbuf_depth = exchange(buf_depth)
+        # all_to_all exchanges the per-receiver blocks (the D axis must
+        # be the split axis exactly)
+        def exchange(x):
+            rows = x.shape[0]
+            blocks = x.reshape(rows, D, transfer_cap)
+            return jax.lax.all_to_all(blocks, AX, 1, 1) \
+                .reshape(rows, D * transfer_cap)
 
-    # push received nodes (compacting column gather + block write onto
-    # the new top, same scatter-free scheme as device.step)
-    flat_depth = rbuf_depth.reshape(-1)
-    push = flat_depth >= 0
-    n_push = push.sum(dtype=jnp.int32)
-    order = jnp.argsort(~push, stable=True)
-    recv_prmu = jnp.take(rbuf_prmu, order, axis=1)
-    recv_aux = jnp.take(rbuf_aux, order, axis=1)
-    recv_depth = jnp.take(flat_depth, order).astype(jnp.int16)
-    new_size = base + n_push
-    n_recv = flat_depth.shape[0]
-    # The block write needs n_recv free columns above `base`; when it
-    # would clamp (or the cursor would pass the limit) the overflow flag
-    # aborts the round and the caller restarts with a larger pool — a
-    # distributed overflow always restarts from the frontier, so the
-    # clamped write never feeds a resumed search.
-    ovf = (base + n_recv > capacity) | (new_size > limit)
+        rbuf_prmu = exchange(buf_prmu)
+        rbuf_aux = exchange(buf_aux)
+        rbuf_depth = exchange(buf_depth)
+
+        # compact received nodes to the front of the block (same
+        # scatter-free scheme as device.step)
+        flat_depth = rbuf_depth.reshape(-1)
+        push = flat_depth >= 0
+        order = jnp.argsort(~push, stable=True)
+        return (jnp.take(rbuf_prmu, order, axis=1),
+                jnp.take(rbuf_aux, order, axis=1),
+                jnp.take(flat_depth, order).astype(jnp.int16),
+                push.sum(dtype=jnp.int32))
+
+    def no_exchange(_):
+        return (jnp.zeros((J, n_recv), s.prmu.dtype),
+                jnp.zeros((A, n_recv), s.aux.dtype),
+                jnp.full((n_recv,), -1, s.depth.dtype),
+                jnp.int32(0))
+
+    recv_prmu, recv_aux, recv_depth, n_push = jax.lax.cond(
+        do_flow, do_exchange, no_exchange, 0)
+
+    # Commit (a skipped/aborted round routes its zero block to the
+    # scratch rows above `limit` — in bounds by the loop builder's
+    # headroom reservation, and never read because rows above the
+    # cursor are garbage by the pool invariant).
     zero = jnp.zeros((), base.dtype)
+    write_at = jnp.where(do_flow, base, jnp.asarray(limit, base.dtype))
+    keep = lambda new, old: jnp.where(do_flow, new, old)  # noqa: E731
     return s._replace(
-        prmu=jax.lax.dynamic_update_slice(s.prmu, recv_prmu, (zero, base)),
-        depth=jax.lax.dynamic_update_slice(s.depth, recv_depth, (base,)),
-        aux=jax.lax.dynamic_update_slice(s.aux, recv_aux, (zero, base)),
-        size=jnp.where(ovf, s.size, new_size),
-        sent=s.sent + total_out.astype(jnp.int64),
-        recv=s.recv + n_push.astype(jnp.int64),
-        steals=s.steals + (n_push > 0).astype(jnp.int64),
+        prmu=jax.lax.dynamic_update_slice(s.prmu, recv_prmu,
+                                          (zero, write_at)),
+        depth=jax.lax.dynamic_update_slice(s.depth, recv_depth,
+                                           (write_at,)),
+        aux=jax.lax.dynamic_update_slice(s.aux, recv_aux, (zero, write_at)),
+        size=keep(base + n_push, s.size),
+        sent=keep(s.sent + total_out.astype(jnp.int64), s.sent),
+        recv=keep(s.recv + n_push.astype(jnp.int64), s.recv),
+        steals=keep(s.steals + (n_push > 0).astype(jnp.int64), s.steals),
         overflow=s.overflow | ovf,
     )
 
@@ -200,33 +261,36 @@ def _expand(s: SearchState):
 
 def build_dist_loop(mesh, tables, make_local_step,
                     balance_period: int, transfer_cap: int,
-                    min_transfer: int, max_rounds: int | None = None,
-                    limit: int | None = None):
-    """Compile a distributed search loop for any problem: state sharded over
-    the worker axis, problem tables replicated. `make_local_step(tables)`
-    returns the problem's SearchState -> SearchState step. `limit` is the
-    per-worker usable-row bound (device.row_limit); defaults to the full
-    pool capacity for steps that reserve no scratch margin."""
+                    min_transfer: int, limit: int):
+    """Compile a distributed search loop for any problem: state sharded
+    over the worker axis, problem tables replicated.
 
-    def worker_loop(tables, *state_leaves):
+    `make_local_step(tables, limit)` returns the problem's
+    SearchState -> SearchState step, bounded to `limit` usable rows —
+    the SAME tightened limit the balance round commits against, chosen
+    by the driver so both the step scratch block and the balance receive
+    block fit above it (see _balance_round).
+
+    The compiled function has signature `run(tables, max_iters, *state)`
+    with `max_iters` a TRACED cumulative per-worker iteration ceiling
+    (like device.run's): segmented drivers pass a new ceiling every
+    segment and hit the compile cache."""
+
+    def worker_loop(tables, max_iters, *state_leaves):
         s = _local_state(*state_leaves)
 
         def cond(s: SearchState):
             has_work = jax.lax.psum(s.size, AX) > 0
             ok = jax.lax.psum(s.overflow.astype(jnp.int32), AX) == 0
-            go = has_work & ok
-            if max_rounds is not None:
-                go = go & (s.iters < max_rounds * balance_period)
-            return go
+            return has_work & ok & (s.iters < max_iters)
 
-        local_step = make_local_step(tables)
+        local_step = make_local_step(tables, limit)
 
         def body(s: SearchState):
             s = jax.lax.fori_loop(0, balance_period,
                                   lambda _, x: local_step(x), s)
             s = s._replace(best=jax.lax.pmin(s.best, AX))
-            row_bound = s.prmu.shape[-1] if limit is None else limit
-            return _balance_round(s, transfer_cap, min_transfer, row_bound)
+            return _balance_round(s, transfer_cap, min_transfer, limit)
 
         return _expand(jax.lax.while_loop(cond, body, s))
 
@@ -234,7 +298,7 @@ def build_dist_loop(mesh, tables, make_local_step,
     spec_tables = jax.tree.map(lambda _: P(), tables)
     return jax.jit(shard_map(
         worker_loop, mesh,
-        in_specs=(spec_tables,) + spec_state,
+        in_specs=(spec_tables, P()) + spec_state,
         out_specs=spec_state,
     ))
 
@@ -295,14 +359,13 @@ def _fetch(x) -> np.ndarray:
 
     Single-controller (the normal case): a plain fetch. Multi-controller
     (--multihost): the output spans non-addressable devices, so gather it
-    with multihost_utils (every process ends up with the full (D,) array,
-    matching the reference's stats Gather-to-rank-0, dist:817-832, except
-    every rank gets the totals)."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(
-            x, tiled=False)).reshape(-1)
-    return np.asarray(x)
+    with multihost_utils tiled=True (the array is already global (D,...);
+    tiled=False would RE-STACK per-process and is rejected for
+    non-addressable inputs). Every process ends up with the full array —
+    the reference's stats Gather-to-rank-0 (dist:817-832) except every
+    rank gets the totals."""
+    from .checkpoint import _to_np
+    return _to_np(x)
 
 
 def _to_mesh(mesh, spec_leaf, x):
@@ -320,35 +383,98 @@ def _to_mesh(mesh, spec_leaf, x):
     return x
 
 
+def fetch_state(state: SearchState) -> SearchState:
+    """Fetch every state leaf to host numpy (multihost: allgather the
+    global value so every process holds it — needed for checkpointing
+    and pool growth)."""
+    return SearchState(*(_fetch(x) for x in state))
+
+
+class _DistDriver:
+    """Compiles/caches the SPMD loop per pool capacity and runs it with
+    lossless overflow recovery: on overflow the stacked state is fetched,
+    every pool re-homed into double the capacity (checkpoint.grow), the
+    loop rebuilt for the new shapes, and the search RESUMED from exactly
+    where it stopped — no explored work is ever discarded (the round-1
+    design restarted overflowing runs from the warm-up frontier).
+
+    `limit_fn(capacity)` is the problem's usable-row bound (e.g.
+    device.row_limit); the driver tightens it so the balance receive
+    block also fits above the limit (see _balance_round)."""
+
+    def __init__(self, mesh, tables, make_local_step, balance_period: int,
+                 transfer_cap: int, min_transfer: int, limit_fn):
+        self.mesh = mesh
+        self.tables = tables
+        self.make_local_step = make_local_step
+        self.balance_period = balance_period
+        self.transfer_cap = transfer_cap
+        self.min_transfer = min_transfer
+        self.limit_fn = limit_fn
+        self.n_recv = mesh.devices.size * transfer_cap
+        self._loops: dict[int, object] = {}
+        self.spec_state = tuple(P(AX) for _ in SearchState._fields)
+
+    def limit(self, capacity: int) -> int:
+        return min(self.limit_fn(capacity), capacity - self.n_recv)
+
+    def _loop(self, capacity: int):
+        if capacity not in self._loops:
+            self._loops[capacity] = build_dist_loop(
+                self.mesh, self.tables, self.make_local_step,
+                self.balance_period, self.transfer_cap, self.min_transfer,
+                limit=self.limit(capacity))
+        return self._loops[capacity]
+
+    def commit(self, state: SearchState) -> SearchState:
+        """Commit host-built state leaves to the mesh."""
+        return SearchState(*(_to_mesh(self.mesh, s, x)
+                             for s, x in zip(self.spec_state, state)))
+
+    def run(self, state: SearchState, max_iters=None) -> SearchState:
+        """Run until exhaustion or the cumulative per-worker iteration
+        ceiling, growing pools and resuming on overflow."""
+        from . import checkpoint
+
+        ceiling = (np.iinfo(np.int64).max if max_iters is None
+                   else int(max_iters))
+        while True:
+            capacity = state.prmu.shape[-1]
+            out = SearchState(*self._loop(capacity)(
+                self.tables, jnp.asarray(ceiling, jnp.int64), *state))
+            if not bool(_fetch(out.overflow).any()):
+                return out
+            grown = checkpoint.grow(fetch_state(out), capacity * 2)
+            state = self.commit(grown)
+
+    def seed(self, frontier: Frontier, capacity: int, jobs: int,
+             init_best: int) -> SearchState:
+        """Stripe a warm-up frontier across the workers, pre-growing the
+        pool until a stripe fits under the usable-row limit."""
+        n_dev = self.mesh.devices.size
+        stripe = -(-max(len(frontier.depth), 1) // n_dev)
+        while self.limit(capacity) < max(stripe, 1):
+            capacity *= 2
+        state = _shard_frontier(frontier, n_dev, capacity, jobs, init_best,
+                                limit=self.limit(capacity))
+        return self.commit(SearchState(*state))
+
+
 def run_with_retry(mesh, tables, make_local_step, frontier: Frontier,
-                   capacity: int, chunk: int, jobs: int, init_best: int,
+                   capacity: int, jobs: int, init_best: int,
                    balance_period: int, transfer_cap: int,
                    min_transfer: int, max_rounds: int | None,
                    limit_fn) -> SearchState:
-    """Seed the mesh from a frontier and run the SPMD loop, growing the
-    pool capacity and retrying on overflow (shared by the PFSP and
-    N-Queens distributed engines).
-
-    `limit_fn(capacity)` is the per-worker usable-row bound."""
-    # a stripe must fit under the usable-row limit: pre-grow rather than
-    # fail seeding (the graceful path the overflow retry provides mid-run)
-    stripe = -(-max(len(frontier.depth), 1) // mesh.devices.size)
-    while limit_fn(capacity) < stripe:
-        capacity *= 2
-
-    spec_state = tuple(P(AX) for _ in SearchState._fields)
-    while True:
-        run = build_dist_loop(mesh, tables, make_local_step, balance_period,
-                              transfer_cap, min_transfer, max_rounds,
-                              limit=limit_fn(capacity))
-        state = _shard_frontier(frontier, mesh.devices.size, capacity, jobs,
-                                init_best, limit=limit_fn(capacity))
-        state = tuple(_to_mesh(mesh, s, x)
-                      for s, x in zip(spec_state, state))
-        out = SearchState(*run(tables, *state))
-        if not bool(_fetch(out.overflow).any()):
-            return out
-        capacity *= 2
+    """Seed the mesh from a frontier and run the SPMD loop to exhaustion,
+    growing the pools and RESUMING on overflow (shared by the PFSP and
+    N-Queens distributed engines). `max_rounds` bounds the number of
+    balance rounds (debug truncation)."""
+    driver = _DistDriver(mesh, tables, make_local_step, balance_period,
+                         transfer_cap, min_transfer, limit_fn)
+    state = driver.seed(frontier, capacity, jobs, init_best)
+    max_iters = (None if max_rounds is None
+                 else max_rounds * balance_period)
+    return driver.run(state, max_iters)
 
 
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
@@ -356,9 +482,23 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            capacity: int = 1 << 17, balance_period: int = 4,
            transfer_cap: int | None = None, min_transfer: int | None = None,
            min_seed: int = 32, max_rounds: int | None = None,
-           tables: BoundTables | None = None, mesh=None) -> DistResult:
+           tables: BoundTables | None = None, mesh=None,
+           segment_iters: int | None = None,
+           checkpoint_path: str | None = None,
+           heartbeat=None) -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
-    capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search)."""
+    capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
+
+    With `segment_iters`/`checkpoint_path` the loop runs in bounded
+    segments with heartbeat + checkpoint/resume between them — the
+    distributed durability layer the reference lacks entirely (its only
+    stall tooling is a 10-second "Still Idle" print, dist:663-668). A
+    checkpoint written here re-loads with its warm-up counters, so a
+    resumed run's totals match an uninterrupted one exactly."""
+    import os
+
+    from . import checkpoint
+
     if mesh is None:
         mesh = worker_mesh(n_devices)
     n_dev = mesh.devices.size
@@ -368,18 +508,50 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     transfer_cap = transfer_cap or 4 * chunk
     min_transfer = min_transfer or 2 * chunk
 
-    fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
-    fr.aux = ref.prefix_front_remain(
-        p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
-    init_best = fr.best if init_ub is None else min(fr.best, int(init_ub))
+    def make_local_step(t, limit):
+        return functools.partial(step, t, lb_kind, chunk, limit=limit)
 
-    def make_local_step(t):
-        return functools.partial(step, t, lb_kind, chunk)
-
-    out = run_with_retry(
-        mesh, tables, make_local_step, fr, capacity, chunk, jobs, init_best,
-        balance_period, transfer_cap, min_transfer, max_rounds,
+    driver = _DistDriver(
+        mesh, tables, make_local_step, balance_period, transfer_cap,
+        min_transfer,
         limit_fn=lambda cap: device_row_limit(cap, chunk, jobs))
+
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        host_state, meta = checkpoint.load(checkpoint_path, p_times=p_times)
+        if np.asarray(host_state.prmu).ndim != 3 \
+                or host_state.prmu.shape[0] != n_dev:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} holds "
+                f"{np.asarray(host_state.prmu).shape} pools; resume needs "
+                f"the same worker count (mesh has {n_dev})")
+        fr = Frontier(prmu=np.zeros((0, jobs), np.int16),
+                      depth=np.zeros(0, np.int16),
+                      tree=int(meta.get("warmup_tree", 0)),
+                      sol=int(meta.get("warmup_sol", 0)),
+                      best=int(np.asarray(host_state.best).min()))
+        state = driver.commit(host_state)
+    else:
+        fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
+        fr.aux = ref.prefix_front_remain(
+            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
+        init_best = (fr.best if init_ub is None
+                     else min(fr.best, int(init_ub)))
+        state = driver.seed(fr, capacity, jobs, init_best)
+
+    max_iters = (None if max_rounds is None
+                 else max_rounds * balance_period)
+    if segment_iters is None and checkpoint_path is None:
+        out = driver.run(state, max_iters)
+    else:
+        ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol}
+
+        def run_fn(s, target):
+            return driver.run(s, max_iters=target)
+
+        out = checkpoint.run_segmented(
+            run_fn, state, segment_iters=segment_iters or 2048,
+            checkpoint_path=checkpoint_path, heartbeat=heartbeat,
+            max_total_iters=max_iters, checkpoint_meta=ckpt_meta)
 
     tree_dev = _fetch(out.tree)
     sol_dev = _fetch(out.sol)

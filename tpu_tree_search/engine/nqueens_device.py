@@ -31,12 +31,14 @@ from .device import SearchState, init_state, make_children, row_limit
 I32_MAX = jnp.int32(2**31 - 1)
 
 
-def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
+def nq_step(n: int, g: int, chunk: int, state: SearchState,
+            limit: int | None = None) -> SearchState:
     """One pop -> safety-check -> branch cycle.
 
     The pool is feature-major (device.SearchState); the safety kernel is
     row-major, so the popped block is transposed in and the child block
-    transposed out — at N-Queens batch sizes that cost is noise."""
+    transposed out — at N-Queens batch sizes that cost is noise.
+    `limit` tightens the usable-row bound (see device.step)."""
     N, capacity = state.prmu.shape
     B = chunk
 
@@ -69,7 +71,8 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState) -> SearchState:
     children = jnp.take(children, order, axis=0).T        # (N, B*N)
     child_depth = jnp.take(child_depth, order)
 
-    limit = row_limit(capacity, B, N)
+    if limit is None:
+        limit = row_limit(capacity, B, N)
     new_size = start + n_push
     overflow = new_size > limit
     write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
@@ -162,6 +165,8 @@ def bfs_warmup(n: int, target: int):
 def search_distributed(n: int, g: int = 1, n_devices: int | None = None,
                        chunk: int = 64, capacity: int = 1 << 17,
                        balance_period: int = 4, min_seed: int = 32,
+                       transfer_cap: int | None = None,
+                       min_transfer: int | None = None,
                        mesh=None) -> NQResult:
     """Distributed N-Queens over the worker mesh
     (capability parity with nqueens_multigpu_cuda.cu, plus balancing)."""
@@ -170,13 +175,14 @@ def search_distributed(n: int, g: int = 1, n_devices: int | None = None,
     n_dev = mesh.devices.size
     fr = bfs_warmup(n, target=min_seed * n_dev)
 
-    def make_local_step(_tables):
-        return functools.partial(nq_step, n, g, chunk)
+    def make_local_step(_tables, limit):
+        return functools.partial(nq_step, n, g, chunk, limit=limit)
 
     out = dist.run_with_retry(
-        mesh, (), make_local_step, fr, capacity, chunk, n,
+        mesh, (), make_local_step, fr, capacity, n,
         init_best=2**31 - 1, balance_period=balance_period,
-        transfer_cap=4 * chunk, min_transfer=2 * chunk, max_rounds=None,
+        transfer_cap=transfer_cap or 4 * chunk,
+        min_transfer=min_transfer or 2 * chunk, max_rounds=None,
         limit_fn=lambda cap: row_limit(cap, chunk, n))
     return NQResult(
         explored_tree=int(dist._fetch(out.tree).sum()) + fr.tree,

@@ -11,9 +11,10 @@ inside the compiled loop:
 1. `all_gather` the pool sizes (every worker sees the global picture —
    the analogue of the Allgather of `local_need`).
 2. Compute a deterministic exchange plan, identically on every worker:
-   rank workers by size; the r-th fullest donates to the r-th emptiest
-   half of their difference (steal-half, the reference's `ratio=2`
-   semantics from popBackBulk, Pool_atom.c:154-178), capped by the static
+   workers above the mean donate half their surplus, workers below fill
+   their deficit, matched by interval overlap so one donor can feed many
+   receivers (steal-half, the reference's `ratio=2` semantics from
+   popBackBulk, Pool_atom.c:154-178), capped by the static
    transfer-buffer size.
 3. Donors pop from the top of their stack (deepest nodes — preserving the
    DFS locality the reference's popBack stealing keeps), pack into a
@@ -37,19 +38,27 @@ def exchange_plan(sizes: jax.Array, cap: int, min_transfer: int) -> jax.Array:
     """(D, D) flow matrix: plan[d, e] nodes move d -> e this round.
 
     Pure function of the globally-known sizes vector, so every worker
-    computes the same plan. Pairing: r-th largest donates to r-th
-    smallest `min(cap, (diff)//2)` when diff >= min_transfer (steal-half
-    with the reference's `size >= 2m` steal threshold, Pool_atom.c:154-178).
+    computes the same plan. Water-filling: workers above the mean donate
+    half their surplus (steal-half, the reference's `ratio=2` semantics
+    from popBackBulk, Pool_atom.c:154-178, and its `size >= 2m` threshold
+    via `min_transfer`), workers below the mean fill their deficit. Donor
+    surpluses and receiver deficits are laid out as consecutive intervals
+    on one shared flow axis; plan[d, e] is the overlap of donor d's and
+    receiver e's intervals — so one hot worker feeds MANY starving
+    workers in a single round (the r-th-fullest/r-th-emptiest pairing it
+    replaces moved work to exactly one receiver per donor per round,
+    which converges D× slower on wide meshes). Per-pair flow is capped
+    at `cap`, the static width of the all_to_all transfer buffer.
     """
     D = sizes.shape[0]
     sizes = sizes.astype(jnp.int32)
-    order_desc = jnp.argsort(-sizes)            # stable: ties by worker id
-    order_asc = jnp.argsort(sizes)
-    donors = order_desc                          # (D,)
-    receivers = order_asc
-    diff = sizes[donors] - sizes[receivers]
-    amount = jnp.clip(diff // 2, 0, cap)
-    amount = jnp.where(diff >= min_transfer, amount, 0)
-    amount = jnp.where(donors == receivers, 0, amount)
-    plan = jnp.zeros((D, D), jnp.int32).at[donors, receivers].add(amount)
-    return plan
+    mean = sizes.sum() // D
+    surplus = jnp.where(sizes - mean >= min_transfer,
+                        (sizes - mean) // 2, 0)              # donors
+    deficit = jnp.clip(mean - sizes, 0, None)                # receivers
+    d_lo = (jnp.cumsum(surplus) - surplus)[:, None]          # (D, 1)
+    d_hi = d_lo + surplus[:, None]
+    r_lo = (jnp.cumsum(deficit) - deficit)[None, :]          # (1, D)
+    r_hi = r_lo + deficit[None, :]
+    overlap = jnp.minimum(d_hi, r_hi) - jnp.maximum(d_lo, r_lo)
+    return jnp.clip(overlap, 0, cap)
