@@ -28,6 +28,51 @@ def test_hybrid_matches_oracle(lb):
     assert res.per_device["host_drained"][0] >= 0
 
 
+def test_hybrid_concurrent_incumbent_exchange():
+    """With ub=inf the host session and the device loop run CONCURRENTLY
+    and exchange incumbents mid-run: the host share produces a bound the
+    device adopts (or vice versa) at a segment boundary WHILE both are
+    still searching. Round 1's sequential three-phase hybrid had no such
+    channel — its device phase could never see a host incumbent — so
+    this test fails against that design by construction."""
+    inst = PFSPInstance.synthetic(jobs=11, machines=4, seed=9)
+    res = hybrid.search(inst.p_times, lb_kind=1, init_ub=None,
+                        chunk=32, capacity=1 << 14, drain_min=16,
+                        host_threads=2, host_fraction=4, segment_iters=4)
+    pd = res.per_device
+    assert pd["exchanges"][0] > 0
+    # a real cross-tier transfer happened in at least one direction
+    assert pd["host_improved"][0] + pd["dev_improved"][0] >= 1
+    # both tiers actually searched (concurrently, not hand-off-only)
+    assert pd["host_tree"][0] > 0
+    assert pd["tree"][0] > 0
+    # and the search still proves the optimum
+    want = seq.pfsp_search(inst, lb=1, init_ub=res.best)
+    assert res.best == want.best
+
+
+def test_hybrid_concurrent_matches_oracle_ub_opt():
+    """Fixed ub: the explored set is traversal-order independent, so the
+    concurrent split (host session + device loop + drain) must still sum
+    to the pure-device run's exact counts. ta003/LB2 keeps a real
+    frontier alive under ub=opt (tree=80062), so the host session gets a
+    genuine share."""
+    from tpu_tree_search.engine import device
+    from tpu_tree_search.problems import taillard
+
+    p = taillard.processing_times(3)
+    opt = taillard.optimal_makespan(3)
+    want = device.search(p, lb_kind=2, init_ub=opt, chunk=256,
+                         capacity=1 << 16)
+    res = hybrid.search(p, lb_kind=2, init_ub=opt, chunk=256,
+                        capacity=1 << 16, drain_min=64, host_threads=3,
+                        host_fraction=2, segment_iters=8)
+    # the concurrent tier ran (expanded its seed share)
+    assert res.per_device["host_expanded"][0] > 0
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
 def test_hybrid_drains_on_host():
     """On an instance whose frontier outlives the device loop the host
     does real work, and the combined totals equal the pure-device run

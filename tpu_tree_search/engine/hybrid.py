@@ -1,20 +1,28 @@
-"""Heterogeneous CPU+TPU co-processing for the single-device engine.
+"""Heterogeneous CPU+TPU co-processing: CONCURRENT host + device search.
 
-The reference's `-C 1` mode runs CPU worker threads next to each GPU
-manager and finishes with a serial CPU drain (pfsp_multigpu_cuda.c:61-69,
-236-263, 487-495; its device loop only pops full chunks while
-`pool.size >= m`, PFSP_lib.c:175/Pool_atom.c:154-178). The TPU analogue:
+The reference's `-C 1` mode runs CPU worker threads concurrently with the
+GPU managers, all sharing the incumbent through the `checkBest` CAS
+(pfsp_multigpu_cuda.c:61-69, 159-263), and finishes with a serial CPU
+drain (:487-495). The TPU analogue here:
 
 1. the native C++ runtime grows the warm-up frontier (step 1),
-2. the compiled device loop explores while the pool can still feed full
-   chunks (`size >= m`, the reference's `-m` threshold),
-3. the residual pool is handed to native host threads which finish it
-   with a multi-threaded DFS sharing the incumbent through an atomic
-   (`tts_search_from` — checkBest semantics).
+2. the frontier is stride-split (roundRobin_distribution semantics):
+   the host share seeds a native multi-threaded ASYNC search session
+   (native.async_start) that runs in the background,
+3. the compiled device loop explores its share in bounded segments;
+   every segment boundary merges incumbents BOTH ways with the session
+   (native.async_best / async_offer) — a bound found by either side
+   prunes the other while both are still running (round 1 ran these
+   phases sequentially, so with ub=inf the device never saw host
+   incumbents),
+4. the device residue (pool below the `-m` threshold, PFSP_lib.c:175)
+   drains on host threads with the freshest merged bound, then the
+   async session is joined.
 
-With the UB fixed the explored set is traversal-order independent, so the
-combined counters equal the pure-device run exactly (the same invariant
-the golden-parity tests rely on).
+With a FIXED ub the explored set is traversal-order independent, so the
+combined counters still equal the pure-device run exactly (the invariant
+the golden-parity tests rely on); with a live incumbent the exchanges
+are what keep both sides' trees near the oracle's.
 """
 
 from __future__ import annotations
@@ -32,57 +40,107 @@ class HybridResult(distributed.DistResult):
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            chunk: int = 1024, capacity: int = 1 << 20,
            drain_min: int | None = None, host_threads: int = 0,
+           host_fraction: int = 8, segment_iters: int = 64,
            tile: int = 1024):
-    """Single-chip search with host warm-up and host drain (`-C 1`).
+    """Single-chip search with a concurrent native host tier (`-C 1`).
 
     `drain_min` (default: the chunk size) is the reference's `-m`: the
     device loop runs while the pool can feed at least that many parents;
-    the leftovers go to the native host runtime.
-    """
+    the leftovers go to the host runtime. `host_fraction`: the host
+    session seeds with every host_fraction-th warm-up node (0 disables
+    the concurrent tier, leaving warm-up + device + drain).
+    `segment_iters` sets the incumbent-exchange cadence in device loop
+    iterations."""
+    import jax.numpy as jnp
+
     from .. import native
+    from . import checkpoint
 
     jobs = p_times.shape[1]
     tables = batched.make_tables(p_times)
     drain_min = chunk if drain_min is None else max(1, drain_min)
 
-    # step 1: native warm-up so the device starts with full chunks
+    # step 1: native warm-up so both tiers start with real work
     fr = distributed.bfs_warmup(p_times, lb_kind, init_ub,
                                 target=max(4 * chunk, 2 * drain_min))
     best0 = fr.best if init_ub is None else min(fr.best, int(init_ub))
 
-    # step 2: compiled device loop while chunks stay full
-    while True:
-        state = device.init_state(jobs, capacity, best0,
-                                  prmu0=fr.prmu, depth0=fr.depth,
-                                  p_times=p_times)
-        out = device.run(tables, state, lb_kind, chunk, tile=tile,
-                         drain_min=drain_min)
-        if not bool(out.overflow):
-            break
-        capacity *= 2
+    # step 2: stride-split the frontier; host share starts NOW, async
+    n = len(fr.depth)
+    handle = None
+    d_prmu, d_depth = fr.prmu, fr.depth
+    if host_fraction > 0 and n >= host_fraction:
+        hmask = np.zeros(n, bool)
+        hmask[::host_fraction] = True
+        handle = native.async_start(
+            p_times, fr.prmu[hmask], fr.depth[hmask], lb_kind=lb_kind,
+            init_ub=best0, n_threads=host_threads)
+        d_prmu, d_depth = fr.prmu[~hmask], fr.depth[~hmask]
 
-    # step 3: native drain of the residual pool (host threads)
-    n_left = int(out.size)
-    d_tree, d_sol = int(out.tree), int(out.sol)
-    best = int(out.best)
+    # step 3: segmented device loop with incumbent exchange per segment
+    state = device.init_state(jobs, capacity, best0, prmu0=d_prmu,
+                              depth0=d_depth, p_times=p_times)
+    exchanges = host_improved = dev_improved = 0
+    target = 0
+    while True:
+        target += segment_iters
+        state = device.run(tables, state, lb_kind, chunk, max_iters=target,
+                           tile=tile, drain_min=drain_min)
+        if bool(state.overflow):
+            capacity *= 2
+            state = checkpoint.grow(state, capacity)
+            continue
+        if handle is not None:
+            dev_best = int(state.best)
+            host_best = native.async_best(handle)
+            merged = min(dev_best, host_best)
+            exchanges += 1
+            if host_best < dev_best:
+                host_improved += 1
+                state = state._replace(
+                    best=jnp.asarray(merged, state.best.dtype))
+            elif dev_best < host_best:
+                dev_improved += 1
+                native.async_offer(handle, merged)
+        if int(state.size) < drain_min:
+            break
+
+    # step 4: host drain of the device residue with the freshest bound
+    n_left = int(state.size)
+    d_tree, d_sol = int(state.tree), int(state.sol)
+    best = int(state.best)
+    if handle is not None:
+        best = min(best, native.async_best(handle))
     drained = 0
     if n_left > 0:
-        res_prmu = np.asarray(out.prmu[:, :n_left]).T
-        res_depth = np.asarray(out.depth[:n_left])
-        h_tree, h_sol, best, drained = native.search_from(
+        res_prmu = np.asarray(state.prmu[:, :n_left]).T
+        res_depth = np.asarray(state.depth[:n_left])
+        r_tree, r_sol, best, drained = native.search_from(
             p_times, res_prmu, res_depth, lb_kind=lb_kind,
             init_ub=best, n_threads=host_threads)
-        d_tree += h_tree
-        d_sol += h_sol
+        d_tree += r_tree
+        d_sol += r_sol
+
+    # join the concurrent host session
+    h_tree = h_sol = h_expanded = 0
+    if handle is not None:
+        h_tree, h_sol, h_best, h_expanded = native.async_join(handle)
+        best = min(best, h_best)
 
     return HybridResult(
-        explored_tree=d_tree + fr.tree,
-        explored_sol=d_sol + fr.sol,
+        explored_tree=d_tree + h_tree + fr.tree,
+        explored_sol=d_sol + h_sol + fr.sol,
         best=best,
         per_device={"tree": [d_tree], "sol": [d_sol],
-                    "evals": [int(out.evals)],
+                    "evals": [int(state.evals)],
+                    "iters": [int(state.iters)],
                     "steals": [0], "recv": [0],
-                    "host_drained": [drained]},
+                    "host_tree": [h_tree], "host_sol": [h_sol],
+                    "host_expanded": [h_expanded],
+                    "host_drained": [drained],
+                    "exchanges": [exchanges],
+                    "host_improved": [host_improved],
+                    "dev_improved": [dev_improved]},
         warmup_tree=fr.tree, warmup_sol=fr.sol,
         complete=True,
     )

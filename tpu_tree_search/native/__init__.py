@@ -38,6 +38,17 @@ def lib() -> ctypes.CDLL:
         handle.tts_search_from.restype = ctypes.c_longlong
         handle.tts_bfs_frontier.restype = ctypes.c_longlong
         handle.tts_nqueens.restype = ctypes.c_longlong
+        handle.tts_async_start.restype = ctypes.c_void_p
+        handle.tts_async_best.restype = ctypes.c_int
+        handle.tts_async_best.argtypes = [ctypes.c_void_p]
+        handle.tts_async_offer.restype = None
+        handle.tts_async_offer.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        handle.tts_async_done.restype = ctypes.c_int
+        handle.tts_async_done.argtypes = [ctypes.c_void_p]
+        handle.tts_async_join.restype = ctypes.c_longlong
+        handle.tts_async_join.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_ulonglong),
+            ctypes.POINTER(ctypes.c_ulonglong), ctypes.POINTER(ctypes.c_int)]
         _lib = handle
     return _lib
 
@@ -117,6 +128,56 @@ def bfs_frontier(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
     n_nodes = int(got)
     return (prmu[:n_nodes].copy(), depth[:n_nodes].copy(),
             int(tree.value), int(sol.value), int(best.value))
+
+
+def async_start(p_times: np.ndarray, prmu: np.ndarray, depth: np.ndarray,
+                lb_kind: int = 1, init_ub: int | None = None,
+                n_threads: int = 0):
+    """Start a background multi-threaded DFS over a seed set and return an
+    opaque session handle — the CONCURRENT heterogeneous tier: the caller
+    keeps driving the device loop while these threads run, merging
+    incumbents through async_best/async_offer (checkBest semantics,
+    reference: pfsp_multigpu_cuda.c:30-50, 159-263). The native side
+    copies all inputs before returning."""
+    import os
+    p = np.ascontiguousarray(p_times, dtype=np.int32)
+    m, n = p.shape
+    prmu = np.ascontiguousarray(prmu, dtype=np.int16).reshape(-1, n)
+    depth = np.ascontiguousarray(depth, dtype=np.int16).reshape(-1)
+    if n_threads <= 0:
+        n_threads = max(1, (os.cpu_count() or 2) - 1)
+    h = lib().tts_async_start(
+        p.ctypes.data_as(ctypes.POINTER(ctypes.c_int)), n, m, lb_kind,
+        0 if init_ub is None else int(init_ub),
+        prmu.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        depth.ctypes.data_as(ctypes.POINTER(ctypes.c_int16)),
+        ctypes.c_longlong(prmu.shape[0]), int(n_threads))
+    return h
+
+
+def async_best(handle) -> int:
+    """Current shared incumbent of a running session."""
+    return int(lib().tts_async_best(handle))
+
+
+def async_offer(handle, best: int) -> None:
+    """Merge an externally-found incumbent into the session (CAS min)."""
+    lib().tts_async_offer(handle, int(best))
+
+
+def async_done(handle) -> bool:
+    """True when every session thread has drained its pool."""
+    return bool(lib().tts_async_done(handle))
+
+
+def async_join(handle):
+    """Join the session and free it. Returns (tree, sol, best, expanded)."""
+    tree = ctypes.c_ulonglong()
+    sol = ctypes.c_ulonglong()
+    best = ctypes.c_int()
+    expanded = lib().tts_async_join(handle, ctypes.byref(tree),
+                                    ctypes.byref(sol), ctypes.byref(best))
+    return int(tree.value), int(sol.value), int(best.value), int(expanded)
 
 
 def nqueens(n: int, g: int = 1):

@@ -469,6 +469,123 @@ long long tts_search_from(const int* p, int jobs, int machines, int lbKind,
   return expanded;
 }
 
+// Asynchronous host search session — the CONCURRENT heterogeneous tier.
+// The reference's -C 1 runs CPU worker threads concurrently with the GPU
+// managers, all sharing the incumbent through checkBest CAS
+// (pfsp_multigpu_cuda.c:61-69, 159-263). Here the Python side drives the
+// compiled device loop in segments while these native threads consume
+// their own seed share; every segment boundary merges incumbents both
+// ways with tts_async_best / tts_async_offer — so a bound found by
+// either side prunes the other while both are still running.
+
+namespace {
+
+struct AsyncSearch {
+  Bounds bounds;
+  int lbKind;
+  int nThreads;
+  std::atomic<int> sharedBest;
+  std::atomic<int> doneThreads{0};
+  std::vector<unsigned long long> trees, sols;
+  std::vector<long long> expandedPer;
+  std::vector<int16_t> seedPrmu, seedDepth;  // owned copies
+  long long nSeeds;
+  std::vector<std::thread> threads;
+
+  AsyncSearch(const int* p, int jobs, int machines, int lb, int initUb,
+              const int16_t* sp, const int16_t* sd, long long n, int nt)
+      : bounds(p, jobs, machines),
+        lbKind(lb),
+        nThreads(nt < 1 ? 1 : nt),
+        sharedBest(initUb > 0 ? initUb : kIntMax),
+        trees(nThreads, 0),
+        sols(nThreads, 0),
+        expandedPer(nThreads, 0),
+        seedPrmu(sp, sp + n * jobs),
+        seedDepth(sd, sd + n),
+        nSeeds(n) {}
+
+  void worker(int t) {
+    const int jobs = bounds.jobs;
+    SearchCounters c;
+    c.best = sharedBest.load(std::memory_order_relaxed);
+    NodeStore pool(jobs);
+    for (long long i = t; i < nSeeds; i += nThreads)
+      pool.push(&seedPrmu[i * jobs], seedDepth[i]);
+    std::vector<int16_t> perm(jobs);
+    int16_t d;
+    while (pool.count > 0) {
+      int g = sharedBest.load(std::memory_order_relaxed);
+      if (g < c.best) c.best = g;
+      pool.popBack(perm.data(), &d);
+      ++expandedPer[t];
+      expandNode(bounds, lbKind, perm.data(), d, c, pool);
+      if (c.best < g) {
+        int cur = g;
+        while (c.best < cur &&
+               !sharedBest.compare_exchange_weak(cur, c.best)) {
+        }
+      }
+    }
+    trees[t] = c.tree;
+    sols[t] = c.sol;
+    doneThreads.fetch_add(1);
+  }
+
+  void start() {
+    for (int t = 0; t < nThreads; ++t)
+      threads.emplace_back(&AsyncSearch::worker, this, t);
+  }
+};
+
+}  // namespace
+
+void* tts_async_start(const int* p, int jobs, int machines, int lbKind,
+                      int initUb, const int16_t* seedPrmu,
+                      const int16_t* seedDepth, long long nSeeds,
+                      int nThreads) {
+  auto* s = new AsyncSearch(p, jobs, machines, lbKind, initUb, seedPrmu,
+                            seedDepth, nSeeds, nThreads);
+  s->start();
+  return s;
+}
+
+int tts_async_best(void* h) {
+  return static_cast<AsyncSearch*>(h)->sharedBest.load();
+}
+
+// Merge an externally-found incumbent (CAS min — checkBest semantics).
+void tts_async_offer(void* h, int b) {
+  auto& shared = static_cast<AsyncSearch*>(h)->sharedBest;
+  int cur = shared.load();
+  while (b < cur && !shared.compare_exchange_weak(cur, b)) {
+  }
+}
+
+int tts_async_done(void* h) {
+  auto* s = static_cast<AsyncSearch*>(h);
+  return s->doneThreads.load() >= s->nThreads ? 1 : 0;
+}
+
+// Join all threads, write out the summed counters, free the session.
+long long tts_async_join(void* h, unsigned long long* tree,
+                         unsigned long long* sol, int* best) {
+  auto* s = static_cast<AsyncSearch*>(h);
+  for (auto& th : s->threads) th.join();
+  unsigned long long tt = 0, ss = 0;
+  long long expanded = 0;
+  for (int t = 0; t < s->nThreads; ++t) {
+    tt += s->trees[t];
+    ss += s->sols[t];
+    expanded += s->expandedPer[t];
+  }
+  *tree = tt;
+  *sol = ss;
+  *best = s->sharedBest.load();
+  delete s;
+  return expanded;
+}
+
 // N-Queens backtracking (reference semantics: nqueens_c.c:99-148).
 long long tts_nqueens(int n, int g, unsigned long long* tree,
                       unsigned long long* sol) {
