@@ -28,7 +28,11 @@ def _pfsp_parser(sub):
     p.add_argument("-u", "--ub", type=int, default=d.ub, choices=(0, 1))
     p.add_argument("-m", type=int, default=d.m)
     p.add_argument("-M", type=int, default=d.M)
-    p.add_argument("-T", type=int, default=d.T)
+    p.add_argument("-T", type=int, default=d.T,
+                   help="reference CPU bulk-pop size; accepted for "
+                        "command-line and CSV-schema compatibility but "
+                        "inert here, like -p (the host tier's native DFS "
+                        "pops per node; PFSP_lib.c:175-185)")
     p.add_argument("-D", type=int, default=d.D)
     p.add_argument("-C", type=int, default=d.C)
     p.add_argument("-w", "--ws", type=int, default=d.ws)
@@ -42,7 +46,7 @@ def _pfsp_parser(sub):
                    help="truncate the search (debugging)")
     p.add_argument("--segment-iters", type=int, default=None,
                    help="run in bounded segments with heartbeat reports "
-                        "(enables checkpointing; single-device only)")
+                        "(enables checkpointing; any -D)")
     p.add_argument("--checkpoint", type=str, default=None,
                    help="checkpoint path; if the file exists the search "
                         "resumes from it")
@@ -102,17 +106,51 @@ def run_pfsp(args) -> int:
 
     t0 = time.perf_counter()
     if args.segment_iters is not None or args.checkpoint is not None:
-        if n_dev != 1:
-            print("error: --segment-iters/--checkpoint require -D 1",
-                  file=sys.stderr)
-            return 2
-        try:
-            out = _run_pfsp_segmented(args, p, init_ub)
-        except (RuntimeError, ValueError, OSError) as e:
-            print(f"error: {e}", file=sys.stderr)
-            return 1
-        tree, sol, best = int(out.tree), int(out.sol), int(out.best)
-        complete = int(np.asarray(out.size).sum()) == 0
+        if n_dev == 1:
+            try:
+                out = _run_pfsp_segmented(args, p, init_ub)
+            except (RuntimeError, ValueError, OSError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            tree, sol, best = int(out.tree), int(out.sol), int(out.best)
+            complete = int(np.asarray(out.size).sum()) == 0
+            per_device = {"tree": [tree], "sol": [sol],
+                          "evals": [int(out.evals)],
+                          "iters": [int(out.iters)],
+                          "steals": [0], "recv": [0]}
+        else:
+            # distributed durability: segmented SPMD loop with stacked
+            # checkpoint/resume and per-worker heartbeat
+            def heartbeat(r):
+                pw = (f" sizes={r.per_worker['size']}"
+                      f" steals={r.per_worker['steals']}"
+                      if r.per_worker else "")
+                print(f"[segment {r.segment}] iters={r.iters} "
+                      f"tree={r.tree} sol={r.sol} best={r.best} "
+                      f"pool={r.pool_size}{pw} t={r.elapsed:.2f}s")
+
+            try:
+                res = distributed.search(
+                    p, lb_kind=args.lb, init_ub=init_ub, n_devices=n_dev,
+                    chunk=args.chunk, capacity=args.capacity,
+                    balance_period=args.balance_period,
+                    # balancing off (-w 0 -L 0): an unreachable transfer
+                    # threshold keeps every plan empty (the cond-gated
+                    # exchange then costs one all_gather) while the
+                    # while-cond — termination, ceiling, segment checks —
+                    # still runs every period
+                    min_transfer=(None if (args.ws or args.L)
+                                  else 2**30),
+                    min_seed=args.m, max_rounds=args.max_iters,
+                    segment_iters=args.segment_iters,
+                    checkpoint_path=args.checkpoint, heartbeat=heartbeat)
+            except (RuntimeError, ValueError, OSError) as e:
+                print(f"error: {e}", file=sys.stderr)
+                return 1
+            tree, sol, best = (res.explored_tree, res.explored_sol,
+                               res.best)
+            complete = res.complete
+            per_device = {k: list(v) for k, v in res.per_device.items()}
     elif n_dev == 1 and args.C:
         # heterogeneous co-processing (-C 1): native host warm-up + the
         # compiled device loop while the pool feeds >= m parents (the
@@ -138,13 +176,13 @@ def run_pfsp(args) -> int:
         tree, sol, best = out.explored_tree, out.explored_sol, out.best
         complete = out.complete
         per_device = {"tree": [tree], "sol": [sol], "evals": [out.evals],
-                      "steals": [0], "recv": [0]}
+                      "iters": [out.iters], "steals": [0], "recv": [0]}
     else:
         res = distributed.search(
             p, lb_kind=args.lb, init_ub=init_ub, n_devices=n_dev,
             chunk=args.chunk, capacity=args.capacity,
-            balance_period=(args.balance_period if (args.ws or args.L)
-                            else 1 << 30),
+            balance_period=args.balance_period,
+            min_transfer=(None if (args.ws or args.L) else 2**30),
             min_seed=args.m,
             max_rounds=args.max_iters)
         tree, sol, best = res.explored_tree, res.explored_sol, res.best
@@ -154,14 +192,76 @@ def run_pfsp(args) -> int:
 
     _print_results(best, tree, sol, elapsed, complete=complete)
     if args.csv:
-        if n_dev == 1:
-            csv_stats.write_single(args.csv, args.inst, args.lb, best, args.m,
-                                   args.M, elapsed, elapsed, tree, sol)
-        else:
-            csv_stats.write_dist(args.csv, args.inst, args.lb, n_dev, args.C,
-                                 args.L, 1, best, args.m, args.M, args.T,
-                                 elapsed, tree, sol, per_device)
+        _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
+                               best, per_device, csv_stats)
     return 0
+
+
+def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
+                           best, per_device, csv_stats):
+    """CSV row with MEASURED phase-time attributions (utils/phase_timing):
+    unit costs of the bound kernel / compaction / balance exchange timed
+    on the real shapes, scaled by the run's counters — the reference's
+    per-PU breakdown (PFSP_statistic.c:69-112) with real data, not the
+    structural zeros of round 1."""
+    import numpy as np
+
+    from .engine import device as dev
+    from .ops import batched
+    from .problems import taillard
+    from .utils import phase_timing
+
+    jobs, machines = p.shape[1], p.shape[0]
+    att = {}
+    try:
+        tables = batched.make_tables(p)
+        pstate = dev.init_state(jobs, args.capacity, init_ub, p_times=p)
+        prof = phase_timing.profile_phases(tables, pstate, args.lb,
+                                           args.chunk)
+        evals = per_device.get("evals", [0] * n_dev)
+        iters = per_device.get("iters",
+                               [max(1, int(e)) // (args.chunk * jobs)
+                                for e in evals])
+        t_bal = 0.0
+        rounds = 0
+        if n_dev > 1 and (args.ws or args.L):
+            from .engine import distributed as dist
+            from .ops import reference as ref
+            from .parallel.mesh import worker_mesh
+
+            transfer_cap, min_transfer = 4 * args.chunk, 2 * args.chunk
+            limit = min(dev.row_limit(args.capacity, args.chunk, jobs),
+                        args.capacity - n_dev * transfer_cap)
+            fr = dist.Frontier(
+                prmu=np.arange(jobs, dtype=np.int16)[None, :],
+                depth=np.zeros(1, np.int16), tree=0, sol=0,
+                best=best)
+            fr.aux = ref.prefix_front_remain(
+                p, fr.prmu, fr.depth)[:, :machines]
+            leaves = dist._shard_frontier(fr, n_dev, args.capacity, jobs,
+                                          best, limit=max(limit, 1))
+            t_bal = phase_timing.profile_balance(
+                worker_mesh(n_dev), leaves, transfer_cap, min_transfer,
+                max(limit, 1))
+            rounds = int(np.max(iters)) // max(1, args.balance_period)
+        att = phase_timing.attribute(prof, elapsed, evals, iters,
+                                     balance_rounds=rounds,
+                                     t_balance=t_bal)
+        per_device = dict(per_device)
+        per_device.update({k: list(v) for k, v in att.items()})
+    except Exception as e:  # profiling must never eat the results row
+        print(f"warning: phase profiling failed ({e}); writing "
+              "zero timing columns", file=sys.stderr)
+
+    if n_dev == 1:
+        csv_stats.write_single(
+            args.csv, args.inst, args.lb, best, args.m, args.M, elapsed,
+            float(att["kernel_time"][0]) if att else elapsed, tree, sol,
+            gen_child_time=float(att["gen_child_time"][0]) if att else 0.0)
+    else:
+        csv_stats.write_dist(args.csv, args.inst, args.lb, n_dev, args.C,
+                             args.L, 1, best, args.m, args.M, args.T,
+                             elapsed, tree, sol, per_device)
 
 
 def _run_pfsp_segmented(args, p, init_ub):

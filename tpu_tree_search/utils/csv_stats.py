@@ -10,10 +10,17 @@ Semantic mapping of per-PU columns to the TPU engine:
   thread that may manage a GPU);
 - `steals` / `success_steals` are balance exchanges with nodes received
   (there are no failed lock acquisitions to count);
-- `gpu_kernel_time` carries the device-loop wall time; memcpy/malloc/
-  gen-child columns are structurally zero (those phases are fused into
-  the compiled loop — that's the point of the design) but retained so
-  existing analysis code parses rows unchanged.
+- timing columns carry MEASURED phase attributions (utils/phase_timing:
+  bound-kernel vs compaction unit costs timed on the real shapes, scaled
+  by each worker's counters; the per-worker remainder is idle) —
+  `gpu_kernel_time` = bound evaluation, `gen_child_time` = prune+branch
+  compaction (the regather step IS the reference's generate_children),
+  `time_load_bal` = measured balance exchanges, `gpu_idle_time` = the
+  remainder, so the columns sum to ~total;
+- memcpy/malloc columns are structurally zero — those phases genuinely
+  do not exist here (HBM-resident pool, static allocation), which is
+  the honest datum; headers are retained so existing analysis parses
+  rows unchanged.
 """
 
 from __future__ import annotations
@@ -45,10 +52,12 @@ SINGLE_HEADER = ("instance_id,lower_bound,optimum,m,M,total_time,"
 
 def write_single(path: str, inst: int, lb: int, optimum: int, m: int, M: int,
                  total_time: float, kernel_time: float,
-                 explored_tree: int, explored_sol: int) -> None:
+                 explored_tree: int, explored_sol: int,
+                 gen_child_time: float = 0.0) -> None:
     """Single-device row (reference: print_results_file_single_gpu)."""
     row = (f"{inst},{lb},{optimum},{m},{M},{total_time:.4f},0.0000,0.0000,"
-           f"{kernel_time:.4f},0.0000,{explored_tree},{explored_sol}")
+           f"{kernel_time:.4f},{gen_child_time:.4f},"
+           f"{explored_tree},{explored_sol}")
     _append(path, SINGLE_HEADER, row)
 
 
@@ -83,9 +92,12 @@ def write_multi(path: str, inst: int, lb: int, D: int, C: int, ws: int,
         _fmt_float_array(zeros_f),                     # memcpy: fused
         _fmt_float_array(zeros_f),                     # malloc: static pool
         _fmt_float_array(per_device.get("kernel_time", zeros_f)),
-        _fmt_float_array(zeros_f),                     # gen_child: fused
-        _fmt_float_array(zeros_f),                     # pool ops: fused
-        _fmt_float_array(zeros_f),                     # idle: masked no-ops
+        _fmt_float_array(per_device.get("gen_child_time", zeros_f)),
+        # pool_ops column: the balance exchange is this engine's only
+        # out-of-step pool manipulation (the reference counts steal-lock
+        # pool ops here)
+        _fmt_float_array(per_device.get("balance_time", zeros_f)),
+        _fmt_float_array(per_device.get("idle_time", zeros_f)),
         _fmt_float_array(zeros_f),                     # termination: in-loop
     ]
     _append(path, MULTI_HEADER, ",".join(cells).rstrip(","))
@@ -122,9 +134,9 @@ def write_dist(path: str, inst: int, lb: int, D: int, C: int, LB: int,
         _fmt_float_array(zeros_f),
         _fmt_float_array(zeros_f),
         _fmt_float_array(per_device.get("kernel_time", zeros_f)),
-        _fmt_float_array(zeros_f),
-        _fmt_float_array(zeros_f),
-        _fmt_float_array(zeros_f),
+        _fmt_float_array(per_device.get("gen_child_time", zeros_f)),
+        _fmt_float_array(zeros_f),                     # pool ops: fused
+        _fmt_float_array(per_device.get("idle_time", zeros_f)),
         _fmt_float_array(zeros_f),
         _fmt_float_array(per_device.get("balance_time", zeros_f)),
     ]
