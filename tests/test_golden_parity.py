@@ -18,7 +18,13 @@ from tpu_tree_search import native
 from tpu_tree_search.problems import taillard
 
 GOLDEN = pathlib.Path(__file__).parent / "golden" / "pfsp_lb2_ub1.jsonl"
+# 50-job class (counts regenerated from the reference compiled with
+# MAX_JOBS=50 per its own recipe, pfsp/README.md:52 / macro.h:9-11 —
+# the multi-word-bitmask LB2 path must reproduce them too)
+GOLDEN_WIDE = pathlib.Path(__file__).parent / "golden" \
+    / "pfsp_lb2_ub1_wide.jsonl"
 CASES = [json.loads(l) for l in GOLDEN.read_text().splitlines()]
+CASES += [json.loads(l) for l in GOLDEN_WIDE.read_text().splitlines()]
 
 # keep CI bounded: native handles everything below a million nodes quickly
 NATIVE_CASES = [c for c in CASES if c["tree"] <= 700_000]
