@@ -139,6 +139,56 @@ def test_lb2_multiword_bitmask_matches_scalar(jobs, machines):
                                       err_msg=f"parent {b}")
 
 
+@pytest.mark.parametrize("jobs,machines", [(20, 5), (50, 10)])
+def test_regather_multiword_sched_mask(jobs, machines):
+    """The two-phase engine's survivor regather rebuilds each child's
+    scheduled-set bitmask from its parent (device._regather
+    with_sched=True). Verify every word against a directly-built mask on
+    deep prefixes (many bits in the second word for jobs > 32) — the
+    TPU-only two-phase path consumes this, so a word-accumulation bug
+    here would not show up in the CPU engine tests."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.engine import device
+
+    rng = np.random.default_rng(jobs)
+    inst = PFSPInstance.synthetic(jobs=jobs, machines=machines, seed=1)
+    tables = batched.make_tables(inst.p_times)
+    B = 16
+    prmu, depth = random_parents(jobs, B, rng)
+    # deep prefixes so high-word bits accumulate
+    depth = np.clip(depth + jobs // 2, 0, jobs - 1).astype(np.int32)
+    front, _ = batched.parent_tables(tables, prmu, depth)
+
+    TB = B
+    N = B * jobs
+    # child columns c = slot*TB + parent (single tile): pick every real
+    # child slot of every parent
+    idx = []
+    for b in range(B):
+        for i in range(int(depth[b]), jobs):
+            idx.append(i * TB + b)
+    idx = jnp.asarray(np.asarray(idx, np.int32))
+    child, caux, sched = device._regather(
+        tables, jnp.asarray(prmu.T), jnp.asarray(depth, jnp.int32)[None, :],
+        jnp.asarray(front).T, idx, TB, with_sched=True)
+    sched = np.asarray(sched)
+
+    W = (jobs + 31) // 32
+    assert sched.shape[0] == W
+    k = 0
+    for b in range(B):
+        d = int(depth[b])
+        for i in range(d, jobs):
+            want = np.zeros(W, np.uint32)
+            for v in list(prmu[b, :d]) + [prmu[b, i]]:
+                want[int(v) // 32] |= np.uint32(1 << (int(v) % 32))
+            np.testing.assert_array_equal(
+                sched[:, k].view(np.uint32), want,
+                err_msg=f"parent {b} slot {i}")
+            k += 1
+
+
 def test_taillard_oracle_table_spotchecks():
     assert taillard.optimal_makespan(14) == 1377
     assert taillard.optimal_makespan(21) == 2297

@@ -241,18 +241,40 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
     return child, caux, jnp.concatenate(words, axis=0)
 
 
-def _tiered_compact(gather, perm, n_keep, N: int):
+def _compact_tiers(N: int, two_phase: bool = False) -> list[int]:
+    """Compaction tier widths. Few and carefully placed: every extra
+    lax.switch branch costs a copy of the (rows, N) output blocks
+    (measured: a 9-rung ladder cost LB1 14% of its step rate). The LB1
+    ladder holds its two steady-state occupancies (final push in N//16,
+    candidates in N//4); the two-phase LB2 ladder adds 3N//32 for the
+    post-prefilter survivors, which sit just above N//16 — a pow2-only
+    ladder would round them to N//4, 4x the gather+pad width (measured
+    on ta021: ncand~152k -> N//4, nkeep~43k -> 3N//32)."""
+    steps = ((N // 16, 3 * N // 32, N // 4) if two_phase
+             else (N // 16, N // 4))
+    return [t for t in steps if t >= 128] + [N]
+
+
+def _tier_switch(tiers: list[int], count, make_branch):
+    """Dispatch to the smallest tier covering `count` via ONE lax.switch
+    (a nested cond ladder copies its result at every level).
+    `make_branch(width) -> (_ -> result)` builds each branch; the last
+    tier must cover every possible count."""
+    if len(tiers) == 1:
+        return make_branch(tiers[0])(0)
+    sel = sum((count > t).astype(jnp.int32) for t in tiers[:-1])
+    return jax.lax.switch(sel, [make_branch(t) for t in tiers], 0)
+
+
+def _tiered_compact(gather, perm, n_keep, N: int, two_phase: bool = False):
     """Full-width (N-column) compacted block, built by the smallest tier
     that covers the `n_keep` survivors: a switch branch gathers only its
     tier's prefix via `gather(idx) -> tuple of (rows, len(idx)) blocks`
     and zero-pads the rest (a cheap sequential write; the garbage columns
-    land above the pool cursor and are never read). Steady-state LB1
-    steps take the N//4 tier, the post-prefilter LB2 rounds the N//16
-    one. The switch carries only these blocks — threading the HBM pools
-    through conditional branches copies them (measured: ~4x step cost),
-    which is why the caller writes the block into the pool outside."""
-    tiers = [t for t in (N // 16, N // 4) if t >= 128] + [N]
-
+    land above the pool cursor and are never read). The switch carries
+    only these blocks — threading the HBM pools through conditional
+    branches copies them (measured: ~4x step cost), which is why the
+    caller writes the block into the pool outside."""
     def branch(t):
         def f(_):
             out = gather(jax.lax.slice(perm, (0,), (t,)))
@@ -263,21 +285,19 @@ def _tiered_compact(gather, perm, n_keep, N: int):
             return out
         return f
 
-    if len(tiers) == 1:
-        return branch(tiers[0])(0)
-    sel = sum((n_keep > t).astype(jnp.int32) for t in tiers[:-1])
-    return jax.lax.switch(sel, [branch(t) for t in tiers], 0)
+    return _tier_switch(_compact_tiers(N, two_phase), n_keep, branch)
 
 
 def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
                           perm, n_keep, TB: int, N: int,
-                          with_sched: bool = False):
+                          with_sched: bool = False,
+                          two_phase: bool = False):
     """Compacted child block rebuilt from the popped parents (see
     _regather), tiered by survivor count (see _tiered_compact)."""
     def gather(idx):
         return _regather(tables, p_prmu, p_depth2, p_aux, idx, TB,
                          with_sched)
-    return _tiered_compact(gather, perm, n_keep, N)
+    return _tiered_compact(gather, perm, n_keep, N, two_phase)
 
 
 def pop_chunk(state: SearchState, B: int, M: int):
@@ -359,13 +379,18 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         perm1 = _partition(cand)
         children, caux, sched = _compact_from_parents(
             tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
-            with_sched=True)
+            with_sched=True, two_phase=True)
 
         def sweep_tiers(tbl, cf_cols, sched_cols, count):
             """Pair sweep over the smallest prefix tier covering `count`
-            live columns; columns past the tier read I32_MAX."""
-            tiers = [t for t in (N // 64, N // 32, N // 16, N // 8,
-                                 N // 4, N // 2)
+            live columns; columns past the tier read I32_MAX. Finer
+            ladder than the compaction's (its branches carry only a
+            (1, N) row, so extra rungs are nearly free) with 3/2^k rungs
+            for the same occupancy reason (_compact_tiers); each rung
+            must satisfy the pair-sweep kernel's own lane-tile gate or
+            lb2_bounds would silently take its XLA fallback there."""
+            tiers = [t for t in (N // 64, N // 32, 3 * N // 64, N // 16,
+                                 3 * N // 32, N // 8, N // 4, N // 2)
                      if t > 0 and min(4096, t & -t)
                      >= pallas_expand.MIN_PALLAS_TILE]
             tiers.append(N)
@@ -381,12 +406,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                     return b
                 return f
 
-            if len(tiers) == 1:
-                return prefix(tiers[0])(0)
-            # one switch, not a nested cond ladder: every cond level
-            # copies its (1, N) result, so a 7-deep ladder pays 7 copies
-            sel = sum((count > t).astype(jnp.int32) for t in tiers[:-1])
-            return jax.lax.switch(sel, [prefix(t) for t in tiers], 0)
+            return _tier_switch(tiers, count, prefix)
 
         def take_block(*rows_arrays):
             """prefix-gather closure over the given (rows, N) arrays."""
@@ -416,7 +436,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             # the partial bound rides the compaction as an extra row
             aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
             children, aux_plus = _tiered_compact(
-                take_block(children, aux_plus), permh, nkeep, N)
+                take_block(children, aux_plus), permh, nkeep, N,
+                two_phase=True)
             caux = aux_plus[:M + 1]
             sched = aux_plus[M + 1:M + 1 + SW]
             lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
@@ -443,7 +464,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # block (sources are the compacted (features, N) arrays)
         perm2 = _partition(push)
         children, child_aux = _tiered_compact(
-            take_block(children, caux), perm2, n_push, N)
+            take_block(children, caux), perm2, n_push, N, two_phase=True)
         child_depth = child_aux[M].astype(jnp.int16)
     else:
         # --- bounds of the dense child grid (Pallas on TPU; the children
