@@ -1,0 +1,79 @@
+"""Exclusive (self-time) op profile from a jax.profiler Chrome trace.
+
+Chrome-trace 'X' events in the device 'XLA Ops' lane nest by timestamp
+containment (control-flow ops like while/conditional span their bodies).
+Summing raw durations double-counts; this computes each op's SELF time
+(duration minus directly-contained children) and aggregates by op name.
+
+    python tools/trace_selftime.py /tmp/tts_trace_lb2 [--top 40]
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+
+
+def load(log_dir):
+    paths = glob.glob(os.path.join(
+        log_dir, "plugins", "profile", "*", "*.trace.json.gz"))
+    ev = []
+    for p in paths:
+        with gzip.open(p, "rt") as f:
+            ev.extend(json.load(f).get("traceEvents", []))
+    return ev
+
+
+def self_times(events, lane="XLA Ops"):
+    tn = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tn[(e["pid"], e["tid"])] = e["args"]["name"]
+    # nesting is only meaningful within one (pid, tid) lane — group
+    # first so multi-core traces don't cross-attribute children
+    lanes = collections.defaultdict(list)
+    for e in events:
+        if (e.get("ph") == "X" and "dur" in e
+                and tn.get((e.get("pid"), e.get("tid"))) == lane):
+            lanes[(e["pid"], e["tid"])].append(e)
+    self_us = collections.Counter()
+    counts = collections.Counter()
+    for xs in lanes.values():
+        # sort by start asc, duration desc so parents precede children
+        xs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # (end_ts, name) of open enclosing events
+        for e in xs:
+            ts, dur, name = e["ts"], e["dur"], e["name"]
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            self_us[name] += dur
+            counts[name] += 1
+            if stack:
+                self_us[stack[-1][1]] -= dur
+            stack.append((ts + dur, name))
+    return self_us, counts
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("logdir")
+    ap.add_argument("--top", type=int, default=40)
+    ap.add_argument("--iters", type=int, default=None,
+                    help="divide totals by this many loop iterations")
+    args = ap.parse_args()
+    self_us, counts = self_times(load(args.logdir))
+    total = sum(self_us.values())
+    print(f"total device self-time: {total/1e3:.2f} ms"
+          + (f"  ({total/1e3/args.iters:.3f} ms/iter)" if args.iters
+             else ""))
+    hdr = f"{'self_ms':>10} {'ms/iter':>8} {'count':>6}  name"
+    print(hdr)
+    for name, s in self_us.most_common(args.top):
+        per = f"{s/1e3/args.iters:8.3f}" if args.iters else " " * 8
+        print(f"{s/1e3:10.2f} {per} {counts[name]:6d}  {name[:100]}")
+
+
+if __name__ == "__main__":
+    main()

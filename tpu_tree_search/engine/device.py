@@ -438,6 +438,14 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             children, aux_plus = _tiered_compact(
                 take_block(children, aux_plus), permh, nkeep, N,
                 two_phase=True)
+            # barrier: the tail sweep's pallas call must see the
+            # mid-compaction's switch outputs materialized — without
+            # this, XLA's fusion of the slice chain miscompiles the
+            # compiled (jitted) step on TPU and the tail sweep reads
+            # stale columns, silently over-pruning (eager and
+            # debug-tapped traces are correct — caught by
+            # test_prefilter_branch_matches_oracle on hardware)
+            aux_plus = jax.lax.optimization_barrier(aux_plus)
             caux = aux_plus[:M + 1]
             sched = aux_plus[M + 1:M + 1 + SW]
             lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]

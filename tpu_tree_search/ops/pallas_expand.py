@@ -442,20 +442,30 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
     tails1 = jnp.take(tables.min_tails, tables.ma1)[:, None]
 
     kernel = functools.partial(_lb2_kernel, J, M, P, PB)
-    call = pl.pallas_call(
-        kernel,
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 10,
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, NT), jnp.int32),
-    )
-    pieces = []
-    for g in range(N // NT):
-        sl = slice(g * NT, (g + 1) * NT)
-        pieces.append(call(sel0, sel1, js1h, pt0, pt1, lag, tails0, tails1,
-                           child_front_cols[:, sl], unsched_cols[:, sl]))
-    if len(pieces) == 1:
-        return pieces[0]
-    return jnp.concatenate(pieces, axis=1)
+    # ONE pallas_call with a grid over column tiles (round 2 issued one
+    # call per tile: at production shapes that is ~55 dispatches/step,
+    # each re-fetching every pair table into VMEM — measured 27% of the
+    # two-phase step). Constant index_maps keep the tables resident
+    # across grid steps while the column blocks double-buffer.
+    # The x64-off scope is load-bearing: the package enables x64 globally
+    # (engine counters are int64), and under x64 the grid index maps
+    # trace their constants as i64 — mosaic then fails to legalize the
+    # index-map function ("failed to legalize operation 'func.return'").
+    # Nothing in this call touches 64-bit data, so scoping the trace to
+    # x32 is semantics-preserving.
+    with jax.enable_x64(False):
+        call = pl.pallas_call(
+            kernel,
+            grid=(N // NT,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)] * 8 + [
+                pl.BlockSpec((M, NT), lambda g: (0, g)),
+                pl.BlockSpec((J, NT), lambda g: (0, g)),
+            ],
+            out_specs=pl.BlockSpec((1, NT), lambda g: (0, g)),
+            out_shape=jax.ShapeDtypeStruct((1, N), jnp.int32),
+        )
+        return call(sel0, sel1, js1h, pt0, pt1, lag, tails0, tails1,
+                    child_front_cols, unsched_cols)
 
 
 def _to_cols(x, G: int, TB: int, J: int):
