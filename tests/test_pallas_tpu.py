@@ -125,11 +125,12 @@ def test_two_phase_lb2_engine_matches_golden_large():
 
 
 def test_prefilter_branch_matches_oracle():
-    """The strong-pair prefilter only compiles in when P > 2*32 pairs —
-    i.e. >= 12 machines — which no small-class golden reaches (20x5 has
-    P=10). This synthetic 8-job x 15-machine instance (P=105) forces the
-    prefilter path end-to-end on hardware and checks the full search
-    against the sequential oracle."""
+    """The strong-pair prefilter only compiles in when
+    P > 2*PAIR_PREFILTER pairs (=48: >= 11 machines) — which no
+    small-class golden reaches (20x5 has P=10). This synthetic
+    8-job x 15-machine instance (P=105) forces the prefilter path
+    end-to-end on hardware and checks the full search against the
+    sequential oracle."""
     from tpu_tree_search.engine import device, sequential as seq
     from tpu_tree_search.problems.pfsp import PFSPInstance
 

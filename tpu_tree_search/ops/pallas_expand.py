@@ -277,6 +277,11 @@ def sched_words(jobs: int) -> int:
 
 LB2_ONEHOT_VMEM = 4 << 20
 
+# pair-sweep kernel tuning knobs (see lb2_bounds_tpu): sublane block of
+# pair rows, and the column-tile cap
+LB2_PB = 64
+LB2_TILE = 4096
+
 
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
     """The pair-sweep kernel keeps its (J, P, J) f32 per-step job one-hot
@@ -367,32 +372,37 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
 
     cf_ref (M, NT) child fronts; unsched_ref (J, NT) f32 0/1 per job;
     tables: sel0/sel1 (P, M) f32 pair-machine one-hots, js1h (J, P, J)
-    f32 per-step job one-hots, pt0/pt1/lag (P, J) i32, tails (P, 1) i32.
+    f32 per-step job one-hots, pt0/pt1/lag (P, J) f32, tails (P, 1) f32.
     Output bounds (1, NT) i32.
     """
     cf_f = cf_ref[:].astype(jnp.float32)            # (M, NT)
     unsched = unsched_ref[:]                        # (J, NT) f32
     hi = jax.lax.Precision.HIGHEST
     lb = None
+    # All values are small non-negative integers (completion times
+    # < 2^24), so f32 arithmetic is EXACT and the active-select chain
+    # becomes mul/max forms the VPU executes with fewer ops than
+    # compare+select: t0 update is one fma (act is exactly 0/1 from the
+    # one-hot matmul), and the t1 select is max(t1, act*cand) — valid
+    # because cand >= t1 whenever act == 1 and everything is >= 0.
     for lo in range(0, P, PB):
         nrows = min(PB, P - lo)
         sl = slice(lo, lo + nrows)
         t0 = jnp.dot(sel0_ref[sl, :], cf_f, precision=hi,
-                     preferred_element_type=jnp.float32).astype(jnp.int32)
+                     preferred_element_type=jnp.float32)
         t1 = jnp.dot(sel1_ref[sl, :], cf_f, precision=hi,
-                     preferred_element_type=jnp.float32).astype(jnp.int32)
+                     preferred_element_type=jnp.float32)
         for j in range(J):
             act = jnp.dot(js1h_ref[j, sl, :], unsched, precision=hi,
-                          preferred_element_type=jnp.float32) > 0.5
-            new0 = t0 + pt0_ref[sl, j:j + 1]
-            new1 = jnp.maximum(t1, new0 + lag_ref[sl, j:j + 1]) \
+                          preferred_element_type=jnp.float32)
+            t0 = t0 + act * pt0_ref[sl, j:j + 1]
+            cand = jnp.maximum(t1, t0 + lag_ref[sl, j:j + 1]) \
                 + pt1_ref[sl, j:j + 1]
-            t0 = jnp.where(act, new0, t0)
-            t1 = jnp.where(act, new1, t1)
+            t1 = jnp.maximum(t1, act * cand)
         per_pair = jnp.maximum(t1 + tails1_ref[sl, :], t0 + tails0_ref[sl, :])
         blk = jnp.max(per_pair, axis=0, keepdims=True)
         lb = blk if lb is None else jnp.maximum(lb, blk)
-    bounds_ref[:] = lb
+    bounds_ref[:] = lb.astype(jnp.int32)
 
 
 def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
@@ -407,7 +417,7 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
     N = child_front_cols.shape[1]
     J = tables.js.shape[1]
     P = int(tables.ma0.shape[0])
-    nt = min(4096, N & -N)
+    nt = min(LB2_TILE, N & -N)
     if (jax.default_backend() != "tpu" or nt < MIN_PALLAS_TILE
             or not lb2_kernel_fits(J, P)):
         return lb2_cols(tables, sched_mask, child_front_cols)
@@ -421,13 +431,13 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
 
 @functools.partial(jax.jit, static_argnames=("tile",))
 def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
-                   tile: int = 4096):
+                   tile: int = LB2_TILE):
     """Pallas LB2 over child columns: child_front_cols (M, N) i32,
     unsched_cols (J, N) f32 — returns (1, N) i32 bounds."""
     M, N = child_front_cols.shape
     J = unsched_cols.shape[0]
     P = tables.ma0.shape[0]
-    PB = 64
+    PB = LB2_PB
     NT = tile
     assert N % NT == 0, (N, NT)
 
@@ -435,11 +445,14 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
     sel1 = (tables.ma1[:, None] == jnp.arange(M)).astype(jnp.float32)
     js1h = (tables.js.T[:, :, None]
             == jnp.arange(J)).astype(jnp.float32)       # (J, P, J)
-    pt0 = tables.ptm0_js
-    pt1 = tables.ptm1_js
-    lag = tables.lag_js
-    tails0 = jnp.take(tables.min_tails, tables.ma0)[:, None]
-    tails1 = jnp.take(tables.min_tails, tables.ma1)[:, None]
+    # f32 tables: the kernel's whole chain runs in (exact) f32
+    pt0 = tables.ptm0_js.astype(jnp.float32)
+    pt1 = tables.ptm1_js.astype(jnp.float32)
+    lag = tables.lag_js.astype(jnp.float32)
+    tails0 = jnp.take(tables.min_tails, tables.ma0)[:, None] \
+        .astype(jnp.float32)
+    tails1 = jnp.take(tables.min_tails, tables.ma1)[:, None] \
+        .astype(jnp.float32)
 
     kernel = functools.partial(_lb2_kernel, J, M, P, PB)
     # ONE pallas_call with a grid over column tiles (round 2 issued one
@@ -644,7 +657,7 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
     if ok and lb_kind == 2:
         N = B * J
         nt = N & -N                      # largest power-of-two divisor
-        nt = min(nt, 4096)
+        nt = min(nt, LB2_TILE)
         if nt >= MIN_PALLAS_TILE:
             children, aux, _ = expand_tpu(tables, prmu_T, depth2, front_T,
                                           lb_kind=1, tile=eff_tile)
