@@ -7,7 +7,14 @@ ppr:1:node, README.md:109-116). The SAME SPMD program then runs
 unchanged; only the mesh spans two controllers, which exercises the
 multi-controller branches (_to_mesh, _fetch, checkpoint._to_np).
 
-Usage: python tests/_multihost_worker.py PORT PROCESS_ID NUM_PROCESSES
+Usage: python tests/_multihost_worker.py PORT PROCESS_ID NUM_PROCESSES \
+           [MODE CHECKPOINT_PATH [MAX_ROUNDS]]
+
+MODE "plain" (default) runs to completion without durability. "trunc"
+runs the SEGMENTED driver with a checkpoint and a round ceiling (the
+kill half of the multihost kill/resume invariant: only process 0 writes
+the file — checkpoint.save rank-gating). "resume" loads that checkpoint
+on every process and finishes the search.
 """
 
 import json
@@ -17,6 +24,9 @@ import sys
 
 def main():
     port, pid, nproc = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "plain"
+    ckpt = sys.argv[5] if len(sys.argv) > 5 else None
+    max_rounds = int(sys.argv[6]) if len(sys.argv) > 6 else None
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("XLA_FLAGS", None)
 
@@ -34,8 +44,13 @@ def main():
 
     inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=0)
     opt = inst.brute_force_optimum()
+    kw = {}
+    if mode in ("trunc", "resume"):
+        kw = dict(segment_iters=8, checkpoint_path=ckpt, heartbeat=None)
+        if mode == "trunc":
+            kw["max_rounds"] = max_rounds
     res = distributed.search(inst.p_times, lb_kind=1, init_ub=opt,
-                             chunk=8, capacity=1 << 12, min_seed=4)
+                             chunk=8, capacity=1 << 12, min_seed=4, **kw)
     print("RESULT " + json.dumps({
         "process": pid,
         "tree": res.explored_tree,

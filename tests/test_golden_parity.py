@@ -12,6 +12,7 @@ the strongest cross-implementation invariant the reference offers
 import json
 import pathlib
 
+import numpy as np
 import pytest
 
 from tpu_tree_search import native
@@ -23,8 +24,18 @@ GOLDEN = pathlib.Path(__file__).parent / "golden" / "pfsp_lb2_ub1.jsonl"
 # the multi-word-bitmask LB2 path must reproduce them too)
 GOLDEN_WIDE = pathlib.Path(__file__).parent / "golden" \
     / "pfsp_lb2_ub1_wide.jsonl"
+# DEEP wide coverage: synthetic 40-50-job instances with 10^4-10^6-node
+# trees at a fixed valid ub, goldened against the reference's own
+# decompose/lb2_bound via the matrix-input wrapper main
+# (tools/gen_matrix_goldens.py; .ref_build/wrap/pfsp/pfsp_mat.c) — the
+# Taillard 50-job instances are all root-pruned or >2^31 nodes, so only
+# synthetic instances can pin the multi-word two-phase path at depth
+GOLDEN_MATRIX = pathlib.Path(__file__).parent / "golden" \
+    / "pfsp_lb2_matrix.jsonl"
 CASES = [json.loads(l) for l in GOLDEN.read_text().splitlines()]
 CASES += [json.loads(l) for l in GOLDEN_WIDE.read_text().splitlines()]
+MATRIX_CASES = [json.loads(l)
+                for l in GOLDEN_MATRIX.read_text().splitlines()]
 
 # keep CI bounded: native handles everything below a million nodes quickly
 NATIVE_CASES = [c for c in CASES if c["tree"] <= 700_000]
@@ -49,5 +60,36 @@ def test_device_engine_matches_reference(case):
     ub = taillard.optimal_makespan(case["inst"])
     out = device.search(p, lb_kind=2, init_ub=ub, chunk=64,
                         capacity=1 << 16)
+    assert (out.explored_tree, out.explored_sol, out.best) == \
+           (case["tree"], case["sol"], case["best"])
+
+
+def _matrix_id(c):
+    return f"{c['jobs']}x{c['machines']}s{c['seed']}_{c['tree']}"
+
+
+@pytest.mark.parametrize("case", MATRIX_CASES, ids=_matrix_id)
+def test_native_matches_reference_deep_wide(case):
+    """>=10^4-node trees with jobs > 32: the native engine against the
+    reference's own library on arbitrary matrices (VERDICT r2 #3 — the
+    round-2 wide goldens only pinned 0-3-node trees)."""
+    p = np.asarray(case["p"], np.int32).reshape(case["machines"],
+                                                case["jobs"])
+    tree, sol, best, _ = native.search(p, lb_kind=2, init_ub=case["ub"])
+    assert (tree, sol, best) == (case["tree"], case["sol"], case["best"])
+
+
+@pytest.mark.parametrize("case", MATRIX_CASES, ids=_matrix_id)
+def test_device_engine_matches_reference_deep_wide(case):
+    """Same invariant through the batched engine — on the CPU backend
+    this drives the XLA multi-word LB2 path; under TTS_TEST_TPU=1 on
+    hardware it drives the two-phase pallas path (prefilter + multi-word
+    bitmask) through trees five orders deeper than the round-2 wide
+    goldens."""
+    from tpu_tree_search.engine import device
+    p = np.asarray(case["p"], np.int32).reshape(case["machines"],
+                                                case["jobs"])
+    out = device.search(p, lb_kind=2, init_ub=case["ub"], chunk=256,
+                        capacity=1 << 18)
     assert (out.explored_tree, out.explored_sol, out.best) == \
            (case["tree"], case["sol"], case["best"])

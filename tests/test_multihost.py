@@ -29,7 +29,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_multihost_matches_single_controller():
+def _launch_pair(*extra_args):
+    """Run the 2-process worker pair; returns both RESULT dicts."""
     port = _free_port()
     repo_root = WORKER.parent.parent
     env = dict(os.environ)
@@ -39,9 +40,10 @@ def test_two_process_multihost_matches_single_controller():
         [str(repo_root)] + env.get("PYTHONPATH", "").split(os.pathsep))
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(port), str(i), "2"],
+            [sys.executable, str(WORKER), str(port), str(i), "2",
+             *map(str, extra_args)],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=str(WORKER.parent.parent))
+            env=env, cwd=str(repo_root))
         for i in range(2)
     ]
     outs = []
@@ -60,6 +62,11 @@ def test_two_process_multihost_matches_single_controller():
         lines = [ln for ln in out.splitlines() if ln.startswith("RESULT ")]
         assert lines, f"no RESULT line in worker output:\n{out[-2000:]}"
         results.append(json.loads(lines[-1][len("RESULT "):]))
+    return results
+
+
+def test_two_process_multihost_matches_single_controller():
+    results = _launch_pair()
 
     # every process reports identical global totals
     assert results[0]["tree"] == results[1]["tree"]
@@ -78,3 +85,31 @@ def test_two_process_multihost_matches_single_controller():
     assert results[0]["tree"] == want.explored_tree
     assert results[0]["sol"] == want.explored_sol
     assert results[0]["best"] == want.best
+
+
+def test_two_process_multihost_kill_resume(tmp_path):
+    """Multihost DURABILITY (the tier the reference's MPI flagship has no
+    answer to): a 2-process segmented run truncated mid-search writes a
+    rank-0-gated checkpoint (checkpoint.save: every rank joins the
+    collective fetch, only process 0 writes the shared file); a SECOND
+    2-process launch resumes it and the final totals match the
+    uninterrupted single-controller oracle exactly."""
+    ck = str(tmp_path / "mh.npz")
+    trunc = _launch_pair("trunc", ck, 1)
+    assert not trunc[0]["complete"], \
+        "truncated run drained the pool; lower MAX_ROUNDS"
+    assert os.path.exists(ck), "rank 0 wrote no checkpoint"
+    assert not os.path.exists(str(tmp_path / "mh.tmp.npz")), \
+        "stray tmp file left"
+
+    resumed = _launch_pair("resume", ck)
+    for k in ("tree", "sol", "best", "complete"):
+        assert resumed[0][k] == resumed[1][k]
+    assert resumed[0]["complete"]
+
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=0)
+    opt = inst.brute_force_optimum()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    assert resumed[0]["tree"] == want.explored_tree
+    assert resumed[0]["sol"] == want.explored_sol
+    assert resumed[0]["best"] == want.best

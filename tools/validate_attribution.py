@@ -1,0 +1,91 @@
+"""Validate phase_timing.attribute against a profiler trace (VERDICT r3 #9).
+
+phase_timing attributes wall time from measured unit costs x counters
+(kernel/compaction/balance/idle). This script checks its kernel share
+against ground truth from a jax.profiler trace of the same steady-state
+window, for one LB1 and one LB2 ta021 run, and prints the error margin.
+
+    python tools/validate_attribution.py [--iters 30] [--chunk 32768]
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trace_selftime import load, self_times  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+from tpu_tree_search.engine import device  # noqa: E402
+from tpu_tree_search.ops import batched  # noqa: E402
+from tpu_tree_search.problems import taillard  # noqa: E402
+from tpu_tree_search.utils import device_info, phase_timing  # noqa: E402
+
+KERNEL_OPS = ("expand_bounds", "lb2_bounds", "pallas")
+
+
+def trace_kernel_share(log_dir):
+    self_us, _ = self_times(load(log_dir))
+    total = sum(self_us.values())
+    kern = sum(v for k, v in self_us.items()
+               if any(s in k.lower() for s in KERNEL_OPS))
+    return kern / total if total else 0.0, total / 1e6
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--chunk", type=int, default=32768)
+    ap.add_argument("--inst", type=int, default=21)
+    ap.add_argument("--warm", type=int, default=400)
+    args = ap.parse_args()
+
+    p = taillard.processing_times(args.inst)
+    ub = taillard.optimal_makespan(args.inst)
+    tables = batched.make_tables(p)
+    jobs = p.shape[1]
+
+    for lb in (1, 2):
+        state = device.init_state(jobs, 1 << 22, ub, p_times=p)
+        state = device.run(tables, state, lb, args.chunk,
+                           max_iters=args.warm)
+        state.size.block_until_ready()
+
+        # the attribution's unit costs, measured on the same shapes
+        prof = phase_timing.profile_phases(tables, state, lb, args.chunk)
+
+        log_dir = tempfile.mkdtemp(prefix=f"tts_attr_lb{lb}_")
+        t0 = time.perf_counter()
+        with device_info.trace(log_dir):
+            out = device.run(tables, state, lb, args.chunk,
+                             max_iters=args.warm + args.iters)
+            out.size.block_until_ready()
+        elapsed = time.perf_counter() - t0
+        evals = int(out.evals) - int(state.evals)
+        iters = int(out.iters) - int(state.iters)
+
+        att = phase_timing.attribute(prof, elapsed, [evals], [iters])
+        att_kernel = float(att["kernel_time"][0])
+        att_share = att_kernel / elapsed
+
+        trace_share, trace_total_s = trace_kernel_share(log_dir)
+        # compare against the DEVICE-time share too: wall includes
+        # dispatch/host gaps the device never sees
+        att_dev_share = att_kernel / trace_total_s if trace_total_s else 0
+
+        print(f"lb={lb}: attribute kernel share of WALL "
+              f"{att_share:6.1%}  of device time {att_dev_share:6.1%}  "
+              f"| trace ground truth {trace_share:6.1%}  "
+              f"| error vs device-share "
+              f"{abs(att_dev_share - trace_share):5.1%} "
+              f"(wall {elapsed:.2f}s, device {trace_total_s:.2f}s, "
+              f"{iters} iters)")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,7 +39,11 @@ def _pfsp_parser(sub):
     p.add_argument("-L", type=int, default=d.L)
     p.add_argument("-p", "--perc", type=float, default=d.perc)
     p.add_argument("--chunk", type=int, default=d.chunk)
-    p.add_argument("--capacity", type=int, default=d.capacity)
+    p.add_argument("--capacity", type=int, default=None,
+                   help=f"pool rows (default: sized by instance class, "
+                        f"at least {d.capacity}; weak-bound classes "
+                        "like 50x5 pre-size large — device."
+                        "default_capacity)")
     p.add_argument("--balance-period", type=int, default=d.balance_period)
     p.add_argument("--csv", type=str, default=None)
     p.add_argument("--max-iters", type=int, default=None,
@@ -50,6 +54,10 @@ def _pfsp_parser(sub):
     p.add_argument("--checkpoint", type=str, default=None,
                    help="checkpoint path; if the file exists the search "
                         "resumes from it")
+    p.add_argument("--checkpoint-every", type=int, default=1,
+                   help="write the checkpoint every N segments (the "
+                        "compressed pool snapshot costs seconds at "
+                        "production sizes; amortize it on long runs)")
     p.add_argument("--grow-capacity", type=int, default=None,
                    help="re-home a resumed checkpoint into a larger pool "
                         "(recovery after an overflow abort)")
@@ -95,29 +103,38 @@ def run_pfsp(args) -> int:
 
     p = taillard.processing_times(args.inst)
     jobs, machines = p.shape[1], p.shape[0]
+    if args.capacity is None:
+        args.capacity = device.default_capacity(jobs, machines)
     init_ub = taillard.optimal_makespan(args.inst) if args.ub == 1 else None
     n_dev = args.D if args.D > 0 else len(jax.devices())
-    if args.C and n_dev != 1:
-        print("warning: -C heterogeneous co-processing requires -D 1; "
-              "running the distributed engine without a host tier",
-              file=sys.stderr)
-        args.C = 0
+    # -C composes with EVERY tier: single-device (hybrid.search),
+    # single-device segmented (_run_pfsp_segmented's host session),
+    # multi-device and the segmented/checkpointed flagship
+    # (distributed.search host_fraction) — the reference runs CPU
+    # workers beside both its multi-GPU and distributed engines
+    host_fraction = 8 if args.C else 0
     _print_pfsp_settings(args, machines, jobs, n_dev)
 
     t0 = time.perf_counter()
     if args.segment_iters is not None or args.checkpoint is not None:
         if n_dev == 1:
             try:
-                out = _run_pfsp_segmented(args, p, init_ub)
+                out, extras = _run_pfsp_segmented(args, p, init_ub,
+                                                  host_fraction)
             except (RuntimeError, ValueError, OSError) as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
-            tree, sol, best = int(out.tree), int(out.sol), int(out.best)
+            tree = int(out.tree) + extras["tree"]
+            sol = int(out.sol) + extras["sol"]
+            best = int(out.best)
+            if extras["best"] is not None:
+                best = min(best, extras["best"])
             complete = int(np.asarray(out.size).sum()) == 0
-            per_device = {"tree": [tree], "sol": [sol],
+            per_device = {"tree": [int(out.tree)], "sol": [int(out.sol)],
                           "evals": [int(out.evals)],
                           "iters": [int(out.iters)],
-                          "steals": [0], "recv": [0]}
+                          "steals": [0], "recv": [0],
+                          **extras["host"]}
         else:
             # distributed durability: segmented SPMD loop with stacked
             # checkpoint/resume and per-worker heartbeat
@@ -143,7 +160,9 @@ def run_pfsp(args) -> int:
                                   else 2**30),
                     min_seed=args.m, max_rounds=args.max_iters,
                     segment_iters=args.segment_iters,
-                    checkpoint_path=args.checkpoint, heartbeat=heartbeat)
+                    checkpoint_path=args.checkpoint, heartbeat=heartbeat,
+                    checkpoint_every=getattr(args, "checkpoint_every", 1),
+                    host_fraction=host_fraction)
             except (RuntimeError, ValueError, OSError) as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
@@ -184,7 +203,8 @@ def run_pfsp(args) -> int:
             balance_period=args.balance_period,
             min_transfer=(None if (args.ws or args.L) else 2**30),
             min_seed=args.m,
-            max_rounds=args.max_iters)
+            max_rounds=args.max_iters,
+            host_fraction=host_fraction)
         tree, sol, best = res.explored_tree, res.explored_sol, res.best
         complete = res.complete
         per_device = {k: list(v) for k, v in res.per_device.items()}
@@ -229,20 +249,34 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
             from .ops import reference as ref
             from .parallel.mesh import worker_mesh
 
-            transfer_cap, min_transfer = 4 * args.chunk, 2 * args.chunk
-            limit = min(dev.row_limit(args.capacity, args.chunk, jobs),
-                        args.capacity - n_dev * transfer_cap)
+            transfer_cap = dist.default_transfer_cap(
+                args.chunk, jobs, machines, n_dev)
+            min_transfer = 2 * args.chunk
+            # the profiled round must honor _balance_round's contract
+            # limit <= capacity - D*transfer_cap with limit >= 1; a
+            # too-small capacity is GROWN (the same pre-grow rule as
+            # _DistDriver.seed) rather than clamped — a clamped limit
+            # times a degenerate exchange whose writes land on live rows
+            cap = args.capacity
+
+            def _limit(c):
+                return min(dev.row_limit(c, args.chunk, jobs),
+                           c - n_dev * transfer_cap)
+
+            while _limit(cap) < 1:
+                cap *= 2
+            limit = _limit(cap)
             fr = dist.Frontier(
                 prmu=np.arange(jobs, dtype=np.int16)[None, :],
                 depth=np.zeros(1, np.int16), tree=0, sol=0,
                 best=best)
             fr.aux = ref.prefix_front_remain(
                 p, fr.prmu, fr.depth)[:, :machines]
-            leaves = dist._shard_frontier(fr, n_dev, args.capacity, jobs,
-                                          best, limit=max(limit, 1))
+            leaves = dist._shard_frontier(fr, n_dev, cap, jobs,
+                                          best, limit=limit)
             t_bal = phase_timing.profile_balance(
                 worker_mesh(n_dev), leaves, transfer_cap, min_transfer,
-                max(limit, 1))
+                limit)
             rounds = int(np.max(iters)) // max(1, args.balance_period)
         att = phase_timing.attribute(prof, elapsed, evals, iters,
                                      balance_rounds=rounds,
@@ -264,24 +298,71 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
                              elapsed, tree, sol, per_device)
 
 
-def _run_pfsp_segmented(args, p, init_ub):
+def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0):
     """Segmented single-device search with heartbeat + checkpoint/resume
-    (the durability layer the reference lacks, SURVEY.md §5)."""
+    (the durability layer the reference lacks, SURVEY.md §5). With
+    `host_fraction > 0` a native `-C` host session runs beside the
+    segments — seeded from a warm-up share (fresh) or rows carved off
+    the checkpointed pool (resume) — with incumbents merged at every
+    segment boundary (engine/hybrid.HostSession).
+
+    Returns (state, extras): host-tier tree/sol/counters to add to the
+    device totals (all zero without a host tier)."""
     import os
 
-    from .engine import checkpoint, device
+    from .engine import checkpoint, device, distributed, hybrid
     from .ops import batched
 
     jobs = p.shape[1]
     tables = batched.make_tables(p)
+    session = None
+    warm_tree = warm_sol = 0
+    h_prmu = np.zeros((0, jobs), np.int16)
+    h_depth = np.zeros(0, np.int16)
     if args.checkpoint and os.path.exists(args.checkpoint):
         state, meta = checkpoint.load(args.checkpoint, p_times=p)
         if args.grow_capacity:
             state = checkpoint.grow(state, args.grow_capacity)
+        warm_tree = int(meta.get("warmup_tree", 0))
+        warm_sol = int(meta.get("warmup_sol", 0))
+        # a -C checkpoint carries the host tier's carved seed nodes;
+        # resume re-seeds the session from them (or pushes them back
+        # into the pool when resuming without -C) — see
+        # engine/distributed.search for the same invariant
+        saved_p = np.asarray(meta.get("host_prmu",
+                                      np.zeros((0, jobs))), np.int16)
+        saved_d = np.asarray(meta.get("host_depth", np.zeros(0)),
+                             np.int16)
+        if host_fraction > 0:
+            if len(saved_d):
+                h_prmu, h_depth = saved_p, saved_d
+            else:
+                state, h_prmu, h_depth = hybrid.pop_host_share(
+                    state, host_fraction)
+            if len(h_depth):
+                session = hybrid.HostSession(
+                    p, h_prmu, h_depth, args.lb, int(state.best))
+        elif len(saved_d):
+            state = hybrid.restore_host_share(state, saved_p, saved_d, p)
         print(f"Resumed from {args.checkpoint} "
               f"(segment {int(meta.get('segment', 0))}, "
               f"iters {int(np.asarray(state.iters).max())}, "
               f"pool {int(np.asarray(state.size).sum())})")
+    elif host_fraction > 0:
+        # a host tier needs real nodes to seed: native warm-up frontier,
+        # stride-split exactly like hybrid.search
+        fr = distributed.bfs_warmup(p, args.lb, init_ub,
+                                    target=4 * host_fraction)
+        best0 = fr.best if init_ub is None else min(fr.best, int(init_ub))
+        warm_tree, warm_sol = fr.tree, fr.sol
+        dmask, h_prmu, h_depth = hybrid.split_host_share(
+            fr.prmu, fr.depth, host_fraction)
+        if len(h_depth):
+            session = hybrid.HostSession(p, h_prmu, h_depth, args.lb,
+                                         best0)
+        state = device.init_state(jobs, args.grow_capacity or args.capacity,
+                                  best0, prmu0=fr.prmu[dmask],
+                                  depth0=fr.depth[dmask], p_times=p)
     else:
         state = device.init_state(jobs, args.grow_capacity or args.capacity,
                                   init_ub, p_times=p)
@@ -296,10 +377,32 @@ def _run_pfsp_segmented(args, p, init_ub):
               f"sol={r.sol} best={r.best} pool={r.pool_size} "
               f"t={r.elapsed:.2f}s")
 
-    return checkpoint.run_segmented(
+    out = checkpoint.run_segmented(
         run_fn, state, segment_iters=seg_iters,
         checkpoint_path=args.checkpoint, heartbeat=heartbeat,
-        max_total_iters=args.max_iters)
+        checkpoint_every=getattr(args, "checkpoint_every", 1),
+        max_total_iters=args.max_iters,
+        checkpoint_meta={"warmup_tree": warm_tree, "warmup_sol": warm_sol,
+                         "host_prmu": (h_prmu if session else
+                                       np.zeros((0, jobs), np.int16)),
+                         "host_depth": (h_depth if session else
+                                        np.zeros(0, np.int16))},
+        post_segment=(session.post_segment if session else None))
+
+    extras = {"tree": warm_tree, "sol": warm_sol, "best": None,
+              "host": {}}
+    if session is not None:
+        session.offer(int(np.asarray(out.best).min()))
+        h_tree, h_sol, h_best, h_expanded = session.join()
+        extras["tree"] += h_tree
+        extras["sol"] += h_sol
+        extras["best"] = h_best
+        extras["host"] = {"host_tree": [h_tree], "host_sol": [h_sol],
+                          "host_expanded": [h_expanded],
+                          "exchanges": [session.exchanges],
+                          "host_improved": [session.host_improved],
+                          "dev_improved": [session.dev_improved]}
+    return out, extras
 
 
 def run_nqueens(args) -> int:

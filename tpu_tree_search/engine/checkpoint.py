@@ -63,6 +63,15 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
                              "pool re-home size")
         for k, v in meta.items():
             arrays[f"meta_{k}"] = np.asarray(v)
+    # Multi-controller: every process reaches this point (the _to_np
+    # fetches above are COLLECTIVE allgathers, so all ranks must run
+    # them and all hold identical data), but only process 0 writes —
+    # concurrent writes + renames of the same tmp file on a shared
+    # filesystem can corrupt or race the checkpoint. resume reads the
+    # same shared path on every process (load() is read-only).
+    import jax
+    if jax.process_index() != 0:
+        return
     path = pathlib.Path(path)
     tmp = path.with_suffix(".tmp.npz")
     np.savez_compressed(tmp, **arrays)
@@ -180,7 +189,8 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   max_total_iters: int | None = None,
                   stall_limit: int = 3,
                   raise_on_overflow: bool = True,
-                  checkpoint_meta: dict | None = None):
+                  checkpoint_meta: dict | None = None,
+                  post_segment=None):
     """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
     bounded segments.
 
@@ -191,6 +201,9 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     works.
 
     - checkpoints every `checkpoint_every` segments when a path is given;
+    - calls `post_segment(state) -> state` after each segment, BEFORE the
+      heartbeat/checkpoint, so cross-tier effects (the `-C` host
+      session's incumbent merge) land in both (engine/hybrid.HostSession);
     - calls `heartbeat(SegmentReport)` after each segment;
     - raises RuntimeError after `stall_limit` consecutive segments with no
       progress (tree/sol/iters all unchanged) — a compiled-loop stall is a
@@ -207,11 +220,22 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     start_iters = int(_to_np(state.iters).max())
     last = (start_iters, -1, -1)
     meta_base = dict(checkpoint_meta or {})
+
+    def final_save(s, seg):
+        # every exit path must leave a CURRENT checkpoint — with
+        # checkpoint_every > 1, returning without this leaves the file
+        # up to checkpoint_every-1 segments stale and a planned
+        # stop-then-resume silently redoes that work
+        if checkpoint_path and seg % checkpoint_every != 0:
+            save(checkpoint_path, s, meta={**meta_base, "segment": seg})
+
     while True:
         target = start_iters + (seg + 1) * segment_iters
         if max_total_iters is not None:
             target = min(target, start_iters + max_total_iters)
         state = run_fn(state, target)
+        if post_segment is not None:
+            state = post_segment(state)
         seg += 1
         iters = int(_to_np(state.iters).max())
         tree = int(_to_np(state.tree).sum())
@@ -231,9 +255,7 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         if checkpoint_path and seg % checkpoint_every == 0:
             save(checkpoint_path, state, meta={**meta_base, "segment": seg})
         if bool(_to_np(state.overflow).any()):
-            if checkpoint_path and seg % checkpoint_every != 0:
-                save(checkpoint_path, state,
-                     meta={**meta_base, "segment": seg})
+            final_save(state, seg)
             if raise_on_overflow:
                 hint = (f"resume from {checkpoint_path} with a larger "
                         "capacity" if checkpoint_path else
@@ -244,6 +266,7 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                     f"incomplete; {hint}", state)
             return state
         if size == 0:
+            final_save(state, seg)
             return state
         if (iters, tree, sol) == last:
             stalls += 1
@@ -255,7 +278,9 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
             stalls = 0
         last = (iters, tree, sol)
         if max_segments is not None and seg >= max_segments:
+            final_save(state, seg)
             return state
         if (max_total_iters is not None
                 and iters >= start_iters + max_total_iters):
+            final_save(state, seg)
             return state

@@ -383,7 +383,45 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     mask = (slot_c >= depth_c) & valid_c
 
     two_phase = lb_kind == 2 and pallas_expand.kernel_ok(J, TB, lb_kind)
-    if two_phase:
+    P = int(tables.ma0.shape[0]) if lb_kind == 2 else 0
+    KH = batched.PAIR_PREFILTER
+    if two_phase and P <= 2 * KH:
+        # One-shot dense LB2 for the FEW-PAIR classes (P <= 2*KH — no
+        # prefilter tier exists): sweep all P pairs over the dense child
+        # grid and compact ONCE. The two-phase detour assumes the LB1
+        # pre-prune removes most of the grid; in the weak-bound regimes
+        # these classes live in (ta031: 50x5, LB1 removes only ~27%) it
+        # removed almost nothing while its full-width regather+sort ran
+        # anyway — measured 10x slower per pushed node than ta021. With
+        # P this small the dense sweep costs less than the detour even
+        # when LB1 WOULD have pruned well (20x5: a wash), so the route
+        # is static. The explored set is identical either way (the final
+        # prune uses the same exact LB2 values), matching the
+        # reference's single code path (bounds_gpu.cu:252-316).
+        children_d, caux_d, lb2b = pallas_expand.expand(
+            tables, p_prmu, p_depth, p_aux, lb_kind=2, tile=TB)
+
+        is_leaf = ((depth_c + 1) == J) & mask
+        sol = state.sol + is_leaf.sum(dtype=jnp.int64)
+        # a complete schedule's LB2 == its makespan
+        leaf_best = jnp.where(is_leaf, lb2b, I32_MAX).min()
+        best = jnp.minimum(state.best, leaf_best)
+
+        push = (mask & ~is_leaf & (lb2b.reshape(1, -1) < best)).reshape(-1)
+        n_push = push.sum(dtype=jnp.int32)
+        tree = state.tree + n_push.astype(jnp.int64)
+
+        def take_dense(idx):
+            idx = jax.lax.optimization_barrier(idx)
+            out = (jnp.take(children_d, idx, axis=1),
+                   jnp.take(caux_d, idx, axis=1))
+            return jax.lax.optimization_barrier(out)
+
+        perm = _partition(push)
+        children, child_aux = _tiered_compact(take_dense, perm, n_push,
+                                              N, two_phase=True)
+        child_depth = child_aux[M].astype(jnp.int16)
+    elif two_phase:
         # Two-phase LB2 (TPU): bound every child with the near-free LB1
         # first (LB1 <= LB2, so LB1-pruning is sound and the explored
         # set stays the exact LB2 set), rebuild only the survivors from
@@ -452,43 +490,38 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # the prefix failed to prune (<10% on the 20x20 class). The
         # total bound stays exactly max(head, tail) = full LB2, so
         # explored trees are bit-identical to the single-sweep path.
-        P = int(tables.ma0.shape[0])
-        KH = batched.PAIR_PREFILTER
+        # (This branch only compiles when P > 2*KH; the few-pair classes
+        # take the one-shot dense route above.)
         SW = pallas_expand.sched_words(J)
-        if P > 2 * KH:
-            head_t, tail_t = batched.pair_split(tables, KH)
-            lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
-            keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
-            nkeep = keep.sum(dtype=jnp.int32)
-            permh = _partition_prefix(keep, ncand, N, two_phase=True)
-            # the partial bound rides the compaction as an extra row
-            # (two structural variants were tried and measured WORSE:
-            # an index-composed final gather that skips re-gathering
-            # children — the composing (N,) take lowers to a ~4.7 ms
-            # serialized gather — and one combined i32 block per
-            # compaction — +60% gather time, byte-bound at 40+ rows)
-            aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
-            children, aux_plus = _tiered_compact(
-                take_block(children, aux_plus), permh, nkeep, N,
-                two_phase=True)
-            # barrier: the tail sweep's pallas call must see the
-            # mid-compaction's switch outputs materialized — without
-            # this, XLA's fusion of the slice chain miscompiles the
-            # compiled (jitted) step on TPU and the tail sweep reads
-            # stale columns, silently over-pruning (eager and
-            # debug-tapped traces are correct — caught by
-            # test_prefilter_branch_matches_oracle on hardware)
-            aux_plus = jax.lax.optimization_barrier(aux_plus)
-            caux = aux_plus[:M + 1]
-            sched = aux_plus[M + 1:M + 1 + SW]
-            lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
-            lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
-            lb2b = jnp.maximum(lb2h_c, lb2t)
-            live = nkeep
-        else:
-            lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
-            lb2h_c = lb2t = lb2b    # debug-block fallbacks (no prefilter)
-            live = ncand
+        head_t, tail_t = batched.pair_split(tables, KH)
+        lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
+        keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
+        nkeep = keep.sum(dtype=jnp.int32)
+        permh = _partition_prefix(keep, ncand, N, two_phase=True)
+        # the partial bound rides the compaction as an extra row
+        # (two structural variants were tried and measured WORSE:
+        # an index-composed final gather that skips re-gathering
+        # children — the composing (N,) take lowers to a ~4.7 ms
+        # serialized gather — and one combined i32 block per
+        # compaction — +60% gather time, byte-bound at 40+ rows)
+        aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
+        children, aux_plus = _tiered_compact(
+            take_block(children, aux_plus), permh, nkeep, N,
+            two_phase=True)
+        # barrier: the tail sweep's pallas call must see the
+        # mid-compaction's switch outputs materialized — without
+        # this, XLA's fusion of the slice chain miscompiles the
+        # compiled (jitted) step on TPU and the tail sweep reads
+        # stale columns, silently over-pruning (eager and
+        # debug-tapped traces are correct — caught by
+        # test_prefilter_branch_matches_oracle on hardware)
+        aux_plus = jax.lax.optimization_barrier(aux_plus)
+        caux = aux_plus[:M + 1]
+        sched = aux_plus[M + 1:M + 1 + SW]
+        lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
+        lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
+        lb2b = jnp.maximum(lb2h_c, lb2t)
+        live = nkeep
 
         push = (jnp.arange(N) < live) & (lb2b.reshape(-1) < best)
         n_push = push.sum(dtype=jnp.int32)
@@ -603,6 +636,19 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
     return _run(tables, state, lb_kind, chunk,
                 jnp.asarray(ceiling, dtype=state.iters.dtype),
                 jnp.asarray(max(drain_min, 1), dtype=jnp.int32), tile=tile)
+
+
+def default_capacity(jobs: int, machines: int, floor: int = 1 << 18) -> int:
+    """Pool-capacity pre-sizing by instance class. The weak-bound
+    few-machine classes (ta031-class 50x5) hold ~11M live rows at their
+    peak (measured, BENCHMARKS r2); starting at the generic default
+    costs six doubling cycles, each a fetch + re-home + recompile.
+    Large-but-strong classes get one free doubling step instead."""
+    if jobs >= 40 and machines <= 8:
+        return max(1 << 24, floor)
+    if jobs >= 40 or machines <= 8:
+        return max(1 << 20, floor)
+    return floor
 
 
 class SearchResult(NamedTuple):

@@ -44,6 +44,26 @@ from .device import SearchState, row_limit as device_row_limit, step
 
 AX = WORKER_AXIS
 
+# per-worker byte budget for one balance round's all_to_all buffers
+# (each way); caps the DEFAULT transfer_cap at production shapes — see
+# default_transfer_cap() and tools/bench_balance.py for the measured
+# tradeoff
+BALANCE_BYTE_BUDGET = 64 << 20
+
+
+def default_transfer_cap(chunk: int, jobs: int, machines: int,
+                         n_dev: int) -> int:
+    """Default balance transfer cap: 4*chunk, byte-budgeted. The
+    all_to_all moves (2J + 4A + 2) bytes per column over D*transfer_cap
+    columns each way per worker; at production shapes (chunk 32768,
+    20x20, D=8) the uncapped default is ~122 MB of exchange buffer per
+    worker per round — the cap bounds it to BALANCE_BYTE_BUDGET.
+    SHARED by search() and the CSV phase profiler (cli) so the profiled
+    exchange is the one production runs."""
+    bytes_per_col = 2 * jobs + 4 * machines + 2
+    budget_cols = BALANCE_BYTE_BUDGET // (bytes_per_col * max(n_dev, 1))
+    return max(min(4 * chunk, budget_cols), 256)
+
 
 # ---------------------------------------------------------------------------
 # Step 1: host BFS warm-up (breadth generates parallelism; reference runs
@@ -485,7 +505,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            tables: BoundTables | None = None, mesh=None,
            segment_iters: int | None = None,
            checkpoint_path: str | None = None,
-           heartbeat=None) -> DistResult:
+           checkpoint_every: int = 1,
+           heartbeat=None, host_fraction: int = 0,
+           host_threads: int = 0) -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
@@ -494,10 +516,18 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     distributed durability layer the reference lacks entirely (its only
     stall tooling is a 10-second "Still Idle" print, dist:663-668). A
     checkpoint written here re-loads with its warm-up counters, so a
-    resumed run's totals match an uninterrupted one exactly."""
+    resumed run's totals match an uninterrupted one exactly.
+
+    `host_fraction > 0` runs the `-C` heterogeneous host tier BESIDE the
+    device mesh (the reference's CPU workers inside the distributed
+    flagship, dist:471-741): a native async session seeded with every
+    host_fraction-th warm-up node (on resume: rows carved off the top of
+    the checkpointed pools), incumbents merged both ways at every
+    segment boundary — a host tier forces segmented execution so the
+    exchange points exist."""
     import os
 
-    from . import checkpoint
+    from . import checkpoint, hybrid
 
     if mesh is None:
         mesh = worker_mesh(n_devices)
@@ -505,7 +535,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     jobs = p_times.shape[1]
     if tables is None:
         tables = batched.make_tables(p_times)
-    transfer_cap = transfer_cap or 4 * chunk
+    if transfer_cap is None:
+        transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
+                                            mesh.devices.size)
     min_transfer = min_transfer or 2 * chunk
 
     def make_local_step(t, limit):
@@ -516,6 +548,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         min_transfer,
         limit_fn=lambda cap: device_row_limit(cap, chunk, jobs))
 
+    session = None
+    h_prmu = np.zeros((0, jobs), np.int16)
+    h_depth = np.zeros(0, np.int16)
     if checkpoint_path and os.path.exists(checkpoint_path):
         host_state, meta = checkpoint.load(checkpoint_path, p_times=p_times)
         if np.asarray(host_state.prmu).ndim != 3 \
@@ -524,6 +559,28 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 f"checkpoint {checkpoint_path} holds "
                 f"{np.asarray(host_state.prmu).shape} pools; resume needs "
                 f"the same worker count (mesh has {n_dev})")
+        # a checkpoint written by a -C run carries the host tier's seed
+        # nodes (they were carved OUT of the pools): resume must either
+        # re-seed the session from them or push them back — dropping
+        # them would silently lose subtrees
+        saved_p = np.asarray(meta.get("host_prmu",
+                                      np.zeros((0, jobs))), np.int16)
+        saved_d = np.asarray(meta.get("host_depth", np.zeros(0)),
+                             np.int16)
+        if host_fraction > 0:
+            if len(saved_d):
+                h_prmu, h_depth = saved_p, saved_d
+            else:
+                host_state, h_prmu, h_depth = hybrid.pop_host_share(
+                    host_state, host_fraction)
+            if len(h_depth):
+                session = hybrid.HostSession(
+                    p_times, h_prmu, h_depth, lb_kind,
+                    int(np.asarray(host_state.best).min()),
+                    n_threads=host_threads)
+        elif len(saved_d):
+            host_state = hybrid.restore_host_share(
+                host_state, saved_p, saved_d, p_times)
         fr = Frontier(prmu=np.zeros((0, jobs), np.int16),
                       depth=np.zeros(0, np.int16),
                       tree=int(meta.get("warmup_tree", 0)),
@@ -532,18 +589,35 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         state = driver.commit(host_state)
     else:
         fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
-        fr.aux = ref.prefix_front_remain(
-            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
         init_best = (fr.best if init_ub is None
                      else min(fr.best, int(init_ub)))
+        dmask, h_prmu, h_depth = hybrid.split_host_share(
+            fr.prmu, fr.depth, host_fraction)
+        if len(h_depth):
+            session = hybrid.HostSession(p_times, h_prmu, h_depth,
+                                         lb_kind, init_best,
+                                         n_threads=host_threads)
+            fr.prmu, fr.depth = fr.prmu[dmask], fr.depth[dmask]
+        fr.aux = ref.prefix_front_remain(
+            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
         state = driver.seed(fr, capacity, jobs, init_best)
 
     max_iters = (None if max_rounds is None
                  else max_rounds * balance_period)
-    if segment_iters is None and checkpoint_path is None:
+    if (segment_iters is None and checkpoint_path is None
+            and session is None):
         out = driver.run(state, max_iters)
     else:
-        ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol}
+        ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol,
+                     # the host tier's seed rides every checkpoint so a
+                     # killed -C run can be resumed without losing the
+                     # carved subtrees (re-exploring the share from its
+                     # seed is exactly-once: the killed session's work
+                     # was never committed anywhere)
+                     "host_prmu": (h_prmu if session else
+                                   np.zeros((0, jobs), np.int16)),
+                     "host_depth": (h_depth if session else
+                                    np.zeros(0, np.int16))}
 
         def run_fn(s, target):
             return driver.run(s, max_iters=target)
@@ -551,15 +625,32 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         out = checkpoint.run_segmented(
             run_fn, state, segment_iters=segment_iters or 2048,
             checkpoint_path=checkpoint_path, heartbeat=heartbeat,
-            max_total_iters=max_iters, checkpoint_meta=ckpt_meta)
+            checkpoint_every=checkpoint_every,
+            max_total_iters=max_iters, checkpoint_meta=ckpt_meta,
+            post_segment=(session.post_segment if session else None))
+
+    h_tree = h_sol = h_expanded = 0
+    host_stats = {}
+    best = int(_fetch(out.best).min())
+    if session is not None:
+        session.offer(best)      # freshest device bound before the join
+        h_tree, h_sol, h_best, h_expanded = session.join()
+        best = min(best, h_best)
+        host_stats = {
+            "host_tree": [h_tree], "host_sol": [h_sol],
+            "host_expanded": [h_expanded],
+            "exchanges": [session.exchanges],
+            "host_improved": [session.host_improved],
+            "dev_improved": [session.dev_improved],
+        }
 
     tree_dev = _fetch(out.tree)
     sol_dev = _fetch(out.sol)
     sizes = _fetch(out.size)
     return DistResult(
-        explored_tree=int(tree_dev.sum()) + fr.tree,
-        explored_sol=int(sol_dev.sum()) + fr.sol,
-        best=int(_fetch(out.best).min()),
+        explored_tree=int(tree_dev.sum()) + fr.tree + h_tree,
+        explored_sol=int(sol_dev.sum()) + fr.sol + h_sol,
+        best=best,
         per_device={
             "tree": tree_dev, "sol": sol_dev,
             "iters": _fetch(out.iters),
@@ -568,6 +659,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             "recv": _fetch(out.recv),
             "steals": _fetch(out.steals),
             "final_size": sizes,
+            **host_stats,
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,
         complete=int(sizes.sum()) == 0,

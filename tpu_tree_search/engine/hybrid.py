@@ -37,6 +37,156 @@ class HybridResult(distributed.DistResult):
     pass
 
 
+class HostSession:
+    """The native concurrent host tier of `-C`: owns the async session
+    lifecycle, the two-way incumbent merge applied at exchange points,
+    and the final join. Driver-agnostic — the single-chip hybrid loop,
+    the single-device segmented driver, and the distributed _DistDriver
+    all plug it in (the reference runs its CPU workers beside the
+    multi-GPU managers AND inside the distributed flagship:
+    pfsp_multigpu_cuda.c:61-69, pfsp_dist_multigpu_cuda.c:471-741)."""
+
+    def __init__(self, p_times, prmu, depth, lb_kind: int, init_ub: int,
+                 n_threads: int = 0):
+        from .. import native
+
+        self._native = native
+        self.handle = native.async_start(
+            np.asarray(p_times), np.asarray(prmu), np.asarray(depth),
+            lb_kind=lb_kind, init_ub=int(init_ub), n_threads=n_threads)
+        self.seeded = int(len(depth))
+        self.exchanges = self.host_improved = self.dev_improved = 0
+        self.joined = None
+
+    def merge(self, dev_best: int) -> int:
+        """Two-way exchange: returns min(device, host) incumbent and
+        offers the device's bound to the session when it is the tighter
+        one (checkBest semantics, multigpu:61-69)."""
+        host_best = self._native.async_best(self.handle)
+        merged = min(int(dev_best), host_best)
+        self.exchanges += 1
+        if host_best < dev_best:
+            self.host_improved += 1
+        elif dev_best < host_best:
+            self.dev_improved += 1
+            self._native.async_offer(self.handle, merged)
+        return merged
+
+    def offer(self, best: int) -> None:
+        self._native.async_offer(self.handle, int(best))
+
+    def join(self):
+        """(tree, sol, best, expanded) of the session; idempotent."""
+        if self.joined is None:
+            self.joined = self._native.async_join(self.handle)
+        return self.joined
+
+    def post_segment(self, state):
+        """checkpoint.run_segmented hook: merge incumbents between the
+        device state (single-device scalar best or stacked per-worker
+        bests) and the session at every segment boundary."""
+        import jax.numpy as jnp
+
+        from . import checkpoint
+
+        dev_best = int(checkpoint._to_np(state.best).min())
+        merged = self.merge(dev_best)
+        if merged < dev_best:
+            state = state._replace(
+                best=jnp.minimum(state.best,
+                                 jnp.asarray(merged, state.best.dtype)))
+        return state
+
+
+def split_host_share(prmu, depth, host_fraction: int):
+    """Stride-split a frontier (roundRobin_distribution semantics,
+    multigpu:159-263): every host_fraction-th node goes to the host
+    tier. Returns (dev_mask, host_prmu, host_depth); host share is empty
+    when the frontier is too small to split."""
+    n = len(depth)
+    if host_fraction <= 0 or n < host_fraction:
+        return np.ones(n, bool), prmu[:0], depth[:0]
+    hmask = np.zeros(n, bool)
+    hmask[::host_fraction] = True
+    return ~hmask, prmu[hmask], depth[hmask]
+
+
+def restore_host_share(host_state, h_prmu, h_depth, p_times):
+    """Resume WITHOUT `-C` of a checkpoint whose host tier held carved
+    nodes (they ride the checkpoint meta — see the search drivers): push
+    them back into the least-loaded pool so no subtree is lost. The aux
+    rows are recomputed from the permutations."""
+    import jax.numpy as jnp
+
+    from ..ops import reference as ref
+
+    n = len(h_depth)
+    if n == 0:
+        return host_state
+    prmu = np.asarray(host_state.prmu).copy()
+    depth = np.asarray(host_state.depth).copy()
+    aux = np.asarray(host_state.aux).copy()
+    size = np.atleast_1d(np.asarray(host_state.size)).copy()
+    stacked = prmu.ndim == 3
+    M = aux.shape[-2]
+    rows = ref.prefix_front_remain(
+        np.asarray(p_times), np.asarray(h_prmu),
+        np.asarray(h_depth))[:, :M]
+    w = int(size.argmin())
+    s = int(size[w])
+    if s + n > prmu.shape[-1]:
+        raise RuntimeError(
+            f"no room to restore the {n}-node host share into pool {w} "
+            f"(size {s}, capacity {prmu.shape[-1]}); resume with "
+            "--grow-capacity")
+    sl = (w,) if stacked else ()
+    prmu[sl + (slice(None), slice(s, s + n))] = np.asarray(h_prmu).T
+    depth[sl + (slice(s, s + n),)] = np.asarray(h_depth)
+    aux[sl + (slice(None), slice(s, s + n))] = rows.T
+    size[w] = s + n
+    new_size = (jnp.asarray(size) if stacked
+                else jnp.asarray(np.asarray(size[0],
+                                            np.asarray(host_state.size).dtype)))
+    return host_state._replace(
+        prmu=jnp.asarray(prmu), depth=jnp.asarray(depth),
+        aux=jnp.asarray(aux), size=new_size)
+
+
+def pop_host_share(host_state, host_fraction: int, cap: int = 4096):
+    """Resume path: no warm-up frontier exists, so carve the host tier's
+    seed off the TOP of the checkpointed pools (host-side numpy, before
+    the state is committed to devices — lossless: the session explores
+    exactly the carved rows). Works on the single-device layout
+    (jobs, capacity) and the stacked one (n_dev, jobs, capacity).
+    Returns (new_state, host_prmu (n, jobs), host_depth (n,))."""
+    prmu = np.asarray(host_state.prmu)
+    depth = np.asarray(host_state.depth)
+    size = np.asarray(host_state.size)
+    stacked = prmu.ndim == 3
+    sizes = size.reshape(-1) if stacked else size.reshape(1)
+    pools_p = prmu if stacked else prmu[None]
+    pools_d = depth if stacked else depth[None]
+    take = [min(int(s) // max(host_fraction, 1), cap // len(sizes))
+            for s in sizes]
+    hp, hd = [], []
+    new_sizes = []
+    for w, k in enumerate(take):
+        s = int(sizes[w])
+        if k > 0:
+            hp.append(pools_p[w][:, s - k:s].T.copy())
+            hd.append(pools_d[w][s - k:s].copy())
+        new_sizes.append(s - k)
+    if not hp:
+        return host_state, prmu[:0].reshape(0, prmu.shape[-2]), depth[:0]
+    import jax.numpy as jnp
+
+    new_size = (jnp.asarray(np.asarray(new_sizes, size.dtype))
+                if stacked else
+                jnp.asarray(np.asarray(new_sizes[0], size.dtype)))
+    state = host_state._replace(size=new_size)
+    return state, np.concatenate(hp, axis=0), np.concatenate(hd)
+
+
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            chunk: int = 1024, capacity: int = 1 << 20,
            drain_min: int | None = None, host_threads: int = 0,
@@ -51,8 +201,6 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     the concurrent tier, leaving warm-up + device + drain).
     `segment_iters` sets the incumbent-exchange cadence in device loop
     iterations."""
-    import jax.numpy as jnp
-
     from .. import native
     from . import checkpoint
 
@@ -66,21 +214,17 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     best0 = fr.best if init_ub is None else min(fr.best, int(init_ub))
 
     # step 2: stride-split the frontier; host share starts NOW, async
-    n = len(fr.depth)
-    handle = None
-    d_prmu, d_depth = fr.prmu, fr.depth
-    if host_fraction > 0 and n >= host_fraction:
-        hmask = np.zeros(n, bool)
-        hmask[::host_fraction] = True
-        handle = native.async_start(
-            p_times, fr.prmu[hmask], fr.depth[hmask], lb_kind=lb_kind,
-            init_ub=best0, n_threads=host_threads)
-        d_prmu, d_depth = fr.prmu[~hmask], fr.depth[~hmask]
+    dmask, h_prmu, h_depth = split_host_share(fr.prmu, fr.depth,
+                                              host_fraction)
+    session = None
+    d_prmu, d_depth = fr.prmu[dmask], fr.depth[dmask]
+    if len(h_depth):
+        session = HostSession(p_times, h_prmu, h_depth, lb_kind, best0,
+                              n_threads=host_threads)
 
     # step 3: segmented device loop with incumbent exchange per segment
     state = device.init_state(jobs, capacity, best0, prmu0=d_prmu,
                               depth0=d_depth, p_times=p_times)
-    exchanges = host_improved = dev_improved = 0
     target = 0
     while True:
         target += segment_iters
@@ -90,18 +234,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             capacity *= 2
             state = checkpoint.grow(state, capacity)
             continue
-        if handle is not None:
-            dev_best = int(state.best)
-            host_best = native.async_best(handle)
-            merged = min(dev_best, host_best)
-            exchanges += 1
-            if host_best < dev_best:
-                host_improved += 1
-                state = state._replace(
-                    best=jnp.asarray(merged, state.best.dtype))
-            elif dev_best < host_best:
-                dev_improved += 1
-                native.async_offer(handle, merged)
+        if session is not None:
+            state = session.post_segment(state)
         if int(state.size) < drain_min:
             break
 
@@ -109,8 +243,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     n_left = int(state.size)
     d_tree, d_sol = int(state.tree), int(state.sol)
     best = int(state.best)
-    if handle is not None:
-        best = min(best, native.async_best(handle))
+    if session is not None:
+        best = session.merge(best)
     drained = 0
     if n_left > 0:
         res_prmu = np.asarray(state.prmu[:, :n_left]).T
@@ -120,12 +254,21 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             init_ub=best, n_threads=host_threads)
         d_tree += r_tree
         d_sol += r_sol
+        if session is not None:
+            # a bound improved by the drain must reach the session while
+            # it is still searching — otherwise it keeps pruning with a
+            # stale (higher) incumbent until join (wasted host work)
+            session.offer(best)
 
     # join the concurrent host session
     h_tree = h_sol = h_expanded = 0
-    if handle is not None:
-        h_tree, h_sol, h_best, h_expanded = native.async_join(handle)
+    exchanges = host_improved = dev_improved = 0
+    if session is not None:
+        h_tree, h_sol, h_best, h_expanded = session.join()
         best = min(best, h_best)
+        exchanges = session.exchanges
+        host_improved = session.host_improved
+        dev_improved = session.dev_improved
 
     return HybridResult(
         explored_tree=d_tree + h_tree + fr.tree,

@@ -283,6 +283,15 @@ LB2_PB = 64
 LB2_TILE = 4096
 
 
+# Wider tiles were tried for the few-pair classes (50x5: P=10 uses 10
+# of 64 sublanes, so the J=50 step chain is per-step-latency-bound and
+# wider NT would amortize it) and OOM the scoped-VMEM stack: mosaic
+# materializes the per-unrolled-step activation temporaries without
+# stack reuse, so scoped usage scales ~J*NT (measured: 17.76 MB at
+# J=50/P=10/NT=8192; 18.18 MB at J=20/P=190/NT=8192 — both over the
+# 16 MB limit). 4096 is the proven ceiling for every production class.
+
+
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
     """The pair-sweep kernel keeps its (J, P, J) f32 per-step job one-hot
     resident in VMEM; past ~4 MB it cannot share VMEM with the column
