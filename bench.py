@@ -30,6 +30,10 @@ if os.environ.get("TTS_BENCH_PLATFORM"):
 
 import numpy as np  # noqa: E402
 
+from tpu_tree_search.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
 from tpu_tree_search.engine import device  # noqa: E402
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402

@@ -21,6 +21,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np  # noqa: E402
 
+from tpu_tree_search.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
 from tpu_tree_search.engine import checkpoint, device  # noqa: E402
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402

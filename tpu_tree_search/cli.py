@@ -249,8 +249,10 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
             from .ops import reference as ref
             from .parallel.mesh import worker_mesh
 
+            adt = dev.aux_dtype(p)
             transfer_cap = dist.default_transfer_cap(
-                args.chunk, jobs, machines, n_dev)
+                args.chunk, jobs, machines, n_dev,
+                aux_itemsize=adt.itemsize)
             min_transfer = 2 * args.chunk
             # the profiled round must honor _balance_round's contract
             # limit <= capacity - D*transfer_cap with limit >= 1; a
@@ -271,7 +273,7 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
                 depth=np.zeros(1, np.int16), tree=0, sol=0,
                 best=best)
             fr.aux = ref.prefix_front_remain(
-                p, fr.prmu, fr.depth)[:, :machines]
+                p, fr.prmu, fr.depth)[:, :machines].astype(adt)
             leaves = dist._shard_frontier(fr, n_dev, cap, jobs,
                                           best, limit=limit)
             t_bal = phase_timing.profile_balance(
@@ -472,6 +474,11 @@ def main(argv=None) -> int:
     if args.multihost:
         import jax
         jax.distributed.initialize()
+    # persistent compile cache: the reference's binaries are AOT-compiled
+    # at build time; this is the JIT-world equivalent (first run compiles
+    # ~45 s and caches to disk, every later process loads in ~1 s)
+    from .utils import compile_cache
+    compile_cache.enable()
     if args.cmd == "pfsp":
         return run_pfsp(args)
     if args.cmd == "devices":

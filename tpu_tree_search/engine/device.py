@@ -43,6 +43,27 @@ from ..ops.batched import BoundTables
 I32_MAX = jnp.int32(2**31 - 1)
 
 
+def aux_dtype(p_times: np.ndarray | None) -> np.dtype:
+    """Narrowest safe dtype for the pool's per-node tables (front vectors)
+    and their compaction traffic. Every value stored there is a machine
+    completion time of some partial schedule, bounded by the critical-path
+    bound: any C[k][i] in the flow-shop recurrence is a sum over one
+    monotone lattice path from (0,0) to (k,i), at most (J + M - 1) cells
+    of at most max(p) each. When that bound fits int16, halving the aux
+    bytes roughly halves the byte-bound compaction gathers and block
+    writes that dominate the step (BENCHMARKS.md round-3 profile:
+    gathers 38% of the LB2 step). Every Taillard class through 200x20
+    fits; 500-job instances fall back to int32 automatically.
+    """
+    if p_times is None:
+        return np.dtype(np.int32)
+    m, j = p_times.shape
+    bound = (j + m - 1) * int(np.max(p_times))
+    if bound <= int(np.iinfo(np.int16).max):
+        return np.dtype(np.int16)
+    return np.dtype(np.int32)
+
+
 def row_limit(capacity: int, chunk: int, jobs: int) -> int:
     """Usable pool rows. The top `chunk*jobs` rows are a scratch margin:
     the push block-write always writes a full chunk*jobs block, and an
@@ -104,7 +125,7 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     depth[:n] = depth0
     if p_times is not None:
         m = p_times.shape[0]
-        aux = np.zeros((m, capacity), dtype=np.int32)
+        aux = np.zeros((m, capacity), dtype=aux_dtype(p_times))
         aux[:, :n] = ref.prefix_front_remain(p_times, prmu0, depth0)[:, :m].T
     else:
         aux = np.zeros((0, capacity), dtype=np.int32)
@@ -180,13 +201,16 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
 
     `idx` (t,) are child-column indices in expand()'s slot-major order
     (c = (g*J + i)*TB + b). Returns (child (J,t) int16,
-    caux (M+1,t) int32 = [child front | depth+1][, sched (W,t) int32
-    multi-word scheduled-set bitmask, W = ceil(J/32)]). Keeping the
-    child block int16 and SEPARATE from the int32 rows measures faster
-    than one combined i32 block (tried: +60% gather time per step —
-    these gathers are byte-bound at 40+ i32 rows)."""
+    caux (M+1,t) = [child front | depth+1] in the POOL's aux dtype
+    (int16 when the instance's completion times fit it, see aux_dtype)
+    [, sched (W,t) int32 multi-word scheduled-set bitmask,
+    W = ceil(J/32)]). Keeping the child block int16 and SEPARATE from
+    the wider aux rows measures faster than one combined i32 block
+    (tried: +60% gather time per step — these gathers are byte-bound at
+    40+ i32 rows; the narrow aux dtype attacks the same wall)."""
     J, B = p_prmu.shape
     M = p_aux.shape[0]
+    adt = p_aux.dtype
     t = idx.shape[0]
     JTB = J * TB
     g = idx // JTB
@@ -197,10 +221,11 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
     # barriers: without them XLA fuses the index arithmetic into the
     # gathers and the fused kernels run ~5x slower (measured on v5e)
     pcol, slot = jax.lax.optimization_barrier((pcol, slot))
-    src = jnp.concatenate([p_aux, p_depth2], axis=0)      # (M+1, B)
+    src = jnp.concatenate([p_aux, p_depth2.astype(adt)], axis=0)  # (M+1, B)
     pp = jnp.take(p_prmu, pcol, axis=1)                   # (J, t) int16
-    pfd = jnp.take(src, pcol, axis=1)                     # (M+1, t) int32
+    pfd = jnp.take(src, pcol, axis=1)                     # (M+1, t) adt
     pp, pfd = jax.lax.optimization_barrier((pp, pfd))
+    pfd = pfd.astype(jnp.int32)   # chain math in i32; stores back in adt
     pf = pfd[:M]
     pd = pfd[M:]                                          # (1, t) depth
 
@@ -226,7 +251,7 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
     for k in range(1, M):
         cf = jnp.maximum(cf, pf[k:k + 1]) + cp[k:k + 1]
         cf_rows.append(cf)
-    caux = jnp.concatenate(cf_rows + [pd + 1], axis=0)    # (M+1, t)
+    caux = jnp.concatenate(cf_rows + [pd + 1], axis=0).astype(adt)  # (M+1,t)
 
     if not with_sched:
         return child, caux
@@ -332,8 +357,9 @@ def pop_chunk(state: SearchState, B: int, M: int):
     """Pop window of up to B parents off the stack top (no commit; the
     caller owns the cursor): the popBackBulk analogue. The window
     [start, start+B) is contiguous, so dynamic_slice beats a gather.
-    Returns (p_prmu (J,B) i16, p_depth (1,B) i32, p_aux (M,B) i32,
-    n, start, valid)."""
+    Returns (p_prmu (J,B) i16, p_depth (1,B) i32, p_aux (M,B) in the
+    POOL's aux dtype (aux_dtype — int16 on most classes; widen to i32
+    before doing chain arithmetic on it), n, start, valid)."""
     J, capacity = state.prmu.shape
     n = jnp.minimum(state.size, B)
     start = state.size - n
@@ -373,6 +399,15 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
     p_prmu, p_depth, p_aux, n, start, valid = pop_chunk(state, B, M)
     zero = jnp.zeros((), start.dtype)
+    # The pool stores aux in the narrow per-instance dtype (aux_dtype:
+    # int16 for every class whose completion times fit); intra-step
+    # blocks are all i32 — measured on v5e: TPU column gathers are
+    # element/latency-bound, so narrow GATHERS buy nothing (+18% step
+    # time when tried), while the sequential push block-write IS
+    # byte-bound and pays half, and the balance all_to_all + checkpoint
+    # + pool HBM footprint halve too. The cast back happens at the
+    # write below.
+    p_aux = p_aux.astype(jnp.int32)
 
     # --- masks in the kernel's child-slot column order
     depth_c = _col_major(p_depth, G, J, TB)                    # (1, N)
@@ -499,11 +534,14 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         nkeep = keep.sum(dtype=jnp.int32)
         permh = _partition_prefix(keep, ncand, N, two_phase=True)
         # the partial bound rides the compaction as an extra row
-        # (two structural variants were tried and measured WORSE:
+        # (three structural variants were tried and measured WORSE:
         # an index-composed final gather that skips re-gathering
         # children — the composing (N,) take lowers to a ~4.7 ms
-        # serialized gather — and one combined i32 block per
-        # compaction — +60% gather time, byte-bound at 40+ rows)
+        # serialized gather; one combined i32 block per compaction —
+        # +60% gather time, byte-bound at 40+ rows; and gathering these
+        # blocks in the pool's int16 aux dtype — TPU column gathers are
+        # element/latency-bound, i16 made them SLOWER (+18%), so the
+        # narrow dtype lives only at the pool boundary, see step())
         aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
         children, aux_plus = _tiered_compact(
             take_block(children, aux_plus), permh, nkeep, N,
@@ -590,8 +628,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                                         (zero, write_at))
     depth = jax.lax.dynamic_update_slice(state.depth, child_depth,
                                          (write_at,))
-    aux = jax.lax.dynamic_update_slice(state.aux, child_aux[:M],
-                                       (zero, write_at))
+    aux = jax.lax.dynamic_update_slice(
+        state.aux, child_aux[:M].astype(state.aux.dtype), (zero, write_at))
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
     return state._replace(
         prmu=prmu,

@@ -52,15 +52,17 @@ BALANCE_BYTE_BUDGET = 64 << 20
 
 
 def default_transfer_cap(chunk: int, jobs: int, machines: int,
-                         n_dev: int) -> int:
+                         n_dev: int, aux_itemsize: int = 4) -> int:
     """Default balance transfer cap: 4*chunk, byte-budgeted. The
-    all_to_all moves (2J + 4A + 2) bytes per column over D*transfer_cap
-    columns each way per worker; at production shapes (chunk 32768,
-    20x20, D=8) the uncapped default is ~122 MB of exchange buffer per
-    worker per round — the cap bounds it to BALANCE_BYTE_BUDGET.
-    SHARED by search() and the CSV phase profiler (cli) so the profiled
-    exchange is the one production runs."""
-    bytes_per_col = 2 * jobs + 4 * machines + 2
+    all_to_all moves (2J + aux_itemsize*A + 2) bytes per column over
+    D*transfer_cap columns each way per worker; at production shapes
+    (chunk 32768, 20x20, D=8) the uncapped default is ~122 MB of
+    exchange buffer per worker per round — the cap bounds it to
+    BALANCE_BYTE_BUDGET. `aux_itemsize` is the pool aux dtype's width
+    (2 for the int16 classes, device.aux_dtype). SHARED by search() and
+    the CSV phase profiler (cli) so the profiled exchange is the one
+    production runs."""
+    bytes_per_col = 2 * jobs + aux_itemsize * machines + 2
     budget_cols = BALANCE_BYTE_BUDGET // (bytes_per_col * max(n_dev, 1))
     return max(min(4 * chunk, budget_cols), 256)
 
@@ -95,7 +97,8 @@ class Frontier:
     tree: int           # counters accumulated during warm-up
     sol: int
     best: int
-    aux: np.ndarray | None = None  # (n, A) int32 per-node pool tables
+    aux: np.ndarray | None = None  # (n, A) per-node pool tables, in the
+                                   # pool's aux dtype (device.aux_dtype)
 
 
 def bfs_warmup(p_times: np.ndarray, lb_kind: int, init_ub: int | None,
@@ -350,7 +353,8 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
     aux_w = 0 if fr.aux is None else fr.aux.shape[1]
     prmu = np.zeros((n_dev, jobs, capacity), np.int16)
     depth = np.zeros((n_dev, capacity), np.int16)
-    aux = np.zeros((n_dev, aux_w, capacity), np.int32)
+    aux = np.zeros((n_dev, aux_w, capacity),
+                   fr.aux.dtype if aux_w else np.int32)
     sizes = np.zeros(n_dev, np.int32)
     for d in range(n_dev):
         stripe_p = fr.prmu[d::n_dev]
@@ -535,9 +539,19 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     jobs = p_times.shape[1]
     if tables is None:
         tables = batched.make_tables(p_times)
+    from .device import aux_dtype as _aux_dtype
+    adt = _aux_dtype(p_times)
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        # resume keeps the SAVED pools' aux dtype (an old int32-aux
+        # checkpoint stays int32), so the balance byte budget must be
+        # priced off the file, not the fresh-run dtype
+        with np.load(checkpoint_path) as z:
+            if "aux" in z.files:
+                adt = np.dtype(z["aux"].dtype)
     if transfer_cap is None:
         transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
-                                            mesh.devices.size)
+                                            mesh.devices.size,
+                                            aux_itemsize=adt.itemsize)
     min_transfer = min_transfer or 2 * chunk
 
     def make_local_step(t, limit):
@@ -599,7 +613,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                                          n_threads=host_threads)
             fr.prmu, fr.depth = fr.prmu[dmask], fr.depth[dmask]
         fr.aux = ref.prefix_front_remain(
-            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]]
+            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]].astype(adt)
         state = driver.seed(fr, capacity, jobs, init_best)
 
     max_iters = (None if max_rounds is None

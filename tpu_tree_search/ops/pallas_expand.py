@@ -308,7 +308,11 @@ def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
     path needs the child fronts this function never materializes
     (device.step's two-phase route owns that case: LB1 kernel for the
     pre-prune, then lb2_bounds over the regathered survivors). The column
-    order is identical to expand()'s for the same tile."""
+    order is identical to expand()'s for the same tile.
+
+    front_T may arrive in the pool's narrow aux dtype (device.aux_dtype);
+    the kernels' chain arithmetic needs i32."""
+    front_T = front_T.astype(jnp.int32)
     J, B = prmu_T.shape
     eff_tile = (tile if B % tile == 0
                 else effective_tile(J, B, tile, lb_kind))
@@ -422,7 +426,12 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
 
     THE single entry point for column-major LB2 — both device.step's
     two-phase tiers and expand()'s one-shot path go through here, so the
-    tile rule and the fallback cannot diverge."""
+    tile rule and the fallback cannot diverge.
+
+    Accepts the pool's narrow aux dtype (engine/device.aux_dtype) for
+    child_front_cols; widened to i32 here at entry (full width — a no-op
+    for the i32 blocks the engine's compaction path passes)."""
+    child_front_cols = child_front_cols.astype(jnp.int32)
     N = child_front_cols.shape[1]
     J = tables.js.shape[1]
     P = int(tables.ma0.shape[0])
@@ -650,7 +659,11 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
            lb_kind: int = 1, tile: int = 1024):
     """Dispatch: Pallas on TPU (LB1/LB1_d directly; LB2 as the expand
     kernel for children/aux + the pair-sweep kernel for bounds, when the
-    job count fits the scheduled-set bitmask), XLA otherwise."""
+    job count fits the scheduled-set bitmask), XLA otherwise.
+
+    front_T may arrive in the pool's narrow aux dtype (device.aux_dtype).
+    """
+    front_T = front_T.astype(jnp.int32)
     J, B = prmu_T.shape
     # A tile that divides the batch is trusted as-is: step() derives it
     # through effective_tile and builds its masks in that column order,

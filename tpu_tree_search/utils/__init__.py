@@ -1,3 +1,3 @@
-from . import stats, csv_stats, config
+from . import stats, csv_stats, config, compile_cache
 
-__all__ = ["stats", "csv_stats", "config"]
+__all__ = ["stats", "csv_stats", "config", "compile_cache"]

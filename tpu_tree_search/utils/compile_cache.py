@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache.
+
+The engine's compiled loop costs ~45 s to build on a TPU backend (the
+one-off `jit` compile BENCHMARKS.md's ta029 row carries); the reference
+pays this cost once at BUILD time — its binaries ship AOT-compiled
+kernels (pfsp/makefile nvcc/hipcc invocations), so a 4-second instance
+really takes 4 seconds. JAX's persistent compilation cache is the
+equivalent: the first process compiles and writes the executable to
+disk, every later process (same program shape + jaxlib + flags) loads it
+in ~1 s. Enabled by every entry point (CLI, bench, tools) via
+enable(); opt out with TTS_NO_COMPILE_CACHE=1 or point the directory
+elsewhere with TTS_COMPILE_CACHE_DIR.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+_DEFAULT_DIR = "~/.cache/tpu_tree_search/xla"
+
+
+def enable(cache_dir: str | None = None) -> str | None:
+    """Turn on JAX's persistent compilation cache (best-effort: unknown
+    backends or read-only filesystems degrade to in-memory caching, never
+    to an error). Returns the directory in use, or None if disabled."""
+    if os.environ.get("TTS_NO_COMPILE_CACHE"):
+        return None
+    path = (cache_dir or os.environ.get("TTS_COMPILE_CACHE_DIR")
+            or _DEFAULT_DIR)
+    path = str(pathlib.Path(path).expanduser())
+    try:
+        pathlib.Path(path).mkdir(parents=True, exist_ok=True)
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        # (jax's default min-compile-time threshold already skips
+        # sub-second compiles — the right call here: the engine's small
+        # helper jits are cheap to rebuild and would churn the cache)
+        return path
+    except Exception:
+        return None
