@@ -89,7 +89,7 @@ def main():
         # TTS_BENCH_ITERS so smoke runs stay short; TTS_BENCH_WARM
         # overrides the warm-up directly.
         it = iters if lb_kind != 2 else max(200, iters // 4)
-        warm = 50 if lb_kind != 2 else min(400, max(50, iters // 5))
+        warm = 50 if lb_kind != 2 else min(1000, max(50, iters // 2))
         warm = int(os.environ.get("TTS_BENCH_WARM", warm))
         evals, dt, state = bench_one(tables, p, ub, lb_kind, chunk, it,
                                      capacity, warm=warm)

@@ -54,7 +54,7 @@ def main():
     sizes = [int(0.5 * capacity)] + [chunk // 2] * (D - 1)
     prmu = np.zeros((D, jobs, capacity), np.int16)
     depth = np.zeros((D, capacity), np.int16)
-    aux = np.zeros((D, machines, capacity), np.int32)
+    aux = np.zeros((D, machines, capacity), device.aux_dtype(p))
     for d in range(D):
         n = sizes[d]
         pm = np.argsort(rng.random((n, jobs)), axis=1).astype(np.int16)

@@ -38,6 +38,18 @@ def _to_np(x) -> np.ndarray:
     return np.asarray(x)
 
 
+def _fetch_many(xs: tuple) -> tuple:
+    """One batched device->host fetch of several small arrays. On a
+    remote-TPU runtime every separate np.asarray is a full roundtrip;
+    a single device_get puts all transfers in flight together, so the
+    batch costs ~one latency instead of len(xs). Multihost shards fall
+    back to the collective allgather path per leaf."""
+    if any(not getattr(x, "is_fully_addressable", True) for x in xs):
+        return tuple(_to_np(x) for x in xs)
+    import jax
+    return tuple(np.asarray(v) for v in jax.device_get(xs))
+
+
 def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
     """Snapshot a search state (single-device or stacked distributed).
 
@@ -237,24 +249,32 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         if post_segment is not None:
             state = post_segment(state)
         seg += 1
-        iters = int(_to_np(state.iters).max())
-        tree = int(_to_np(state.tree).sum())
-        sol = int(_to_np(state.sol).sum())
-        sizes = _to_np(state.size)
+        # ONE batched host fetch for every per-segment scalar: through a
+        # remote-TPU runtime each separate fetch is a full roundtrip
+        # (~0.15 s on the tunnel; six of them cost ~0.9 s per segment —
+        # measured as the gap between segment wall time and the compiled
+        # loop's in-trace step cost, BENCHMARKS.md round 3)
+        fetched = _fetch_many((state.iters, state.tree, state.sol,
+                               state.size, state.best, state.steals,
+                               state.overflow))
+        f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf = fetched
+        iters = int(f_iters.max())
+        tree = int(f_tree.sum())
+        sol = int(f_sol.sum())
         size = int(sizes.sum())
         if heartbeat is not None:
             per_worker = None
             if sizes.ndim:                      # stacked distributed state
                 per_worker = {"size": sizes.tolist(),
-                              "steals": _to_np(state.steals).tolist(),
-                              "best": _to_np(state.best).tolist()}
+                              "steals": f_steals.tolist(),
+                              "best": f_best.tolist()}
             heartbeat(SegmentReport(
                 segment=seg, iters=iters, tree=tree, sol=sol,
-                best=int(_to_np(state.best).min()), pool_size=size,
+                best=int(f_best.min()), pool_size=size,
                 elapsed=time.perf_counter() - t0, per_worker=per_worker))
         if checkpoint_path and seg % checkpoint_every == 0:
             save(checkpoint_path, state, meta={**meta_base, "segment": seg})
-        if bool(_to_np(state.overflow).any()):
+        if bool(f_ovf.any()):
             final_save(state, seg)
             if raise_on_overflow:
                 hint = (f"resume from {checkpoint_path} with a larger "

@@ -119,21 +119,32 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     n = prmu0.shape[0]
     assert n <= capacity
 
-    prmu = np.zeros((jobs, capacity), dtype=np.int16)
-    depth = np.zeros(capacity, dtype=np.int16)
-    prmu[:, :n] = prmu0.T
-    depth[:n] = depth0
+    # Allocate the pool ON the device and ship only the seed rows: the
+    # host-side np.zeros variant uploaded the full capacity through the
+    # runtime (~350 MB at capacity 2^22 for 20x20 — seconds per call on
+    # a remote-TPU tunnel, paid per instance by campaign drivers).
+    def seeded(shape, dtype, rows):
+        buf = jnp.zeros(shape, dtype)
+        if rows is None:
+            return buf
+        at = (0,) * (buf.ndim - 1) + (0,)
+        return jax.lax.dynamic_update_slice(
+            buf, jnp.asarray(rows, dtype), at)
+
+    prmu = seeded((jobs, capacity), jnp.int16, prmu0.T)
+    depth = seeded((capacity,), jnp.int16, depth0)
     if p_times is not None:
         m = p_times.shape[0]
-        aux = np.zeros((m, capacity), dtype=aux_dtype(p_times))
-        aux[:, :n] = ref.prefix_front_remain(p_times, prmu0, depth0)[:, :m].T
+        aux = seeded((m, capacity), aux_dtype(p_times),
+                     ref.prefix_front_remain(p_times, prmu0,
+                                             depth0)[:, :m].T)
     else:
-        aux = np.zeros((0, capacity), dtype=np.int32)
+        aux = jnp.zeros((0, capacity), jnp.int32)
     best = 2**31 - 1 if init_ub is None else int(init_ub)
     return SearchState(
-        prmu=jnp.asarray(prmu),
-        depth=jnp.asarray(depth),
-        aux=jnp.asarray(aux),
+        prmu=prmu,
+        depth=depth,
+        aux=aux,
         size=jnp.int32(n),
         best=jnp.int32(best),
         tree=jnp.int64(0),
@@ -488,12 +499,13 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             ladder than the compaction's (its branches carry only a
             (1, N) row, so extra rungs are nearly free) with 3/2^k rungs
             for the same occupancy reason (_compact_tiers); each rung
-            must satisfy the pair-sweep kernel's own lane-tile gate or
+            must satisfy the pair-sweep kernel's own tile rule
+            (lb2_tile — lane alignment AND the scoped-VMEM model) or
             lb2_bounds would silently take its XLA fallback there."""
+            PT = int(tbl.ma0.shape[0])
             tiers = [t for t in (N // 64, N // 32, 3 * N // 64, N // 16,
                                  3 * N // 32, N // 8, N // 4, N // 2)
-                     if t > 0 and min(pallas_expand.LB2_TILE, t & -t)
-                     >= pallas_expand.MIN_PALLAS_TILE]
+                     if t > 0 and pallas_expand.lb2_tile(J, PT, t) > 0]
             tiers.append(N)
 
             def prefix(width):

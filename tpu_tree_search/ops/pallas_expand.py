@@ -287,9 +287,37 @@ LB2_TILE = 4096
 # of 64 sublanes, so the J=50 step chain is per-step-latency-bound and
 # wider NT would amortize it) and OOM the scoped-VMEM stack: mosaic
 # materializes the per-unrolled-step activation temporaries without
-# stack reuse, so scoped usage scales ~J*NT (measured: 17.76 MB at
-# J=50/P=10/NT=8192; 18.18 MB at J=20/P=190/NT=8192 — both over the
-# 16 MB limit). 4096 is the proven ceiling for every production class.
+# stack reuse, so scoped usage scales with (pair-block rows x NT x J)
+# (measured: 17.76 MB at J=50/P=10/NT=8192; 18.18 MB at
+# J=20/P=190/NT=8192; 18.09 MB at J=50/P=166/NT=4096 — the last one a
+# round-3 REGRESSION: KH 32->24 grew the 50x20 tail block enough to
+# blow the 16 MB limit at the fixed 4096 tile, caught by re-measuring
+# ta056). lb2_tile() sizes NT against that model instead of trusting
+# one constant.
+
+# Scoped-VMEM model for lb2_tile: bytes ~= (rows*J + 2048) * NT — an
+# affine fit with a row-independent term, deliberately CONSERVATIVE over
+# all three measured points (predicts 21.5/20.9/27.0 MB for the
+# 18.09/17.76/18.18 MB measurements, so every configuration that
+# measured over the limit is rejected, including J=50/P=10/NT=8192,
+# which a pure rows*NT*J model would wrongly approve), while keeping
+# the proven production tiles: 20x20 -> 4096 (13.6 MB model), 50x20
+# tail -> 2048 (10.7 MB), 50x5 dense -> 4096 (10.4 MB).
+_LB2_SCOPED_BASE = 2048
+_LB2_SCOPED_BUDGET = 15e6
+
+
+def lb2_tile(jobs: int, pairs: int, width: int) -> int:
+    """Largest legal pallas column tile for a pair sweep over `width`
+    columns: divides width (power-of-two factor), caps at LB2_TILE, and
+    respects the scoped-VMEM model above. Returns 0 when no tile
+    >= MIN_PALLAS_TILE exists (callers then take the XLA path)."""
+    rows = min(LB2_PB, pairs)
+    nt = min(LB2_TILE, width & -width)
+    while nt >= MIN_PALLAS_TILE and (
+            (rows * jobs + _LB2_SCOPED_BASE) * nt > _LB2_SCOPED_BUDGET):
+        nt //= 2
+    return nt if nt >= MIN_PALLAS_TILE else 0
 
 
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
@@ -435,8 +463,8 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
     N = child_front_cols.shape[1]
     J = tables.js.shape[1]
     P = int(tables.ma0.shape[0])
-    nt = min(LB2_TILE, N & -N)
-    if (jax.default_backend() != "tpu" or nt < MIN_PALLAS_TILE
+    nt = lb2_tile(J, P, N)
+    if (jax.default_backend() != "tpu" or nt == 0
             or not lb2_kernel_fits(J, P)):
         return lb2_cols(tables, sched_mask, child_front_cols)
     vj = jnp.arange(J, dtype=jnp.int32)
@@ -678,9 +706,7 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
                           lb_kind=lb_kind, tile=eff_tile)
     if ok and lb_kind == 2:
         N = B * J
-        nt = N & -N                      # largest power-of-two divisor
-        nt = min(nt, LB2_TILE)
-        if nt >= MIN_PALLAS_TILE:
+        if lb2_tile(J, int(tables.ma0.shape[0]), N) > 0:
             children, aux, _ = expand_tpu(tables, prmu_T, depth2, front_T,
                                           lb_kind=1, tile=eff_tile)
             sched = sched_mask_cols(prmu_T, depth2, eff_tile)  # (W, N)
