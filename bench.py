@@ -81,14 +81,15 @@ def main():
 
     for lb_kind in lbs:
         # LB2 steps are ~4x slower: shorten its window so the total
-        # bench stays a few minutes, but warm PAST the ramp — LB2's
-        # early iterations pop underfilled chunks for hundreds of steps,
-        # and a timed window straddling the ramp under-reports the
-        # sustained rate by >2x (the full ta021 solve sustains ~38M
-        # evals/s; a 50-iter warm measured 15M). Both windows scale with
-        # TTS_BENCH_ITERS so smoke runs stay short; TTS_BENCH_WARM
-        # overrides the warm-up directly.
-        it = iters if lb_kind != 2 else max(200, iters // 4)
+        # bench stays a few minutes — but only to HALF the LB1 window
+        # (a quarter made the fixed ~0.5 s dispatch+fetch cost read as a
+        # 10%+ rate loss), and warm PAST the ramp: LB2's early
+        # iterations pop underfilled chunks for hundreds of steps, and
+        # a timed window straddling the ramp under-reports the
+        # sustained rate by >2x. Both windows scale with TTS_BENCH_ITERS
+        # so smoke runs stay short; TTS_BENCH_WARM overrides the
+        # warm-up directly.
+        it = iters if lb_kind != 2 else max(200, iters // 2)
         warm = 50 if lb_kind != 2 else min(1000, max(50, iters // 2))
         warm = int(os.environ.get("TTS_BENCH_WARM", warm))
         evals, dt, state = bench_one(tables, p, ub, lb_kind, chunk, it,

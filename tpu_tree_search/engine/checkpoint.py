@@ -148,6 +148,26 @@ def load(path: str | pathlib.Path,
     return state, meta
 
 
+def aux_dtype_of(path) -> np.dtype:
+    """The aux dtype a resume of `path` will end up with, read from the
+    zip member's npy HEADER only (decompressing the array to learn its
+    dtype costs a full second pass over a possibly multi-hundred-MB
+    member). Legacy pre-aux checkpoints reconstruct as int32 (load()
+    above). Lives here because it encodes this module's file format."""
+    import zipfile
+
+    with zipfile.ZipFile(path) as zf:
+        if "aux.npy" not in zf.namelist():
+            return np.dtype(np.int32)
+        with zf.open("aux.npy") as f:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                _, _, dtype = np.lib.format.read_array_header_1_0(f)
+            else:
+                _, _, dtype = np.lib.format.read_array_header_2_0(f)
+    return np.dtype(dtype)
+
+
 class PoolOverflow(RuntimeError):
     """Pool capacity exceeded; `.state` is the (resumable) search state."""
 

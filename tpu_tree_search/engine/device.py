@@ -125,11 +125,8 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     # a remote-TPU tunnel, paid per instance by campaign drivers).
     def seeded(shape, dtype, rows):
         buf = jnp.zeros(shape, dtype)
-        if rows is None:
-            return buf
-        at = (0,) * (buf.ndim - 1) + (0,)
         return jax.lax.dynamic_update_slice(
-            buf, jnp.asarray(rows, dtype), at)
+            buf, jnp.asarray(rows, dtype), (0,) * buf.ndim)
 
     prmu = seeded((jobs, capacity), jnp.int16, prmu0.T)
     depth = seeded((capacity,), jnp.int16, depth0)

@@ -330,26 +330,6 @@ def build_dist_loop(mesh, tables, make_local_step,
 # Host entry point
 
 
-def _checkpoint_aux_dtype(path) -> np.dtype:
-    """The aux dtype a resume of `path` will end up with, read from the
-    zip member's npy HEADER only (decompressing the array to learn its
-    dtype costs a full second pass over a possibly multi-hundred-MB
-    member). Legacy pre-aux checkpoints reconstruct as int32
-    (checkpoint.load)."""
-    import zipfile
-
-    with zipfile.ZipFile(path) as zf:
-        if "aux.npy" not in zf.namelist():
-            return np.dtype(np.int32)
-        with zf.open("aux.npy") as f:
-            version = np.lib.format.read_magic(f)
-            if version == (1, 0):
-                _, _, dtype = np.lib.format.read_array_header_1_0(f)
-            else:
-                _, _, dtype = np.lib.format.read_array_header_2_0(f)
-    return np.dtype(dtype)
-
-
 class DistResult:
     def __init__(self, explored_tree, explored_sol, best, per_device,
                  warmup_tree, warmup_sol, complete=True):
@@ -568,7 +548,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         # byte budget must be priced off the file, not the fresh-run
         # dtype. Only the npy header is read — np.load()[...] would
         # decompress the whole array for one .dtype attribute.
-        adt = _checkpoint_aux_dtype(checkpoint_path)
+        adt = checkpoint.aux_dtype_of(checkpoint_path)
     if transfer_cap is None:
         transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
                                             mesh.devices.size,
