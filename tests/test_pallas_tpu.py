@@ -161,3 +161,37 @@ def test_lb2_kernel_matches_xla_fallback():
     for a, b, name in zip(t, x, ("children", "aux", "bounds")):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b),
                                       err_msg=name)
+
+
+def test_wide_class_two_phase_matches_oracle():
+    """The 100-job route (pallas LB1 prefilter at the J>=64 tile floor of
+    128 + XLA scan pair sweeps over survivor tiers — no pallas pair
+    kernel, lb2_kernel_fits gates it off past J=64): the J=100/TB=128
+    bounds kernel must match the XLA oracle bit-for-bit, and the
+    two-phase engine route must run on a 100x20 instance (the round-3
+    regression this guards was a hard compile OOM on this class)."""
+    from tpu_tree_search.engine import device
+    from tpu_tree_search.ops import batched as b
+
+    rng = np.random.default_rng(7)
+    p = rng.integers(1, 100, (20, 100)).astype(np.int32)
+    tables = b.make_tables(p)
+
+    tile = pallas_expand.effective_tile(100, 512, 1024, 1, machines=20)
+    assert tile == 128  # the wide-class floor this test exists to pin
+    args = _random_parents(p, 512, seed=3)
+    bounds_t = pallas_expand.expand_bounds(tables, *args, lb_kind=1,
+                                           tile=tile)
+    bounds_x = pallas_expand.expand_bounds_xla(tables, *args, lb_kind=1,
+                                               tile=tile)
+    np.testing.assert_array_equal(np.asarray(bounds_t),
+                                  np.asarray(bounds_x))
+
+    # drive the full two-phase LB2 step on the class (compile + run;
+    # a tight synthetic ub keeps the bounded window cheap, and hitting
+    # the static pool ceiling early is fine — overflow is a clean,
+    # recoverable exit, not a failure of the route)
+    state = device.init_state(100, 1 << 19, 7000, p_times=p)
+    out = device.run(tables, state, 2, 512, max_iters=40)
+    assert int(out.iters) > 0
+    assert int(out.tree) > 0

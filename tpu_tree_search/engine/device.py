@@ -361,6 +361,41 @@ def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
     return _tiered_compact(gather, perm, n_keep, N, two_phase)
 
 
+def lb2_route(jobs: int, machines: int, pairs: int, chunk: int,
+              tile: int = 1024) -> tuple[str, int, bool]:
+    """THE LB2 routing decision at these shapes: returns
+    (route, TB, pair_kernel_ok), route in {'dense', 'prefilter', 'xla'}
+    — pair_kernel_ok says whether the pallas pair-sweep kernel runs
+    (the prefilter route sweeps via it when True, via the XLA scan when
+    False). Shared by step() and the phase-attribution profiler
+    (utils/phase_timing) so the attribution can never price a path or
+    an implementation the engine does not use.
+
+    - 'dense': one-shot dense pair sweep — needs the pallas pair kernel
+      (lb2_kernel_fits) at the LB2-capped tile AND a few-pair class.
+    - 'prefilter': pallas LB1 pre-prune + pair sweeps over survivor
+      tiers (pallas or XLA scan per lb2_bounds' own dispatch). When the
+      pair kernel cannot run anyway, the LB2 tile cap's halving is moot
+      and the tile retries at the LB1 cap (the 100-job classes).
+    - 'xla': no pallas kernel fits (wrong backend or J*M*TB over every
+      cap) — the dense XLA fallback.
+    """
+    TB = pallas_expand.effective_tile(jobs, chunk, tile, 2,
+                                      machines=machines)
+    pair_ok = (pallas_expand.kernel_ok(jobs, TB, 2, machines=machines)
+               and pallas_expand.lb2_kernel_fits(jobs, pairs))
+    if not pair_ok:
+        TB1 = pallas_expand.effective_tile(jobs, chunk, tile, 1,
+                                           machines=machines)
+        if pallas_expand.kernel_ok(jobs, TB1, 1, machines=machines):
+            TB = TB1
+    if not pallas_expand.kernel_ok(jobs, TB, 1, machines=machines):
+        return "xla", TB, pair_ok
+    if pair_ok and pairs <= 2 * batched.PAIR_PREFILTER:
+        return "dense", TB, pair_ok
+    return "prefilter", TB, pair_ok
+
+
 def pop_chunk(state: SearchState, B: int, M: int):
     """Pop window of up to B parents off the stack top (no commit; the
     caller owns the cursor): the popBackBulk analogue. The window
@@ -400,8 +435,15 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         "seed the state with init_state(..., p_times=...) so it carries "
         "the per-node front tables")
     # the tile ALSO defines the expand outputs' column order — derived
-    # through the same single function expand() uses
-    TB = pallas_expand.effective_tile(J, B, tile, lb_kind)
+    # through the same single functions expand() uses; lb2_route owns
+    # the LB2 route/tile choice (dense vs prefilter vs XLA, including
+    # the LB1-tile retry for the 100-job classes whose pair kernel is
+    # gated off — measured on ta071/ta081, BENCHMARKS.md)
+    if lb_kind == 2:
+        route, TB, _ = lb2_route(J, M, int(tables.ma0.shape[0]), B, tile)
+    else:
+        route = None
+        TB = pallas_expand.effective_tile(J, B, tile, lb_kind, machines=M)
     G = B // TB
     N = B * J
 
@@ -425,10 +467,9 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     ).reshape(1, N)
     mask = (slot_c >= depth_c) & valid_c
 
-    two_phase = lb_kind == 2 and pallas_expand.kernel_ok(J, TB, lb_kind)
     P = int(tables.ma0.shape[0]) if lb_kind == 2 else 0
     KH = batched.PAIR_PREFILTER
-    if two_phase and P <= 2 * KH:
+    if route == "dense":
         # One-shot dense LB2 for the FEW-PAIR classes (P <= 2*KH — no
         # prefilter tier exists): sweep all P pairs over the dense child
         # grid and compact ONCE. The two-phase detour assumes the LB1
@@ -464,7 +505,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         children, child_aux = _tiered_compact(take_dense, perm, n_push,
                                               N, two_phase=True)
         child_depth = child_aux[M].astype(jnp.int16)
-    elif two_phase:
+    elif route == "prefilter":
         # Two-phase LB2 (TPU): bound every child with the near-free LB1
         # first (LB1 <= LB2, so LB1-pruning is sound and the explored
         # set stays the exact LB2 set), rebuild only the survivors from
@@ -495,14 +536,20 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             live columns; columns past the tier read I32_MAX. Finer
             ladder than the compaction's (its branches carry only a
             (1, N) row, so extra rungs are nearly free) with 3/2^k rungs
-            for the same occupancy reason (_compact_tiers); each rung
-            must satisfy the pair-sweep kernel's own tile rule
-            (lb2_tile — lane alignment AND the scoped-VMEM model) or
-            lb2_bounds would silently take its XLA fallback there."""
+            for the same occupancy reason (_compact_tiers). When the
+            sweep runs as the pallas kernel, each rung must satisfy its
+            tile rule (lb2_tile — lane alignment AND the scoped-VMEM
+            model) or lb2_bounds would silently take its XLA fallback
+            there; when the class is outside the pair kernel anyway
+            (lb2_kernel_fits false — the J>64 classes), the XLA scan
+            has no tile constraint and every rung is admitted, keeping
+            the swept prefix snug around small survivor sets."""
             PT = int(tbl.ma0.shape[0])
+            xla_sweep = not pallas_expand.lb2_kernel_fits(J, PT)
             tiers = [t for t in (N // 64, N // 32, 3 * N // 64, N // 16,
                                  3 * N // 32, N // 8, N // 4, N // 2)
-                     if t > 0 and pallas_expand.lb2_tile(J, PT, t) > 0]
+                     if t > 0 and (xla_sweep
+                                   or pallas_expand.lb2_tile(J, PT, t) > 0)]
             tiers.append(N)
 
             def prefix(width):
@@ -526,54 +573,65 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                 return jax.lax.optimization_barrier(out)
             return take
 
-        # Strong-pair prefilter (the reference's unimplemented LB2_LEARN,
-        # c_bound_johnson.h:29): sweep only the PAIR_PREFILTER
-        # strongest pairs (tables store pairs strongest-first), prune on
-        # that partial max (partial max <= LB2, so pruning on it is
-        # sound), and pay for the remaining pairs only on the children
-        # the prefix failed to prune (<10% on the 20x20 class). The
-        # total bound stays exactly max(head, tail) = full LB2, so
-        # explored trees are bit-identical to the single-sweep path.
-        # (This branch only compiles when P > 2*KH; the few-pair classes
-        # take the one-shot dense route above.)
         SW = pallas_expand.sched_words(J)
-        head_t, tail_t = batched.pair_split(tables, KH)
-        lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
-        keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
-        nkeep = keep.sum(dtype=jnp.int32)
-        permh = _partition_prefix(keep, ncand, N, two_phase=True)
-        # the partial bound rides the compaction as an extra row
-        # (three structural variants were tried and measured WORSE:
-        # an index-composed final gather that skips re-gathering
-        # children — the composing (N,) take lowers to a ~4.7 ms
-        # serialized gather; one combined i32 block per compaction —
-        # +60% gather time, byte-bound at 40+ rows; and gathering these
-        # blocks in the pool's int16 aux dtype — TPU column gathers are
-        # element/latency-bound, i16 made them SLOWER (+18%), so the
-        # narrow dtype lives only at the pool boundary, see step())
-        aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
-        children, aux_plus = _tiered_compact(
-            take_block(children, aux_plus), permh, nkeep, N,
-            two_phase=True)
-        # barrier: the tail sweep's pallas call must see the
-        # mid-compaction's switch outputs materialized — without
-        # this, XLA's fusion of the slice chain miscompiles the
-        # compiled (jitted) step on TPU and the tail sweep reads
-        # stale columns, silently over-pruning (eager and
-        # debug-tapped traces are correct — caught by
-        # test_prefilter_branch_matches_oracle on hardware)
-        aux_plus = jax.lax.optimization_barrier(aux_plus)
-        caux = aux_plus[:M + 1]
-        sched = aux_plus[M + 1:M + 1 + SW]
-        lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
-        lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
-        lb2b = jnp.maximum(lb2h_c, lb2t)
-        live = nkeep
+        if P <= KH:
+            # Few pairs but outside the dense route (the wide few-pair
+            # classes, e.g. 100x5: the pallas pair kernel is gated off
+            # past J=64): no prefilter tail exists — pair_split would
+            # return an empty tail table whose (0, N) pair-max has no
+            # identity — so ONE full sweep over the LB1 survivors is
+            # the whole LB2.
+            lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
+            live = ncand
+        else:
+            # Strong-pair prefilter (the reference's unimplemented
+            # LB2_LEARN, c_bound_johnson.h:29): sweep only the
+            # PAIR_PREFILTER strongest pairs (tables store pairs
+            # strongest-first), prune on that partial max (partial max
+            # <= LB2, so pruning on it is sound), and pay for the
+            # remaining pairs only on the children the prefix failed to
+            # prune (<10% on the 20x20 class). The total bound stays
+            # exactly max(head, tail) = full LB2, so explored trees are
+            # bit-identical to the single-sweep path.
+            head_t, tail_t = batched.pair_split(tables, KH)
+            lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
+            keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
+            nkeep = keep.sum(dtype=jnp.int32)
+            permh = _partition_prefix(keep, ncand, N, two_phase=True)
+            # the partial bound rides the compaction as an extra row
+            # (three structural variants were tried and measured WORSE:
+            # an index-composed final gather that skips re-gathering
+            # children — the composing (N,) take lowers to a ~4.7 ms
+            # serialized gather; one combined i32 block per compaction —
+            # +60% gather time, byte-bound at 40+ rows; and gathering
+            # these blocks in the pool's int16 aux dtype — TPU column
+            # gathers are element/latency-bound, i16 made them SLOWER
+            # (+18%), so the narrow dtype lives only at the pool
+            # boundary, see step())
+            aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
+            children, aux_plus = _tiered_compact(
+                take_block(children, aux_plus), permh, nkeep, N,
+                two_phase=True)
+            # barrier: the tail sweep's pallas call must see the
+            # mid-compaction's switch outputs materialized — without
+            # this, XLA's fusion of the slice chain miscompiles the
+            # compiled (jitted) step on TPU and the tail sweep reads
+            # stale columns, silently over-pruning (eager and
+            # debug-tapped traces are correct — caught by
+            # test_prefilter_branch_matches_oracle on hardware)
+            aux_plus = jax.lax.optimization_barrier(aux_plus)
+            caux = aux_plus[:M + 1]
+            sched = aux_plus[M + 1:M + 1 + SW]
+            lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
+            lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
+            lb2b = jnp.maximum(lb2h_c, lb2t)
+            live = nkeep
 
         push = (jnp.arange(N) < live) & (lb2b.reshape(-1) < best)
         n_push = push.sum(dtype=jnp.int32)
         tree = state.tree + n_push.astype(jnp.int64)
-        if __debug__ and __import__("os").environ.get("TTS_DEBUG_STEP"):
+        if (__debug__ and P > KH
+                and __import__("os").environ.get("TTS_DEBUG_STEP")):
             # smuggle intermediates out via the balance counters
             lv = jnp.arange(N) < live
             hsum = jnp.where(lv, lb2h_c.reshape(-1), 0).sum(dtype=jnp.int64)

@@ -255,19 +255,25 @@ def expand_bounds_tpu(tables: BoundTables, prmu_T, depth2, front_T,
     return pieces[0] if G == 1 else jnp.concatenate(pieces, axis=1)
 
 
-def kernel_ok(jobs: int, eff_tile: int, lb_kind: int) -> bool:
+def kernel_ok(jobs: int, eff_tile: int, lb_kind: int,
+              machines: int | None = None) -> bool:
     """THE eligibility rule for the Pallas expand kernels — shared by
     expand(), expand_bounds() and device.step's two-phase gate so the
     dispatch can never diverge between them. The scheduled-set bitmask is
     multi-word (ceil(jobs/32) int32 rows) so LB2 has no job-count cliff;
     whether the pair sweep itself runs as the Pallas kernel or the XLA
-    bitmask path is lb2_bounds' own VMEM decision (lb2_kernel_fits)."""
+    bitmask path is lb2_bounds' own VMEM decision (lb2_kernel_fits).
+    When `machines` is given, the expand kernel's scoped-VMEM unit cap
+    (EXPAND_TILE_UNITS) is enforced too — a trusted caller-supplied tile
+    over the cap must fall back to XLA rather than compile-OOM."""
     if jax.default_backend() != "tpu":
         return False
     lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
-    return (eff_tile >= MIN_PALLAS_TILE
+    return (eff_tile >= min_tile(jobs)
             and eff_tile % 128 == 0          # lane-aligned reshapes
-            and jobs * eff_tile <= lane_cap)
+            and jobs * eff_tile <= lane_cap
+            and (machines is None
+                 or jobs * machines * eff_tile <= EXPAND_TILE_UNITS))
 
 
 def sched_words(jobs: int) -> int:
@@ -323,9 +329,14 @@ def lb2_tile(jobs: int, pairs: int, width: int) -> int:
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
     """The pair-sweep kernel keeps its (J, P, J) f32 per-step job one-hot
     resident in VMEM; past ~4 MB it cannot share VMEM with the column
-    tiles (covers every class through 50xM and 100x5/100x10; wider
-    instances take the XLA bitmask path, lb2_cols, instead)."""
-    return jobs * pairs * jobs * 4 <= LB2_ONEHOT_VMEM
+    tiles. Jobs are additionally capped at 64: mosaic's scoped-VMEM
+    stack behavior changes qualitatively past the validated classes
+    (measured: J=100/P=24/NT=512 allocates 24.8 MB where the J<=50
+    model predicts 2.3 MB — the J-step unrolled temporaries stop being
+    reused). Classes outside either cap take the XLA bitmask path
+    (lb2_cols, a lax.scan), which the two-phase route still runs only
+    over survivor tiers."""
+    return jobs <= 64 and jobs * pairs * jobs * 4 <= LB2_ONEHOT_VMEM
 
 
 def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
@@ -343,8 +354,10 @@ def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
     front_T = front_T.astype(jnp.int32)
     J, B = prmu_T.shape
     eff_tile = (tile if B % tile == 0
-                else effective_tile(J, B, tile, lb_kind))
-    if kernel_ok(J, eff_tile, lb_kind) and lb_kind in (0, 1):
+                else effective_tile(J, B, tile, lb_kind,
+                                    machines=front_T.shape[0]))
+    if kernel_ok(J, eff_tile, lb_kind,
+                 machines=front_T.shape[0]) and lb_kind in (0, 1):
         return expand_bounds_tpu(tables, prmu_T, depth2, front_T,
                                  lb_kind=lb_kind, tile=eff_tile)
     return expand_bounds_xla(tables, prmu_T, depth2, front_T,
@@ -385,18 +398,29 @@ def lb2_cols(tables: BoundTables, sched_mask, child_front_cols):
                    preferred_element_type=jnp.float32).astype(jnp.int32)
     tmp1 = jnp.dot(sel1, cf_f, precision=jax.lax.Precision.HIGHEST,
                    preferred_element_type=jnp.float32).astype(jnp.int32)
-    for j in range(J):
-        jsj = t.js[:, j][:, None]                       # (P, 1)
+
+    # The J-step chain runs as a lax.scan, NOT an unrolled python loop:
+    # unrolled, XLA keeps O(J) of the (P, N) step temporaries live at
+    # once — at 100 jobs x 190 pairs x 409600 children that is ~28 GB
+    # of HBM (measured compile OOM on ta081-class); the scan carries
+    # exactly two (P, N) buffers. Bit-identical math either way.
+    def chain(carry, xs):
+        t0, t1 = carry
+        jsj, pt0j, pt1j, lagj = xs                      # (P,) each
+        jsc = jsj[:, None]                              # (P, 1)
         if W == 1:
-            active = ((sched_mask >> jsj) & one) == 0   # (P, N)
+            active = ((sched_mask >> jsc) & one) == 0   # (P, N)
         else:
-            word = jnp.take(sched_mask, jsj[:, 0] // 32, axis=0)  # (P, N)
-            active = ((word >> (jsj % 32)) & one) == 0
-        new0 = tmp0 + t.ptm0_js[:, j][:, None]
-        new1 = jnp.maximum(tmp1, new0 + t.lag_js[:, j][:, None]) \
-            + t.ptm1_js[:, j][:, None]
-        tmp0 = jnp.where(active, new0, tmp0)
-        tmp1 = jnp.where(active, new1, tmp1)
+            word = jnp.take(sched_mask, jsj // 32, axis=0)        # (P, N)
+            active = ((word >> (jsc % 32)) & one) == 0
+        new0 = t0 + pt0j[:, None]
+        new1 = jnp.maximum(t1, new0 + lagj[:, None]) + pt1j[:, None]
+        return (jnp.where(active, new0, t0),
+                jnp.where(active, new1, t1)), None
+
+    (tmp0, tmp1), _ = jax.lax.scan(
+        chain, (tmp0, tmp1),
+        (t.js.T, t.ptm0_js.T, t.ptm1_js.T, t.lag_js.T))
     back0 = jnp.take(t.min_tails, t.ma0)[:, None]       # (P, 1)
     back1 = jnp.take(t.min_tails, t.ma1)[:, None]
     per_pair = jnp.maximum(tmp1 + back1, tmp0 + back0)
@@ -637,20 +661,49 @@ def expand_bounds_xla(tables: BoundTables, prmu_T, depth2, front_T,
 MIN_PALLAS_TILE = 256   # below this mosaic rejects the lane reshapes
 MAX_TILE_LANES = 1 << 15  # J*tile cap keeping the tile's VMEM ~10 MB
 
+# Expand-kernel scoped-VMEM cap in J*M*TB units: the kernel's unrolled
+# J-loops materialize ~37 B of per-step temporaries per unit. The 512k
+# unit point hard-OOMs the 16 MB stack at BOTH measured J's (18.73 MB
+# at 100x20x256 AND 18.53 MB at 50x20x512 — so the unit model is
+# J-independent, and the pre-cap code had a LATENT compile crash on any
+# 50x20 LB1 run, never hit only because that class's LB2 route happens
+# to use tile 256); 20x20x1024 = 409.6k units is the proven production
+# ceiling, and 100x20x128 compiles and matches the XLA oracle
+# bit-exactly. Applied only when the caller supplies `machines`.
+EXPAND_TILE_UNITS = 20 * 20 * 1024
+
+
+def min_tile(jobs: int) -> int:
+    """Mosaic's lane-reshape floor for the expand kernels: 256 in
+    general; 128 is validated for the wide classes (jobs >= 64 keeps
+    the J*tile lane count >= 8192 — measured bit-exact at J=100/TB=128,
+    which the 100x20 class needs to fit the scoped-VMEM stack)."""
+    return 128 if jobs >= 64 else 256
+
 
 def effective_tile(jobs: int, batch: int, tile: int = 1024,
-                   lb_kind: int = 1) -> int:
+                   lb_kind: int = 1, machines: int | None = None) -> int:
     """The tile expand() will actually use — THE single source of truth
     for the output column order. Shrinks the requested tile while the
-    (jobs x tile) working set exceeds the VMEM budget (20-job instances
-    run at 1024; 50 jobs -> 512; 100 -> 256), then falls back to one
-    batch-wide tile if the batch is not a multiple. LB2 halves the
-    budget — its pair-sweep kernel shares the program's VMEM headroom.
-    step() derives its mask column order from this same function; they
-    must never diverge.
+    (jobs x tile) working set exceeds the VMEM budget or, when
+    `machines` is given, while the expand kernel's scoped-VMEM units
+    (J*M*TB, see EXPAND_TILE_UNITS) exceed the measured ceiling — so
+    20x20 runs at 1024, 50x20 and 100x10 at 256, 100x20 and 200x10 at
+    128; then falls back to one batch-wide tile if the batch is not a
+    multiple. LB2 halves the
+    lane budget — its pair-sweep kernel shares the program's VMEM
+    headroom. step() derives its mask column order from this same
+    function; they must never diverge.
     """
     cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
-    while tile >= MIN_PALLAS_TILE and jobs * tile > cap:
+    floor = min_tile(jobs)
+
+    def too_big(t):
+        if jobs * t > cap:
+            return True
+        return machines is not None and jobs * machines * t > EXPAND_TILE_UNITS
+
+    while tile >= floor and too_big(tile):
         tile //= 2
     return tile if batch % tile == 0 else batch
 
@@ -699,8 +752,9 @@ def expand(tables: BoundTables, prmu_T, depth2, front_T,
     # (kernel_ok below still gates hardware limits — an oversized trusted
     # tile falls back to XLA, never to a different column order).
     eff_tile = (tile if B % tile == 0
-                else effective_tile(J, B, tile, lb_kind))
-    ok = kernel_ok(J, eff_tile, lb_kind)
+                else effective_tile(J, B, tile, lb_kind,
+                                    machines=front_T.shape[0]))
+    ok = kernel_ok(J, eff_tile, lb_kind, machines=front_T.shape[0])
     if ok and lb_kind in (0, 1):
         return expand_tpu(tables, prmu_T, depth2, front_T,
                           lb_kind=lb_kind, tile=eff_tile)

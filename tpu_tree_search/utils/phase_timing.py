@@ -75,20 +75,30 @@ def _pop_and_bound(tables: BoundTables, state, lb_kind: int, chunk: int,
                    tile: int):
     """The step's pop + dense bound evaluation, nothing else — the
     'kernel' phase in reference terms (evaluate_gpu,
-    PFSP_gpu_lib.cu:129-152). For LB2 on TPU this times the PALLAS
-    dense path (expand kernel + pair sweep) — the XLA bitmask fallback
-    the engine never takes overestimated the unit cost ~7x (caught by
+    PFSP_gpu_lib.cu:129-152). For LB2 this times the dense path through
+    the same sweep implementation the engine uses (pallas pair kernel
+    when lb2_kernel_fits, the XLA scan otherwise — timing the WRONG
+    implementation overestimated the unit cost ~7x, caught by
     tools/validate_attribution.py). The dense sweep still overestimates
     the production two-phase route's sweep width (full N vs the
-    survivor tiers, <= ~3x on the 20x20 class) — attribution leans
-    conservative on kernel share; margins documented in BENCHMARKS.md."""
+    survivor tiers); profile_phases scales it by the tier fraction —
+    margins documented in BENCHMARKS.md."""
     from ..engine import device
 
     J = state.prmu.shape[0]
     M = tables.p.shape[0]
-    TB = pallas_expand.effective_tile(J, chunk, tile, lb_kind)
+    P = int(tables.ma0.shape[0])
+    if lb_kind == 2:
+        # device.lb2_route owns BOTH the tile and the
+        # which-implementation decision — the dense proxy must be timed
+        # through the same sweep implementation the engine's route uses
+        _, TB, pair_kernel = device.lb2_route(J, M, P, chunk, tile)
+    else:
+        TB = pallas_expand.effective_tile(J, chunk, tile, lb_kind,
+                                          machines=M)
+        pair_kernel = False
     p_prmu, p_depth, p_aux, *_ = device.pop_chunk(state, chunk, M)
-    if lb_kind == 2 and pallas_expand.kernel_ok(J, TB, 2):
+    if lb_kind == 2 and pair_kernel:
         _, _, bounds = pallas_expand.expand(tables, p_prmu, p_depth,
                                             p_aux, lb_kind=2, tile=TB)
         return bounds
@@ -124,22 +134,29 @@ def profile_phases(tables: BoundTables, state, lb_kind: int, chunk: int,
                 kind, chunk, tile).sum(dtype=jnp.float32), K)(warm)
 
     J = state.prmu.shape[0]
-    TBk = pallas_expand.effective_tile(J, chunk, tile, lb_kind)
+    M = tables.p.shape[0]
     P = int(tables.ma0.shape[0])
     from ..ops import batched as _b
-    if (lb_kind == 2 and pallas_expand.kernel_ok(J, TBk, 2)
-            and P > 2 * _b.PAIR_PREFILTER):
-        # two-phase prefilter engine: the timeable dense proxy sweeps
-        # ALL pairs over the FULL grid; production sweeps run the KH
-        # head pairs over the ~N/4 candidate tier and the tail pairs
-        # over the ~3N/32 survivor tier — scale the sweep part by that
-        # tier fraction so the attribution prices the path the engine
-        # actually takes (tools/validate_attribution.py measures the
-        # residual margin)
+
+    # device.lb2_route IS the engine's routing decision — sharing it is
+    # what keeps the attribution from pricing a path the engine does
+    # not take (the round-2 bug class tools/validate_attribution.py
+    # exists to catch)
+    route, _, _ = device.lb2_route(J, M, P, chunk, tile)
+    if lb_kind == 2 and route == "prefilter":
+        # prefilter engine: the timeable dense proxy sweeps ALL pairs
+        # over the FULL grid; production sweeps run min(KH, P) head
+        # pairs over the ~N/4 candidate tier and any remaining tail
+        # pairs over the ~3N/32 survivor tier — scale the sweep part by
+        # that tier fraction so the attribution prices the path the
+        # engine actually takes (applies to the J>64 classes too, whose
+        # sweeps run as the XLA scan over the same tiers; for P <= KH
+        # the tail term is zero — one full sweep at the candidate tier)
         t1 = timed_bound(1)
         t2 = max(timed_bound(2), t1)
         KH = _b.PAIR_PREFILTER
-        frac = 0.25 * KH / P + (3 / 32) * (P - KH) / P
+        frac = (0.25 * min(KH, P) / P
+                + (3 / 32) * max(P - KH, 0) / P)
         t_bound = t1 + (t2 - t1) * frac
     else:
         t_bound = timed_bound(lb_kind)
