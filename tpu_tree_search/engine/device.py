@@ -277,7 +277,8 @@ def _regather(tables: BoundTables, p_prmu, p_depth2, p_aux, idx,
     return child, caux, jnp.concatenate(words, axis=0)
 
 
-def _compact_tiers(N: int, two_phase: bool = False) -> list[int]:
+def _compact_tiers(N: int, two_phase: bool = False,
+                   cap: int | None = None) -> list[int]:
     """Compaction tier widths. Few and carefully placed: every extra
     lax.switch branch costs a copy of the (rows, N) output blocks
     (measured: a 9-rung ladder cost LB1 14% of its step rate). The LB1
@@ -285,10 +286,15 @@ def _compact_tiers(N: int, two_phase: bool = False) -> list[int]:
     candidates in N//4); the two-phase LB2 ladder adds 3N//32 for the
     post-prefilter survivors, which sit just above N//16 — a pow2-only
     ladder would round them to N//4, 4x the gather+pad width (measured
-    on ta021: ncand~152k -> N//4, nkeep~43k -> 3N//32)."""
+    on ta021: ncand~152k -> N//4, nkeep~43k -> 3N//32).
+
+    `cap` truncates the ladder AND the frame: every block is padded to
+    `cap` instead of N (the steady branch of the two-phase route runs
+    its whole post-LB1 pipeline in N//4-wide frames — see step())."""
     steps = ((N // 16, 3 * N // 32, N // 4) if two_phase
              else (N // 16, N // 4))
-    return [t for t in steps if t >= 128] + [N]
+    cap = N if cap is None else cap
+    return [t for t in steps if 128 <= t < cap] + [cap]
 
 
 def _tier_switch(tiers: list[int], count, make_branch):
@@ -303,7 +309,8 @@ def _tier_switch(tiers: list[int], count, make_branch):
 
 
 def _partition_prefix(push: jax.Array, live, N: int,
-                      two_phase: bool = False) -> jax.Array:
+                      two_phase: bool = False,
+                      cap: int | None = None) -> jax.Array:
     """_partition when every True column is known to sit below `live`
     (a traced count): sort only the smallest compaction tier covering
     `live` instead of all N keys (~3x of the two-phase step's sort cost
@@ -313,52 +320,59 @@ def _partition_prefix(push: jax.Array, live, N: int,
     the pool cursor and are never read (the consuming compact's tier is
     chosen by n_push <= live, so its prefix always lies inside the
     sorted region)."""
-    tiers = _compact_tiers(N, two_phase)
+    tiers = _compact_tiers(N, two_phase, cap)
+    frame = push.shape[0]
 
     def branch(t):
         def f(_):
             srt = _partition(push[:t])
-            if t < N:
+            if t < frame:
                 srt = jnp.concatenate(
-                    [srt, jnp.arange(t, N, dtype=jnp.int32)])
+                    [srt, jnp.arange(t, frame, dtype=jnp.int32)])
             return srt
         return f
 
     return _tier_switch(tiers, live, branch)
 
 
-def _tiered_compact(gather, perm, n_keep, N: int, two_phase: bool = False):
-    """Full-width (N-column) compacted block, built by the smallest tier
-    that covers the `n_keep` survivors: a switch branch gathers only its
-    tier's prefix via `gather(idx) -> tuple of (rows, len(idx)) blocks`
-    and zero-pads the rest (a cheap sequential write; the garbage columns
-    land above the pool cursor and are never read). The switch carries
-    only these blocks — threading the HBM pools through conditional
-    branches copies them (measured: ~4x step cost), which is why the
-    caller writes the block into the pool outside."""
+def _tiered_compact(gather, perm, n_keep, N: int, two_phase: bool = False,
+                    cap: int | None = None):
+    """Frame-width compacted block (frame = `cap` or N), built by the
+    smallest tier that covers the `n_keep` survivors: a switch branch
+    gathers only its tier's prefix via `gather(idx) -> tuple of
+    (rows, len(idx)) blocks` and zero-pads the rest (a cheap sequential
+    write; the garbage columns land above the pool cursor and are never
+    read). The switch carries only these blocks — threading the HBM
+    pools through conditional branches copies them (measured: ~4x step
+    cost), which is why the caller writes the block into the pool
+    outside."""
+    tiers = _compact_tiers(N, two_phase, cap)
+    frame = tiers[-1]
+
     def branch(t):
         def f(_):
             out = gather(jax.lax.slice(perm, (0,), (t,)))
-            if t < N:
+            if t < frame:
                 out = tuple(jnp.concatenate(
-                    [o, jnp.zeros(o.shape[:-1] + (N - t,), o.dtype)],
+                    [o, jnp.zeros(o.shape[:-1] + (frame - t,), o.dtype)],
                     axis=-1) for o in out)
             return out
         return f
 
-    return _tier_switch(_compact_tiers(N, two_phase), n_keep, branch)
+    return _tier_switch(tiers, n_keep, branch)
 
 
 def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
                           perm, n_keep, TB: int, N: int,
                           with_sched: bool = False,
-                          two_phase: bool = False):
+                          two_phase: bool = False,
+                          cap: int | None = None):
     """Compacted child block rebuilt from the popped parents (see
     _regather), tiered by survivor count (see _tiered_compact)."""
     def gather(idx):
         return _regather(tables, p_prmu, p_depth2, p_aux, idx, TB,
                          with_sched)
-    return _tiered_compact(gather, perm, n_keep, N, two_phase)
+    return _tiered_compact(gather, perm, n_keep, N, two_phase, cap)
 
 
 def lb2_route(jobs: int, machines: int, pairs: int, chunk: int,
@@ -416,6 +430,55 @@ def pop_chunk(state: SearchState, B: int, M: int):
     return p_prmu, p_depth, p_aux, n, start, valid
 
 
+def _write_block(state: SearchState, children, child_depth, child_aux,
+                 start, n_push, limit):
+    """Write the compacted child block at the cursor — or, when the step
+    overflows, into the scratch margin at `limit` (rows
+    [limit, limit + B*J) hold no live data by the size <= limit
+    invariant), so an overflowing step's pool is untouched in its live
+    region. Uses the same `start + n_push > limit` predicate as
+    _commit's scalar guards — keep via this one helper."""
+    M = child_aux.shape[0] - 1
+    zero = jnp.zeros((), start.dtype)
+    write_at = jnp.where(start + n_push > limit,
+                         jnp.asarray(limit, start.dtype), start)
+    prmu = jax.lax.dynamic_update_slice(state.prmu, children,
+                                        (zero, write_at))
+    depth = jax.lax.dynamic_update_slice(state.depth, child_depth,
+                                         (write_at,))
+    aux = jax.lax.dynamic_update_slice(
+        state.aux, child_aux[:M].astype(state.aux.dtype), (zero, write_at))
+    return prmu, depth, aux
+
+
+def _commit(state: SearchState, prmu, depth, aux, n_push, best, sol, mask,
+            limit, start) -> SearchState:
+    """THE no-commit overflow contract, shared by every route: an
+    overflowing step must NOT commit — advancing the cursor past the
+    limit would lose subtrees (and make the overflow checkpoint
+    unrecoverable). The state is left exactly as before the step with
+    only the flag set: the caller routes the block write to the scratch
+    margin (rows [limit, limit + B*J) hold no live data by the
+    size <= limit invariant — `write_at` at the call sites uses this
+    same `start + n_push > limit` condition) and the scalars here are
+    guarded with selects, so grow-capacity + resume continues the
+    search losslessly."""
+    new_size = start + n_push
+    overflow = new_size > limit
+    keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
+    return state._replace(
+        prmu=prmu,
+        depth=depth,
+        aux=aux,
+        size=keep(new_size, state.size),
+        best=keep(best, state.best),
+        tree=keep(state.tree + n_push.astype(jnp.int64), state.tree),
+        sol=keep(sol, state.sol),
+        iters=state.iters + 1,
+        evals=keep(state.evals + mask.sum(dtype=jnp.int64), state.evals),
+        overflow=state.overflow | overflow)
+
+
 def step(tables: BoundTables, lb_kind: int, chunk: int,
          state: SearchState, tile: int = 1024,
          limit: int | None = None) -> SearchState:
@@ -448,7 +511,6 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     N = B * J
 
     p_prmu, p_depth, p_aux, n, start, valid = pop_chunk(state, B, M)
-    zero = jnp.zeros((), start.dtype)
     # The pool stores aux in the narrow per-instance dtype (aux_dtype:
     # int16 for every class whose completion times fit); intra-step
     # blocks are all i32 — measured on v5e: TPU column gathers are
@@ -493,7 +555,6 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
         push = (mask & ~is_leaf & (lb2b.reshape(1, -1) < best)).reshape(-1)
         n_push = push.sum(dtype=jnp.int32)
-        tree = state.tree + n_push.astype(jnp.int64)
 
         def take_dense(idx):
             idx = jax.lax.optimization_barrier(idx)
@@ -527,38 +588,48 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         ncand = cand.sum(dtype=jnp.int32)
 
         perm1 = _partition(cand)
-        children, caux, sched = _compact_from_parents(
-            tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
-            with_sched=True, two_phase=True)
+        SW = pallas_expand.sched_words(J)
+        debug_tap = bool(__debug__ and P > KH and
+                         __import__("os").environ.get("TTS_DEBUG_STEP"))
+        if limit is None:
+            limit = row_limit(capacity, B, J)
 
         def sweep_tiers(tbl, cf_cols, sched_cols, count):
             """Pair sweep over the smallest prefix tier covering `count`
             live columns; columns past the tier read I32_MAX. Finer
             ladder than the compaction's (its branches carry only a
-            (1, N) row, so extra rungs are nearly free) with 3/2^k rungs
-            for the same occupancy reason (_compact_tiers). When the
-            sweep runs as the pallas kernel, each rung must satisfy its
-            tile rule (lb2_tile — lane alignment AND the scoped-VMEM
+            (1, frame) row, so extra rungs are nearly free) with 3/2^k
+            rungs for the same occupancy reason (_compact_tiers). When
+            the sweep runs as the pallas kernel, each rung must satisfy
+            its tile rule (lb2_tile — lane alignment AND the scoped-VMEM
             model) or lb2_bounds would silently take its XLA fallback
             there; when the class is outside the pair kernel anyway
             (lb2_kernel_fits false — the J>64 classes), the XLA scan
             has no tile constraint and every rung is admitted, keeping
             the swept prefix snug around small survivor sets."""
             PT = int(tbl.ma0.shape[0])
+            frame = cf_cols.shape[1]
             xla_sweep = not pallas_expand.lb2_kernel_fits(J, PT)
-            tiers = [t for t in (N // 64, N // 32, 3 * N // 64, N // 16,
-                                 3 * N // 32, N // 8, N // 4, N // 2)
-                     if t > 0 and (xla_sweep
-                                   or pallas_expand.lb2_tile(J, PT, t) > 0)]
-            tiers.append(N)
+            # finer than the compaction ladder (rungs here carry only a
+            # (1, frame) row): the tail sweep's survivor count sits
+            # wherever the head prune left it, and a coarse ladder
+            # over-sweeps it by up to 50% (nkeep~43k rode the 61440
+            # rung — measured, 166 pairs x 18k wasted columns/step)
+            tiers = [t for t in (k * N // 64 for k in
+                                 (1, 2, 3, 4, 5, 6, 8, 10, 12, 14, 16,
+                                  20, 24, 32))
+                     if 0 < t < frame
+                     and (xla_sweep
+                          or pallas_expand.lb2_tile(J, PT, t) > 0)]
+            tiers.append(frame)
 
             def prefix(width):
                 def f(_):
                     b = pallas_expand.lb2_bounds(
                         tbl, cf_cols[:, :width], sched_cols[:, :width])
-                    if width < N:
+                    if width < frame:
                         b = jnp.concatenate(
-                            [b, jnp.full((1, N - width), I32_MAX,
+                            [b, jnp.full((1, frame - width), I32_MAX,
                                          jnp.int32)], axis=1)
                     return b
                 return f
@@ -566,85 +637,147 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             return _tier_switch(tiers, count, prefix)
 
         def take_block(*rows_arrays):
-            """prefix-gather closure over the given (rows, N) arrays."""
+            """prefix-gather closure over the given (rows, frame)
+            arrays."""
             def take(idx):
                 idx = jax.lax.optimization_barrier(idx)
                 out = tuple(jnp.take(a, idx, axis=1) for a in rows_arrays)
                 return jax.lax.optimization_barrier(out)
             return take
 
-        SW = pallas_expand.sched_words(J)
-        if P <= KH:
-            # Few pairs but outside the dense route (the wide few-pair
-            # classes, e.g. 100x5: the pallas pair kernel is gated off
-            # past J=64): no prefilter tail exists — pair_split would
-            # return an empty tail table whose (0, N) pair-max has no
-            # identity — so ONE full sweep over the LB1 survivors is
-            # the whole LB2.
-            lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
-            live = ncand
-        else:
-            # Strong-pair prefilter (the reference's unimplemented
-            # LB2_LEARN, c_bound_johnson.h:29): sweep only the
-            # PAIR_PREFILTER strongest pairs (tables store pairs
-            # strongest-first), prune on that partial max (partial max
-            # <= LB2, so pruning on it is sound), and pay for the
-            # remaining pairs only on the children the prefix failed to
-            # prune (<10% on the 20x20 class). The total bound stays
-            # exactly max(head, tail) = full LB2, so explored trees are
-            # bit-identical to the single-sweep path.
-            head_t, tail_t = batched.pair_split(tables, KH)
-            lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
-            keep = (jnp.arange(N) < ncand) & (lb2h.reshape(-1) < best)
-            nkeep = keep.sum(dtype=jnp.int32)
-            permh = _partition_prefix(keep, ncand, N, two_phase=True)
-            # the partial bound rides the compaction as an extra row
-            # (three structural variants were tried and measured WORSE:
-            # an index-composed final gather that skips re-gathering
-            # children — the composing (N,) take lowers to a ~4.7 ms
-            # serialized gather; one combined i32 block per compaction —
-            # +60% gather time, byte-bound at 40+ rows; and gathering
-            # these blocks in the pool's int16 aux dtype — TPU column
-            # gathers are element/latency-bound, i16 made them SLOWER
-            # (+18%), so the narrow dtype lives only at the pool
-            # boundary, see step())
-            aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
-            children, aux_plus = _tiered_compact(
-                take_block(children, aux_plus), permh, nkeep, N,
-                two_phase=True)
-            # barrier: the tail sweep's pallas call must see the
-            # mid-compaction's switch outputs materialized — without
-            # this, XLA's fusion of the slice chain miscompiles the
-            # compiled (jitted) step on TPU and the tail sweep reads
-            # stale columns, silently over-pruning (eager and
-            # debug-tapped traces are correct — caught by
-            # test_prefilter_branch_matches_oracle on hardware)
-            aux_plus = jax.lax.optimization_barrier(aux_plus)
-            caux = aux_plus[:M + 1]
-            sched = aux_plus[M + 1:M + 1 + SW]
-            lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
-            lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
-            lb2b = jnp.maximum(lb2h_c, lb2t)
-            live = nkeep
+        def tail_pipeline(W_):
+            """Everything after the LB1 prune, in W_-wide frames.
 
-        push = (jnp.arange(N) < live) & (lb2b.reshape(-1) < best)
-        n_push = push.sum(dtype=jnp.int32)
-        tree = state.tree + n_push.astype(jnp.int64)
-        if (__debug__ and P > KH
-                and __import__("os").environ.get("TTS_DEBUG_STEP")):
-            # smuggle intermediates out via the balance counters
-            lv = jnp.arange(N) < live
-            hsum = jnp.where(lv, lb2h_c.reshape(-1), 0).sum(dtype=jnp.int64)
-            tsum = jnp.where(lv, lb2t.reshape(-1), 0).sum(dtype=jnp.int64)
+            Run twice as the two branches of ONE lax.cond: the steady
+            branch at W_ = N//4 (taken whenever ncand fits, ~93% of
+            ta021 steady-state iterations) and the safe branch at
+            W_ = N. On v5e the gather cost cliff sits on the SOURCE
+            width (tools/bench_gather.py: t=61440 costs 0.69 ms from a
+            164k-wide source vs 4.0 ms from a 655k-wide one), so the
+            steady branch's blocks are BORN narrow — its compaction
+            gathers read N//4-wide sources, its pads/copies and the
+            final pool block write shrink 4x. Slicing the sources of a
+            full-width pipeline instead was measured WORSE than the
+            round-3 baseline (the slice ops break XLA's gather+pad
+            fusions and re-materialize every block: 43.6M -> 34.0M
+            evals/s), which is why the narrow width is threaded through
+            the whole pipeline rather than applied at the gathers."""
+            def f(_):
+                children, caux, sched = _compact_from_parents(
+                    tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
+                    with_sched=True, two_phase=True, cap=W_)
+
+                if P <= KH:
+                    # Few pairs but outside the dense route (the wide
+                    # few-pair classes, e.g. 100x5: the pallas pair
+                    # kernel is gated off past J=64): no prefilter tail
+                    # exists — pair_split would return an empty tail
+                    # table whose (0, frame) pair-max has no identity —
+                    # so ONE full sweep over the LB1 survivors is the
+                    # whole LB2.
+                    lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
+                    live = ncand
+                else:
+                    # Strong-pair prefilter (the reference's
+                    # unimplemented LB2_LEARN, c_bound_johnson.h:29):
+                    # sweep only the PAIR_PREFILTER strongest pairs
+                    # (tables store pairs strongest-first), prune on
+                    # that partial max (partial max <= LB2, so pruning
+                    # on it is sound), and pay for the remaining pairs
+                    # only on the children the prefix failed to prune
+                    # (<10% on the 20x20 class). The total bound stays
+                    # exactly max(head, tail) = full LB2, so explored
+                    # trees are bit-identical to the single-sweep path.
+                    head_t, tail_t = batched.pair_split(tables, KH)
+                    lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
+                    keep = ((jnp.arange(W_) < ncand)
+                            & (lb2h.reshape(-1) < best))
+                    nkeep = keep.sum(dtype=jnp.int32)
+                    permh = _partition_prefix(keep, ncand, N,
+                                              two_phase=True, cap=W_)
+                    # the partial bound rides the compaction as an
+                    # extra row (three structural variants were tried
+                    # and measured WORSE: an index-composed final
+                    # gather that skips re-gathering children — the
+                    # composing (N,) take lowers to a ~4.7 ms
+                    # serialized gather; one combined i32 block per
+                    # compaction — +60% gather time, byte-bound at 40+
+                    # rows; and gathering these blocks in the pool's
+                    # int16 aux dtype — TPU column gathers are
+                    # element/latency-bound, i16 made them SLOWER
+                    # (+18%), so the narrow dtype lives only at the
+                    # pool boundary, see step())
+                    aux_plus = jnp.concatenate([caux, sched, lb2h],
+                                               axis=0)
+                    children, aux_plus = _tiered_compact(
+                        take_block(children, aux_plus), permh, nkeep, N,
+                        two_phase=True, cap=W_)
+                    # barrier: the tail sweep's pallas call must see
+                    # the mid-compaction's switch outputs materialized
+                    # — without this, XLA's fusion of the slice chain
+                    # miscompiles the compiled (jitted) step on TPU and
+                    # the tail sweep reads stale columns, silently
+                    # over-pruning (eager and debug-tapped traces are
+                    # correct — caught by
+                    # test_prefilter_branch_matches_oracle on hardware)
+                    aux_plus = jax.lax.optimization_barrier(aux_plus)
+                    caux = aux_plus[:M + 1]
+                    sched = aux_plus[M + 1:M + 1 + SW]
+                    lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
+                    lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
+                    lb2b = jnp.maximum(lb2h_c, lb2t)
+                    live = nkeep
+
+                push = ((jnp.arange(W_) < live)
+                        & (lb2b.reshape(-1) < best))
+                n_push = push.sum(dtype=jnp.int32)
+                if debug_tap:
+                    # smuggle intermediates out via the balance counters
+                    lv = jnp.arange(W_) < live
+                    hsum = jnp.where(lv, lb2h_c.reshape(-1),
+                                     0).sum(dtype=jnp.int64)
+                    tsum = jnp.where(lv, lb2t.reshape(-1),
+                                     0).sum(dtype=jnp.int64)
+                else:
+                    hsum = tsum = jnp.int64(0)
+
+                # final compaction: direct prefix gather of the
+                # already-built block (sources are the compacted
+                # (features, W_) arrays)
+                perm2 = _partition_prefix(push, live, N, two_phase=True,
+                                          cap=W_)
+                children, child_aux = _tiered_compact(
+                    take_block(children, caux), perm2, n_push, N,
+                    two_phase=True, cap=W_)
+                child_depth = child_aux[M].astype(jnp.int16)
+
+                # pool write inside the branch: the written block is
+                # W_-wide, so the steady branch moves a quarter of the
+                # bytes (_write_block owns the overflow scratch-margin
+                # routing, shared with the common path)
+                prmu, depth, aux = _write_block(
+                    state, children, child_depth, child_aux, start,
+                    n_push, limit)
+                return prmu, depth, aux, n_push, hsum, tsum
+            return f
+
+        # N/4 cap: ncand hovers just under it on the 20x20 class
+        # (~0.93 N/4 steady state; ~7% of iterations exceed it and take
+        # the safe branch). A 5N/16 cap was measured very slightly
+        # WORSE (47.4M vs 47.9M): widening every steady-branch frame
+        # costs more than the rare safe branch saves.
+        W = max(N // 4, 128)
+        if W >= N:  # toy shapes: no narrow branch exists
+            prmu, depth, aux, n_push, hsum, tsum = tail_pipeline(N)(0)
+        else:
+            prmu, depth, aux, n_push, hsum, tsum = jax.lax.cond(
+                ncand <= W, tail_pipeline(W), tail_pipeline(N), 0)
+
+        if debug_tap:
             state = state._replace(sent=hsum, recv=tsum,
                                    steals=n_push.astype(jnp.int64))
-
-        # final compaction: direct prefix gather of the already-built
-        # block (sources are the compacted (features, N) arrays)
-        perm2 = _partition_prefix(push, live, N, two_phase=True)
-        children, child_aux = _tiered_compact(
-            take_block(children, caux), perm2, n_push, N, two_phase=True)
-        child_depth = child_aux[M].astype(jnp.int16)
+        return _commit(state, prmu, depth, aux, n_push, best, sol, mask,
+                       limit, start)
     else:
         # --- bounds of the dense child grid (Pallas on TPU; the children
         # themselves are never materialized — survivors are rebuilt from
@@ -662,7 +795,6 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # --- prune + push surviving internal children
         push = (mask & ~is_leaf & (bounds < best)).reshape(-1)
         n_push = push.sum(dtype=jnp.int32)
-        tree = state.tree + n_push.astype(jnp.int64)
 
         # Compaction: stable-partition the surviving column indices to
         # the front (_partition), rebuild those children from their
@@ -680,35 +812,10 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
     if limit is None:
         limit = row_limit(capacity, B, J)
-    new_size = start + n_push
-
-    # An overflowing step must NOT commit: advancing the cursor past the
-    # limit would lose subtrees (and make the overflow checkpoint
-    # unrecoverable). The state is left exactly as before the step with
-    # only the flag set — the block write is routed to the scratch margin
-    # (rows [limit, limit + B*J) hold no live data by the size <= limit
-    # invariant) and scalars are guarded with selects, so grow-capacity +
-    # resume continues the search losslessly.
-    overflow = new_size > limit
-    write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
-    prmu = jax.lax.dynamic_update_slice(state.prmu, children,
-                                        (zero, write_at))
-    depth = jax.lax.dynamic_update_slice(state.depth, child_depth,
-                                         (write_at,))
-    aux = jax.lax.dynamic_update_slice(
-        state.aux, child_aux[:M].astype(state.aux.dtype), (zero, write_at))
-    keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
-    return state._replace(
-        prmu=prmu,
-        depth=depth,
-        aux=aux,
-        size=keep(new_size, state.size),
-        best=keep(best, state.best),
-        tree=keep(tree, state.tree),
-        sol=keep(sol, state.sol),
-        iters=state.iters + 1,
-        evals=keep(state.evals + mask.sum(dtype=jnp.int64), state.evals),
-        overflow=state.overflow | overflow)
+    prmu, depth, aux = _write_block(state, children, child_depth,
+                                    child_aux, start, n_push, limit)
+    return _commit(state, prmu, depth, aux, n_push, best, sol, mask,
+                   limit, start)
 
 
 @functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "tile"))

@@ -147,16 +147,20 @@ def profile_phases(tables: BoundTables, state, lb_kind: int, chunk: int,
         # prefilter engine: the timeable dense proxy sweeps ALL pairs
         # over the FULL grid; production sweeps run min(KH, P) head
         # pairs over the ~N/4 candidate tier and any remaining tail
-        # pairs over the ~3N/32 survivor tier — scale the sweep part by
-        # that tier fraction so the attribution prices the path the
-        # engine actually takes (applies to the J>64 classes too, whose
-        # sweeps run as the XLA scan over the same tiers; for P <= KH
-        # the tail term is zero — one full sweep at the candidate tier)
+        # pairs over the survivor tier — since the round-4 fine sweep
+        # ladder (device.step sweep_tiers, rungs of N/64) the tail rung
+        # sits snugly at ~5N/64 on the measured ta021 steady state
+        # (nkeep ~43k of N=655k) rather than the old coarse 3N/32 rung.
+        # Scale the sweep part by that tier fraction so the attribution
+        # prices the path the engine actually takes (applies to the
+        # J>64 classes too, whose sweeps run as the XLA scan over the
+        # same tiers; for P <= KH the tail term is zero — one full
+        # sweep at the candidate tier)
         t1 = timed_bound(1)
         t2 = max(timed_bound(2), t1)
         KH = _b.PAIR_PREFILTER
         frac = (0.25 * min(KH, P) / P
-                + (3 / 32) * max(P - KH, 0) / P)
+                + (5 / 64) * max(P - KH, 0) / P)
         t_bound = t1 + (t2 - t1) * frac
     else:
         t_bound = timed_bound(lb_kind)
