@@ -44,11 +44,14 @@ def test_attribute_sums_to_elapsed_and_differentiates_workers():
 
 
 def test_cli_dist_csv_has_real_phase_columns(tmp_path):
-    """End-to-end: a -D 8 CLI run writes per-worker timing arrays that
-    are nonzero and bounded by the run's wall time."""
+    """End-to-end: a single-controller -D 8 CLI run writes the
+    reference's INTRA-NODE schema (multigpu.csv,
+    PFSP_statistic.c:69-112 — `--multihost` runs write the dist
+    schema) with per-worker timing arrays that are nonzero and bounded
+    by the run's wall time."""
     from tpu_tree_search import cli
 
-    path = tmp_path / "dist.csv"
+    path = tmp_path / "multigpu.csv"
     rc = cli.main(["pfsp", "-i", "3", "-l", "2", "-u", "1", "-D", "8",
                    "--chunk", "64", "--capacity", str(1 << 15),
                    "--csv", str(path)])
@@ -56,9 +59,10 @@ def test_cli_dist_csv_has_real_phase_columns(tmp_path):
     rows = analysis.read_rows(str(path))
     assert len(rows) == 1
     row = rows[0]
-    kernel = np.asarray(row["all_gpu_kernel_time"], dtype=float)
-    gen = np.asarray(row["all_gpu_gen_child_time"], dtype=float)
-    idle = np.asarray(row["all_gpu_idle_time"], dtype=float)
+    assert "all_exp_tree_gpu" not in row     # dist-only column family
+    kernel = np.asarray(row["gpu_kernel_time"], dtype=float)
+    gen = np.asarray(row["gpu_gen_child_time"], dtype=float)
+    idle = np.asarray(row["gpu_idle_time"], dtype=float)
     total = float(row["total_time"])
     assert len(kernel) == 8
     assert kernel.sum() > 0

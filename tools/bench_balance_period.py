@@ -1,0 +1,106 @@
+"""Ground the balance-period default with ON-CHIP cost data.
+
+VERDICT r4 #9: the round-3 sensitivity table measured balance_period on
+the virtual CPU mesh, where collectives serialize on the host — its
+wall-clock preference for sparse periods (16 beat 4 by 1.7x) is an
+artifact of that backend, and the default was never defended.
+
+This tool prices the period where it matters: the per-iteration cost of
+the FULL SPMD program (build_dist_loop on a 1-chip mesh) at each
+period, on IDENTICAL warmed state and windows (the same-state method of
+tools/bench_spmd_tax.py — both prior methodologies documented there
+gave garbage). The spread side of the tradeoff (per-worker tree CV vs
+period) is backend-independent and comes from the round-3 CPU-mesh
+table; this measurement supplies the missing cost side.
+
+    python tools/bench_balance_period.py [--inst 21] [--lb 2]
+"""
+
+import argparse
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tpu_tree_search.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tpu_tree_search.engine import device, distributed  # noqa: E402
+from tpu_tree_search.ops import batched  # noqa: E402
+from tpu_tree_search.parallel.mesh import worker_mesh  # noqa: E402
+from tpu_tree_search.problems import taillard  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--inst", type=int, default=21)
+    ap.add_argument("--lb", type=int, default=2)
+    ap.add_argument("--chunk", type=int, default=32768)
+    ap.add_argument("--capacity", type=int, default=1 << 22)
+    ap.add_argument("--warm", type=int, default=500)
+    ap.add_argument("--iters", type=int, default=256)
+    ap.add_argument("--periods", type=int, nargs="*",
+                    default=[1, 2, 4, 8, 16, 64])
+    ap.add_argument("--repeats", type=int, default=3)
+    args = ap.parse_args()
+
+    p = taillard.processing_times(args.inst)
+    ub = taillard.optimal_makespan(args.inst)
+    tables = batched.make_tables(p)
+    jobs, machines = p.shape[1], p.shape[0]
+    chunk, lb = args.chunk, args.lb
+
+    state = device.init_state(jobs, args.capacity, ub, p_times=p)
+    state = device.run(tables, state, lb, chunk, max_iters=args.warm)
+    state.size.block_until_ready()
+    assert not bool(state.overflow) and int(state.size) > 0
+    target = int(state.iters) + args.iters
+    stacked = tuple(x[None] for x in state)
+
+    adt = device.aux_dtype(p)
+    tc = distributed.default_transfer_cap(chunk, jobs, machines, 1,
+                                          aux_itemsize=adt.itemsize)
+    limit = min(device.row_limit(args.capacity, chunk, jobs),
+                args.capacity - tc)
+
+    def mls(t, lim):
+        return functools.partial(device.step, t, lb, chunk, limit=lim)
+
+    rows = []
+    for period in args.periods:
+        loop = distributed.build_dist_loop(worker_mesh(1), tables, mls,
+                                           period, tc, 2 * chunk, limit)
+
+        def call():
+            out = loop(tables, jnp.int64(target), *stacked)
+            jax.block_until_ready(out)
+
+        call()  # compile+warm at the final signature
+        best = float("inf")
+        for _ in range(args.repeats):
+            t0 = time.perf_counter()
+            call()
+            best = min(best, time.perf_counter() - t0)
+        ms = best / args.iters * 1e3
+        rows.append({"balance_period": period,
+                     "ms_per_iter": round(ms, 4)})
+        print(json.dumps(rows[-1]), flush=True)
+
+    base = rows[0]["ms_per_iter"] if rows else 0
+    print(json.dumps({"inst": args.inst, "lb": lb, "chunk": chunk,
+                      "window_iters": args.iters,
+                      "rows": rows,
+                      "note": "identical warmed state across periods"}))
+
+
+if __name__ == "__main__":
+    main()

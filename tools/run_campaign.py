@@ -1,0 +1,131 @@
+"""Campaign driver: solve Taillard instances end-to-end on one chip,
+with a per-instance wall budget and partial-progress reporting.
+
+Generalizes tools/run_single_device_table.py (VERDICT r3 #7, the 20x20
+table) to the reference's wider campaign groups (VERDICT r4 #1): the
+50-job groups its intra-node driver enumerates
+(/root/reference/pfsp/launch_scripts/mgpu_launch.sh:51-58 — ta031-ta050
+and ta052/53/56/57/58) and any other instance list, at either bound.
+
+Per instance: solve to the PROVEN optimum (ub=opt, pool drained) within
+the budget, else stop at the budget and record the partial row — tree
+so far, sustained pushed-nodes/s and eval rate — so infeasible
+instances get a measured rate + extrapolation instead of silence.
+Overflow grows the pool losslessly (checkpoint.grow) and continues.
+
+    TTS_BUDGET_S=7200 nohup python -u tools/run_campaign.py 31 32 ... \
+        > /tmp/campaign.log 2>&1 &
+
+Env: TTS_BUDGET_S (default 7200), TTS_LB (default 2), TTS_CHUNK
+(default 32768), TTS_CAMPAIGN_OUT (default /tmp/campaign.jsonl).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from tpu_tree_search.utils import compile_cache  # noqa: E402
+
+compile_cache.enable()
+
+import jax  # noqa: E402
+
+from tpu_tree_search.engine import checkpoint, device  # noqa: E402
+from tpu_tree_search.ops import batched  # noqa: E402
+from tpu_tree_search.problems import taillard  # noqa: E402
+
+OUT = os.environ.get("TTS_CAMPAIGN_OUT", "/tmp/campaign.jsonl")
+LB = int(os.environ.get("TTS_LB", "2"))
+CHUNK = int(os.environ.get("TTS_CHUNK", "32768"))
+BUDGET_S = float(os.environ.get("TTS_BUDGET_S", "7200"))
+SEG = int(os.environ.get("TTS_SEG", "2000"))
+
+
+def fetch(state):
+    vals = jax.device_get((state.iters, state.tree, state.sol, state.best,
+                           state.size, state.evals, state.overflow))
+    return [int(np.asarray(v).max()) for v in vals[:-1]] + \
+        [bool(np.asarray(vals[-1]).any())]
+
+
+def solve(inst: int, lb: int, budget_s: float) -> dict:
+    p = taillard.processing_times(inst)
+    ub = taillard.optimal_makespan(inst)
+    m, jobs = p.shape
+    tables = batched.make_tables(p)
+    # pre-size: weak-bound classes peak in the tens of millions of live
+    # rows; the floor covers the chunk*jobs scratch margin (row_limit).
+    # TTS_CAPACITY overrides (the round-4 probes measured the 50x5 class
+    # peaking just past the 1<<24 default — one avoidable grow cycle,
+    # each a multi-GB pool fetch through the remote tunnel).
+    capacity = int(os.environ.get("TTS_CAPACITY", "0")) or \
+        max(device.default_capacity(jobs, m), 4 * CHUNK * jobs)
+    state = device.init_state(jobs, capacity, ub, p_times=p)
+    t0 = time.perf_counter()
+    target = 0
+    grows = 0
+    last_hb = t0
+    while True:
+        target += SEG
+        out = device.run(tables, state, lb, CHUNK, max_iters=target)
+        iters, tree, sol, best, size, evals, overflow = fetch(out)
+        now = time.perf_counter()
+        if overflow:
+            capacity *= 2
+            grows += 1
+            print(f"  [grow] capacity -> {capacity} (pool={size})",
+                  flush=True)
+            state = checkpoint.grow(out, capacity)
+            target = iters  # next loop adds SEG on top of where we are
+            continue
+        state = out
+        if now - last_hb > 30 or size == 0:
+            print(f"  [seg] iters={iters} tree={tree} pool={size} "
+                  f"best={best} t={now - t0:.1f}s", flush=True)
+            last_hb = now
+        if size == 0 or now - t0 > budget_s:
+            break
+    elapsed = time.perf_counter() - t0
+    done = size == 0
+    row = {"inst": inst, "jobs": jobs, "machines": m, "lb": lb,
+           "done": done, "elapsed_s": round(elapsed, 2),
+           "tree": tree, "sol": sol, "best": best, "evals": evals,
+           "iters": iters, "capacity": capacity, "grows": grows,
+           "pool_at_stop": size,
+           "pushed_per_s": round(tree / elapsed, 1),
+           "evals_per_s": round(evals / elapsed, 1)}
+    if done:
+        assert best == ub, (inst, best, ub)
+    return row
+
+
+def main():
+    done = set()
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            done = {(json.loads(ln)["inst"], json.loads(ln)["lb"])
+                    for ln in f if ln.strip()}
+    insts = [int(x) for x in sys.argv[1:]]
+    for inst in insts:
+        if (inst, LB) in done:
+            print(f"ta{inst:03d} lb{LB}: already done, skipping",
+                  flush=True)
+            continue
+        print(f"ta{inst:03d} lb{LB}: solving (budget {BUDGET_S:.0f}s)...",
+              flush=True)
+        row = solve(inst, LB, BUDGET_S)
+        with open(OUT, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        tag = "SOLVED" if row["done"] else "partial"
+        print(f"ta{inst:03d} lb{LB}: {tag} t={row['elapsed_s']}s "
+              f"tree={row['tree']} pushed/s={row['pushed_per_s']}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ command lines against the TPU engine:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -35,6 +36,15 @@ def _pfsp_parser(sub):
                         "pops per node; PFSP_lib.c:175-185)")
     p.add_argument("-D", type=int, default=d.D)
     p.add_argument("-C", type=int, default=d.C)
+    p.add_argument("--host-fraction", type=int, default=None,
+                   help="with -C 1: seed the native host tier with every "
+                        "k-th warm-up node (default 8; 0 disables the "
+                        "concurrent tier)")
+    p.add_argument("--host-threads", type=int, default=None,
+                   help="with -C 1: native host worker threads "
+                        "(default: host cores / device count, the "
+                        "reference's num_procs/deviceCount rule, "
+                        "pfsp_multigpu_cuda.c:61-69)")
     p.add_argument("-w", "--ws", type=int, default=d.ws)
     p.add_argument("-L", type=int, default=d.L)
     p.add_argument("-p", "--perc", type=float, default=d.perc)
@@ -111,8 +121,18 @@ def run_pfsp(args) -> int:
     # single-device segmented (_run_pfsp_segmented's host session),
     # multi-device and the segmented/checkpointed flagship
     # (distributed.search host_fraction) — the reference runs CPU
-    # workers beside both its multi-GPU and distributed engines
-    host_fraction = 8 if args.C else 0
+    # workers beside both its multi-GPU and distributed engines.
+    # --host-fraction/--host-threads make the tier a measured knob;
+    # threads default to the reference's num_procs/deviceCount rule
+    # (pfsp_multigpu_cuda.c:61-69).
+    if args.C:
+        host_fraction = (8 if args.host_fraction is None
+                         else max(args.host_fraction, 0))
+        host_threads = (max(1, (os.cpu_count() or 1) // max(n_dev, 1))
+                        if args.host_threads is None
+                        else max(args.host_threads, 1))
+    else:
+        host_fraction, host_threads = 0, 0
     _print_pfsp_settings(args, machines, jobs, n_dev)
 
     t0 = time.perf_counter()
@@ -120,7 +140,8 @@ def run_pfsp(args) -> int:
         if n_dev == 1:
             try:
                 out, extras = _run_pfsp_segmented(args, p, init_ub,
-                                                  host_fraction)
+                                                  host_fraction,
+                                                  host_threads)
             except (RuntimeError, ValueError, OSError) as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
@@ -162,7 +183,8 @@ def run_pfsp(args) -> int:
                     segment_iters=args.segment_iters,
                     checkpoint_path=args.checkpoint, heartbeat=heartbeat,
                     checkpoint_every=getattr(args, "checkpoint_every", 1),
-                    host_fraction=host_fraction)
+                    host_fraction=host_fraction,
+                    host_threads=host_threads)
             except (RuntimeError, ValueError, OSError) as e:
                 print(f"error: {e}", file=sys.stderr)
                 return 1
@@ -184,7 +206,9 @@ def run_pfsp(args) -> int:
             return 2
         res = hybrid.search(p, lb_kind=args.lb, init_ub=init_ub,
                             chunk=args.chunk, capacity=args.capacity,
-                            drain_min=max(args.m, 1))
+                            drain_min=max(args.m, 1),
+                            host_fraction=host_fraction,
+                            host_threads=host_threads)
         tree, sol, best = res.explored_tree, res.explored_sol, res.best
         complete = res.complete
         per_device = {k: list(v) for k, v in res.per_device.items()}
@@ -204,7 +228,8 @@ def run_pfsp(args) -> int:
             min_transfer=(None if (args.ws or args.L) else 2**30),
             min_seed=args.m,
             max_rounds=args.max_iters,
-            host_fraction=host_fraction)
+            host_fraction=host_fraction,
+            host_threads=host_threads)
         tree, sol, best = res.explored_tree, res.explored_sol, res.best
         complete = res.complete
         per_device = {k: list(v) for k, v in res.per_device.items()}
@@ -294,13 +319,23 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
             args.csv, args.inst, args.lb, best, args.m, args.M, elapsed,
             float(att["kernel_time"][0]) if att else elapsed, tree, sol,
             gen_child_time=float(att["gen_child_time"][0]) if att else 0.0)
-    else:
+    elif getattr(args, "multihost", False):
+        # the DCN tier writes the reference's dist_multigpu.csv schema
+        # (PFSP_statistic.c:123-167)
         csv_stats.write_dist(args.csv, args.inst, args.lb, n_dev, args.C,
                              args.L, 1, best, args.m, args.M, args.T,
                              elapsed, tree, sol, per_device)
+    else:
+        # single-controller multi-device runs are the intra-node tier:
+        # the reference's multigpu.csv schema (PFSP_statistic.c:69-112),
+        # which its analysis scripts distinguish from the dist schema
+        csv_stats.write_multi(args.csv, args.inst, args.lb, n_dev, args.C,
+                              args.ws, best, args.m, args.M, args.T,
+                              elapsed, tree, sol, per_device)
 
 
-def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0):
+def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0,
+                        host_threads: int = 0):
     """Segmented single-device search with heartbeat + checkpoint/resume
     (the durability layer the reference lacks, SURVEY.md §5). With
     `host_fraction > 0` a native `-C` host session runs beside the
@@ -343,7 +378,8 @@ def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0):
                     state, host_fraction)
             if len(h_depth):
                 session = hybrid.HostSession(
-                    p, h_prmu, h_depth, args.lb, int(state.best))
+                    p, h_prmu, h_depth, args.lb, int(state.best),
+                    n_threads=host_threads)
         elif len(saved_d):
             state = hybrid.restore_host_share(state, saved_p, saved_d, p)
         print(f"Resumed from {args.checkpoint} "
@@ -361,7 +397,7 @@ def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0):
             fr.prmu, fr.depth, host_fraction)
         if len(h_depth):
             session = hybrid.HostSession(p, h_prmu, h_depth, args.lb,
-                                         best0)
+                                         best0, n_threads=host_threads)
         state = device.init_state(jobs, args.grow_capacity or args.capacity,
                                   best0, prmu0=fr.prmu[dmask],
                                   depth0=fr.depth[dmask], p_times=p)

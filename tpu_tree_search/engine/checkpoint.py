@@ -159,12 +159,31 @@ def aux_dtype_of(path) -> np.dtype:
     with zipfile.ZipFile(path) as zf:
         if "aux.npy" not in zf.namelist():
             return np.dtype(np.int32)
-        with zf.open("aux.npy") as f:
-            version = np.lib.format.read_magic(f)
-            if version == (1, 0):
-                _, _, dtype = np.lib.format.read_array_header_1_0(f)
-            else:
-                _, _, dtype = np.lib.format.read_array_header_2_0(f)
+        try:
+            with zf.open("aux.npy") as f:
+                version = np.lib.format.read_magic(f)
+                if version == (1, 0):
+                    _, _, dtype = np.lib.format.read_array_header_1_0(f)
+                elif version == (2, 0):
+                    _, _, dtype = np.lib.format.read_array_header_2_0(f)
+                else:
+                    # (3, 0) headers (utf8 field names) share the 2.0
+                    # wire format for plain dtypes; parse via numpy's
+                    # version-dispatching reader when present, else the
+                    # 2.0 reader
+                    read = getattr(np.lib.format, "_read_array_header",
+                                   None)
+                    if read is not None:
+                        _, _, dtype = read(f, version)
+                    else:
+                        _, _, dtype = \
+                            np.lib.format.read_array_header_2_0(f)
+        except (ValueError, OSError) as e:
+            # a corrupt/truncated member must surface as a clear resume
+            # error, not an uncaught header-parse exception mid-load
+            raise RuntimeError(
+                f"unreadable aux.npy header in checkpoint {path}: {e}"
+            ) from e
     return np.dtype(dtype)
 
 

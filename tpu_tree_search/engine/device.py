@@ -102,6 +102,14 @@ class SearchState(NamedTuple):
     overflow: jax.Array  # bool: capacity would have been exceeded
 
 
+@functools.partial(jax.jit, donate_argnums=0)
+def _seed_update(buf, rows):
+    """In-place (donated) write of the seed rows into the fresh pool
+    buffer; module-level so the jit cache persists across init_state
+    calls (a per-call wrapper would retrace every instance/segment)."""
+    return jax.lax.dynamic_update_slice(buf, rows, (0,) * buf.ndim)
+
+
 def init_state(jobs: int, capacity: int, init_ub: int | None,
                prmu0: np.ndarray | None = None,
                depth0: np.ndarray | None = None,
@@ -122,11 +130,14 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
     # Allocate the pool ON the device and ship only the seed rows: the
     # host-side np.zeros variant uploaded the full capacity through the
     # runtime (~350 MB at capacity 2^22 for 20x20 — seconds per call on
-    # a remote-TPU tunnel, paid per instance by campaign drivers).
+    # a remote-TPU tunnel, paid per instance by campaign drivers). The
+    # seeding update runs jitted with the zeros buffer DONATED so the
+    # write is in place — eager dynamic_update_slice holds both the
+    # zeros and the result at once, ~2x peak HBM per pool array at init
+    # (enough to OOM capacities that fit once running).
     def seeded(shape, dtype, rows):
-        buf = jnp.zeros(shape, dtype)
-        return jax.lax.dynamic_update_slice(
-            buf, jnp.asarray(rows, dtype), (0,) * buf.ndim)
+        return _seed_update(jnp.zeros(shape, dtype),
+                            jnp.asarray(rows, dtype))
 
     prmu = seeded((jobs, capacity), jnp.int16, prmu0.T)
     depth = seeded((capacity,), jnp.int16, depth0)
@@ -544,7 +555,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # is static. The explored set is identical either way (the final
         # prune uses the same exact LB2 values), matching the
         # reference's single code path (bounds_gpu.cu:252-316).
-        children_d, caux_d, lb2b = pallas_expand.expand(
+        _, caux_d, lb2b = pallas_expand.expand(
             tables, p_prmu, p_depth, p_aux, lb_kind=2, tile=TB)
 
         is_leaf = ((depth_c + 1) == J) & mask
@@ -556,15 +567,19 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         push = (mask & ~is_leaf & (lb2b.reshape(1, -1) < best)).reshape(-1)
         n_push = push.sum(dtype=jnp.int32)
 
-        def take_dense(idx):
-            idx = jax.lax.optimization_barrier(idx)
-            out = (jnp.take(children_d, idx, axis=1),
-                   jnp.take(caux_d, idx, axis=1))
-            return jax.lax.optimization_barrier(out)
-
+        # Compaction rebuilds survivors from the CHUNK-WIDE parents
+        # (_compact_from_parents) rather than gathering the dense
+        # (rows, N) child blocks the kernel materialized: at the wide
+        # classes this route serves (50x5: N = 1.64M at chunk 32768)
+        # the dense frame sits far past the v5e source-width gather
+        # cliff (tools/bench_gather.py), while the parent sources stay
+        # 32k wide. The kernel's dense caux is still consumed by the
+        # pair sweep above; only its children output is dead (cheap
+        # relative to the cliff-priced gathers it replaces).
         perm = _partition(push)
-        children, child_aux = _tiered_compact(take_dense, perm, n_push,
-                                              N, two_phase=True)
+        children, child_aux = _compact_from_parents(
+            tables, p_prmu, p_depth, p_aux, perm, n_push, TB, N,
+            two_phase=True)
         child_depth = child_aux[M].astype(jnp.int16)
     elif route == "prefilter":
         # Two-phase LB2 (TPU): bound every child with the near-free LB1

@@ -515,6 +515,17 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
+    `balance_period=4` is a MEASURED default (round 4): on real TPU
+    hardware the cond-gated balance round is free — the full SPMD
+    program costs 6.40 ms/iter at period 4 vs 6.64 at period 1 and
+    6.53 at period 16 on identical ta021 state
+    (tools/bench_balance_period.py, ±2% noise) — so the period is
+    chosen for SPREAD, where the CPU-mesh sensitivity table
+    (BENCHMARKS.md) shows per-worker tree CV 0.16 at period 4 vs 0.20
+    at period 16. The CPU mesh's wall-clock preference for sparse
+    periods is an artifact of host-serialized collectives; do not
+    retune this knob on the virtual mesh.
+
     With `segment_iters`/`checkpoint_path` the loop runs in bounded
     segments with heartbeat + checkpoint/resume between them — the
     distributed durability layer the reference lacks entirely (its only

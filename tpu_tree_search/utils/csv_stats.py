@@ -17,6 +17,16 @@ Semantic mapping of per-PU columns to the TPU engine:
   compaction (the regather step IS the reference's generate_children),
   `time_load_bal` = measured balance exchanges, `gpu_idle_time` = the
   remainder, so the columns sum to ~total;
+- `gpu_kernel_time` SEMANTICS: the column brackets pop + mask + dense
+  bound evaluation — mirroring the reference's kernel timer, which
+  wraps the whole evaluate_gpu region including copies and launch
+  (PFSP_statistic.c vs PFSP_gpu_lib.cu:129-152) — NOT the bound op
+  alone. For LB2 the dense sweeps dominate the bracket so the column
+  ~equals op-level kernel time (validated to ~3% against profiler
+  traces); for LB1 the bound op is a small part of its bracket, so
+  the column reads ~2.4x the op-level trace share BY DEFINITION
+  (tools/validate_attribution.py reports both semantics with error
+  bars — the bracket-vs-bracket error is the attribution's accuracy);
 - memcpy/malloc columns are structurally zero — those phases genuinely
   do not exist here (HBM-resident pool, static allocation), which is
   the honest datum; headers are retained so existing analysis parses
