@@ -95,7 +95,6 @@ def main():
                      "ms_per_iter": round(ms, 4)})
         print(json.dumps(rows[-1]), flush=True)
 
-    base = rows[0]["ms_per_iter"] if rows else 0
     print(json.dumps({"inst": args.inst, "lb": lb, "chunk": chunk,
                       "window_iters": args.iters,
                       "rows": rows,

@@ -555,7 +555,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # is static. The explored set is identical either way (the final
         # prune uses the same exact LB2 values), matching the
         # reference's single code path (bounds_gpu.cu:252-316).
-        _, caux_d, lb2b = pallas_expand.expand(
+        _, _, lb2b = pallas_expand.expand(
             tables, p_prmu, p_depth, p_aux, lb_kind=2, tile=TB)
 
         is_leaf = ((depth_c + 1) == J) & mask
@@ -573,9 +573,11 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # classes this route serves (50x5: N = 1.64M at chunk 32768)
         # the dense frame sits far past the v5e source-width gather
         # cliff (tools/bench_gather.py), while the parent sources stay
-        # 32k wide. The kernel's dense caux is still consumed by the
-        # pair sweep above; only its children output is dead (cheap
-        # relative to the cliff-priced gathers it replaces).
+        # 32k wide. The expand kernel's children/aux outputs are dead
+        # here (lb2 sweeps run on the kernel's internal fronts) — their
+        # materialization is cheap relative to the cliff-priced dense
+        # gathers this replaces (measured: ta033 1.21M -> 1.65M
+        # pushed/s).
         perm = _partition(push)
         children, child_aux = _compact_from_parents(
             tables, p_prmu, p_depth, p_aux, perm, n_push, TB, N,
