@@ -1,5 +1,6 @@
 """Campaign driver: solve Taillard instances end-to-end on one chip,
-with a per-instance wall budget and partial-progress reporting.
+with a per-instance wall budget, partial-progress reporting, and
+AUTOMATIC STALL RECOVERY.
 
 Generalizes tools/run_single_device_table.py (VERDICT r3 #7, the 20x20
 table) to the reference's wider campaign groups (VERDICT r4 #1): the
@@ -7,146 +8,342 @@ table) to the reference's wider campaign groups (VERDICT r4 #1): the
 (/root/reference/pfsp/launch_scripts/mgpu_launch.sh:51-58 — ta031-ta050
 and ta052/53/56/57/58) and any other instance list, at either bound.
 
-Per instance: solve to the PROVEN optimum (ub=opt, pool drained) within
-the budget, else stop at the budget and record the partial row — tree
-so far, sustained pushed-nodes/s and eval rate — so infeasible
-instances get a measured rate + extrapolation instead of silence.
-Overflow grows the pool losslessly (checkpoint.grow) and continues.
+Architecture (VERDICT r4 #8): each instance runs in a WORKER SUBPROCESS
+that heartbeats a JSON status line per segment and checkpoints every
+--checkpoint-every segments; the supervisor in this process watches the
+heartbeat age and, when it exceeds ~4x the recent segment pace (a hung
+device dispatch — the ~600 s tunnel stalls BENCHMARKS.md documents), kills
+the worker's process group and respawns it resuming from the last
+checkpoint. Search determinism (fixed chunk, DFS order) makes the
+redo-from-checkpoint lossless: final counters are bit-identical to an
+unkilled run (tests/test_dist_durability.py::test_supervisor_stall_resume).
+The reference's only stall tooling is a 10 s "Still Idle" print
+(pfsp_dist_multigpu_cuda.c:663-668) — it never recovers.
+
+Per instance: solve to the PROVEN optimum (ub=opt by default, pool
+drained) within the budget, else stop at the budget and record the
+partial row — tree so far, sustained pushed-nodes/s and eval rate — so
+infeasible instances get a measured rate + extrapolation instead of
+silence. Overflow grows the pool losslessly (checkpoint.grow) and
+continues.
 
     TTS_BUDGET_S=7200 nohup python -u tools/run_campaign.py 31 32 ... \
         > /tmp/campaign.log 2>&1 &
 
 Env: TTS_BUDGET_S (default 7200), TTS_LB (default 2), TTS_CHUNK
-(default 32768), TTS_CAMPAIGN_OUT (default /tmp/campaign.jsonl).
+(default 32768), TTS_CAMPAIGN_OUT (default /tmp/campaign.jsonl),
+TTS_WORKDIR (status/checkpoint files, default /tmp), TTS_SEG (default
+2000 iters/segment), TTS_CKPT_EVERY (segments between checkpoints,
+default 8), TTS_UB ("opt" | "inf", default opt), TTS_STALL_GRACE
+(seconds before the first heartbeat may be declared dead, default 900 —
+covers a cold 50x20 compile), TTS_MAX_RESTARTS (default 50).
+Test hooks (worker side): TTS_TEST_STALL_AT_SEG=N — after writing
+segment N's heartbeat, hang forever (simulates a dead tunnel dispatch).
 """
 
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import numpy as np  # noqa: E402
-
-from tpu_tree_search.utils import compile_cache  # noqa: E402
-
-compile_cache.enable()
-
-import jax  # noqa: E402
-
-from tpu_tree_search.engine import checkpoint, device  # noqa: E402
-from tpu_tree_search.ops import batched  # noqa: E402
-from tpu_tree_search.problems import taillard  # noqa: E402
-
 OUT = os.environ.get("TTS_CAMPAIGN_OUT", "/tmp/campaign.jsonl")
+WORKDIR = os.environ.get("TTS_WORKDIR", "/tmp")
 LB = int(os.environ.get("TTS_LB", "2"))
 CHUNK = int(os.environ.get("TTS_CHUNK", "32768"))
 BUDGET_S = float(os.environ.get("TTS_BUDGET_S", "7200"))
 SEG = int(os.environ.get("TTS_SEG", "2000"))
+CKPT_EVERY = int(os.environ.get("TTS_CKPT_EVERY", "8"))
+UB_MODE = os.environ.get("TTS_UB", "opt")
+STALL_GRACE = float(os.environ.get("TTS_STALL_GRACE", "900"))
+STALL_FACTOR = float(os.environ.get("TTS_STALL_FACTOR", "4"))
+STALL_MIN = float(os.environ.get("TTS_STALL_MIN", "120"))
+MAX_RESTARTS = int(os.environ.get("TTS_MAX_RESTARTS", "50"))
 
 
-def fetch(state):
-    vals = jax.device_get((state.iters, state.tree, state.sol, state.best,
-                           state.size, state.evals, state.overflow))
-    return [int(np.asarray(v).max()) for v in vals[:-1]] + \
-        [bool(np.asarray(vals[-1]).any())]
+def paths(inst: int, lb: int):
+    base = os.path.join(WORKDIR, f"tts_ta{inst:03d}_lb{lb}")
+    return base + ".status.jsonl", base + ".ckpt.npz"
 
 
-def solve(inst: int, lb: int, budget_s: float) -> dict:
+# ----------------------------------------------------------------- worker
+
+def worker_main(inst: int) -> None:
+    """Solve one instance via checkpoint.run_segmented (THE segmented
+    driver — this function only adds the status-file heartbeat, the wall
+    budget, and overflow growth), heartbeating + checkpointing.
+
+    Resumes from the checkpoint file if it exists (the pool arrays AND
+    every counter live in the SearchState the checkpoint stores, so the
+    resumed run continues the exact count sequence)."""
+    from tpu_tree_search.utils import compile_cache
+
+    compile_cache.enable()
+
+    import numpy as np
+
+    import jax
+
+    from tpu_tree_search.engine import checkpoint, device
+    from tpu_tree_search.ops import batched
+    from tpu_tree_search.problems import taillard
+
+    lb = LB
+    status_path, ckpt_path = paths(inst, lb)
+    stall_at = int(os.environ.get("TTS_TEST_STALL_AT_SEG", "0"))
+
+    def emit(rec: dict) -> None:
+        rec["t"] = time.time()
+        with open(status_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
     p = taillard.processing_times(inst)
-    ub = taillard.optimal_makespan(inst)
+    ub = taillard.optimal_makespan(inst) if UB_MODE == "opt" else None
     m, jobs = p.shape
     tables = batched.make_tables(p)
-    # pre-size: weak-bound classes peak in the tens of millions of live
-    # rows; the floor covers the chunk*jobs scratch margin (row_limit).
-    # TTS_CAPACITY overrides (the round-4 probes measured the 50x5 class
-    # peaking just past the 1<<24 default — one avoidable grow cycle,
-    # each a multi-GB pool fetch through the remote tunnel).
     capacity = int(os.environ.get("TTS_CAPACITY", "0")) or \
         max(device.default_capacity(jobs, m), 4 * CHUNK * jobs)
-    state = device.init_state(jobs, capacity, ub, p_times=p)
-    t0 = time.perf_counter()
-    target = 0
     grows = 0
-    last_hb = t0
-    while True:
-        target += SEG
-        out = device.run(tables, state, lb, CHUNK, max_iters=target)
-        iters, tree, sol, best, size, evals, overflow = fetch(out)
-        now = time.perf_counter()
-        if overflow:
+    spent_before = 0.0
+    if os.path.exists(ckpt_path):
+        state, meta = checkpoint.load(ckpt_path, p_times=p)
+        capacity = state.prmu.shape[-1]
+        grows = int(meta.get("grows", 0))
+        spent_before = float(meta.get("spent_s", 0.0))
+        if bool(np.asarray(state.overflow).any()):
+            # killed right after an overflow checkpoint: grow NOW or the
+            # resumed loop would exit immediately forever
             capacity *= 2
             grows += 1
-            print(f"  [grow] capacity -> {capacity} (pool={size})",
-                  flush=True)
-            state = checkpoint.grow(out, capacity)
-            target = iters  # next loop adds SEG on top of where we are
-            continue
-        state = out
-        if now - last_hb > 30 or size == 0:
-            print(f"  [seg] iters={iters} tree={tree} pool={size} "
-                  f"best={best} t={now - t0:.1f}s", flush=True)
-            last_hb = now
-        if size == 0 or now - t0 > budget_s:
+            state = checkpoint.grow(state, capacity)
+            emit({"kind": "grow", "capacity": capacity})
+        emit({"kind": "resume", "iters": int(np.asarray(state.iters)),
+              "capacity": capacity, "spent_s": spent_before})
+    else:
+        state = device.init_state(jobs, capacity, ub, p_times=p)
+
+    t0 = time.perf_counter()
+
+    def spent_now(elapsed: float) -> float:
+        return spent_before + elapsed
+
+    def hb(rep):
+        emit({"kind": "seg", "seg": rep.segment, "iters": rep.iters,
+              "tree": rep.tree, "sol": rep.sol, "best": rep.best,
+              "size": rep.pool_size, "capacity": capacity,
+              "spent_s": round(spent_now(rep.elapsed), 2)})
+        if rep.segment % CKPT_EVERY == 0:
+            # run_segmented saves right after this callback; the marker
+            # tells the supervisor to allow a long heartbeat gap for the
+            # save (a multi-hundred-MB pool fetch through the tunnel)
+            emit({"kind": "ckpt_start", "seg": rep.segment})
+        if stall_at and rep.segment >= stall_at:
+            emit({"kind": "test_stall", "seg": rep.segment})
+            time.sleep(10 ** 6)  # simulated dead dispatch (test hook)
+
+    def run_fn(s, target):
+        return device.run(tables, s, lb, CHUNK, max_iters=target)
+
+    while True:
+        def mk_meta():
+            return {"inst": inst, "lb": lb, "chunk": CHUNK, "grows": grows,
+                    "spent_s": round(
+                        spent_now(time.perf_counter() - t0), 2)}
+
+        try:
+            state = checkpoint.run_segmented(
+                run_fn, state, segment_iters=SEG,
+                checkpoint_path=ckpt_path, checkpoint_every=CKPT_EVERY,
+                heartbeat=hb, checkpoint_meta=mk_meta,
+                should_stop=lambda rep: spent_now(rep.elapsed) > BUDGET_S)
             break
-    elapsed = time.perf_counter() - t0
+        except checkpoint.PoolOverflow as e:
+            capacity *= 2
+            grows += 1
+            emit({"kind": "grow", "capacity": capacity})
+            state = checkpoint.grow(e.state, capacity)
+
+    fetched = jax.device_get((state.iters, state.tree, state.sol,
+                              state.best, state.size, state.evals))
+    iters, tree, sol, best, size, evals = (int(np.asarray(v).max())
+                                           for v in fetched)
+    spent = spent_now(time.perf_counter() - t0)
     done = size == 0
     row = {"inst": inst, "jobs": jobs, "machines": m, "lb": lb,
-           "done": done, "elapsed_s": round(elapsed, 2),
-           "tree": tree, "sol": sol, "best": best, "evals": evals,
-           "iters": iters, "capacity": capacity, "grows": grows,
-           "pool_at_stop": size,
-           "pushed_per_s": round(tree / elapsed, 1),
-           "evals_per_s": round(evals / elapsed, 1)}
-    if done:
-        assert best == ub, (inst, best, ub)
-    return row
+           "chunk": CHUNK, "budget_s": BUDGET_S, "ub_mode": UB_MODE,
+           "done": done, "elapsed_s": round(spent, 2), "tree": tree,
+           "sol": sol, "best": best, "evals": evals, "iters": iters,
+           "capacity": capacity, "grows": grows, "pool_at_stop": size,
+           "pushed_per_s": round(tree / max(spent, 1e-9), 1),
+           "evals_per_s": round(evals / max(spent, 1e-9), 1)}
+    if done and UB_MODE == "opt" and best != ub:
+        # a WRONG ANSWER is never a transient — the supervisor must
+        # abort the campaign loudly, not retry/skip
+        emit({"kind": "fatal",
+              "reason": f"wrong answer: best={best} != optimum {ub}",
+              **row})
+        sys.exit(3)
+    emit({"kind": "done", **row})
+
+
+# ------------------------------------------------------------- supervisor
+
+def read_status(path: str) -> list[dict]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if ln:
+                try:
+                    out.append(json.loads(ln))
+                except json.JSONDecodeError:
+                    pass  # torn write from a killed worker
+    return out
+
+
+def stall_timeout(fresh: list[dict]) -> float:
+    """Adaptive heartbeat timeout: ~STALL_FACTOR x the slowest recent
+    inter-heartbeat gap (checkpoint segments are legitimately slower —
+    a multi-hundred-MB pool fetch through the tunnel), floored at
+    STALL_MIN. Gaps are measured within the CURRENT worker run only —
+    a gap spanning a previous kill+respawn would inflate the estimate
+    by the very stall it recovered from. Before any gap is measurable,
+    STALL_GRACE (cold compile)."""
+    ts = [r["t"] for r in fresh[-12:]]
+    gaps = [b - a for a, b in zip(ts, ts[1:]) if b > a]
+    if not gaps:
+        return STALL_GRACE
+    return max(STALL_MIN, STALL_FACTOR * max(gaps))
+
+
+def supervise(inst: int, lb: int) -> dict | None:
+    """Run the worker for one instance, restarting it (resume from the
+    last checkpoint) whenever its heartbeat goes dead. Returns the final
+    row, or None if the instance failed MAX_RESTARTS times."""
+    status_path, ckpt_path = paths(inst, lb)
+    if os.path.exists(status_path):
+        os.unlink(status_path)
+    # a stale checkpoint from a previous campaign would silently skip
+    # work measured under different settings
+    if os.path.exists(ckpt_path):
+        os.unlink(ckpt_path)
+
+    restarts = 0
+    iters_at_spawn = -1
+    dead_without_progress = 0
+    while True:
+        n_before = len(read_status(status_path))
+        proc = subprocess.Popen(
+            [sys.executable, "-u", os.path.abspath(__file__),
+             "--worker", str(inst)],
+            start_new_session=True)
+        spawn_t = time.time()
+        outcome = None      # "done" | "exit" | "stall"
+        while True:
+            time.sleep(1.0)
+            recs = read_status(status_path)
+            fresh = recs[n_before:]
+            for r in fresh:
+                if r.get("kind") == "done":
+                    outcome = "done"
+                    row = r
+                    break
+            if outcome == "done":
+                break
+            rc = proc.poll()
+            if rc is not None:
+                outcome = "exit"
+                break
+            last_t = fresh[-1]["t"] if fresh else spawn_t
+            timeout = stall_timeout(fresh)
+            if fresh and fresh[-1].get("kind") == "ckpt_start":
+                # a checkpoint save is in flight — legitimately minutes
+                # through the tunnel; don't kill it on the segment pace
+                timeout = max(timeout, STALL_GRACE)
+            if time.time() - last_t > timeout:
+                outcome = "stall"
+                break
+        if outcome == "done":
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait()
+            row.pop("kind", None)
+            row.pop("t", None)
+            row["restarts"] = restarts
+            return row
+        # dead or hung: kill the whole process group and resume
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        proc.wait()
+        recs = read_status(status_path)
+        for r in recs[n_before:]:
+            if r.get("kind") == "fatal":
+                # a wrong answer is never a transient — abort the whole
+                # campaign loudly rather than retry or skip
+                raise RuntimeError(
+                    f"ta{inst:03d} lb{lb}: {r.get('reason', 'fatal')}")
+        iters_now = max((r.get("iters", 0) for r in recs), default=0)
+        if iters_now <= iters_at_spawn:
+            dead_without_progress += 1
+        else:
+            dead_without_progress = 0
+        iters_at_spawn = iters_now
+        restarts += 1
+        print(f"ta{inst:03d} lb{lb}: worker {outcome} "
+              f"(restart {restarts}, iters={iters_now}); resuming from "
+              f"checkpoint", flush=True)
+        if restarts >= MAX_RESTARTS or dead_without_progress >= 3:
+            print(f"ta{inst:03d} lb{lb}: giving up after {restarts} "
+                  f"restarts ({dead_without_progress} without progress)",
+                  flush=True)
+            return None
+        time.sleep(min(30, 5 * dead_without_progress + 2))
 
 
 def main():
-    done = set()
+    done = {}
     if os.path.exists(OUT):
         with open(OUT) as f:
-            done = {(json.loads(ln)["inst"], json.loads(ln)["lb"])
-                    for ln in f if ln.strip()}
+            for ln in f:
+                if ln.strip():
+                    r = json.loads(ln)
+                    # rows from before the chunk field default to the
+                    # current CHUNK (they predate configurable rechecks)
+                    done[(r["inst"], r["lb"], r.get("chunk", CHUNK))] = r
     insts = [int(x) for x in sys.argv[1:]]
     for inst in insts:
-        if (inst, LB) in done:
-            print(f"ta{inst:03d} lb{LB}: already done, skipping",
+        if (inst, LB, CHUNK) in done:
+            r = done[(inst, LB, CHUNK)]
+            print(f"ta{inst:03d} lb{LB}: already done "
+                  f"(chunk={r.get('chunk', CHUNK)} "
+                  f"budget={r.get('budget_s', '?')} "
+                  f"t={r['elapsed_s']}s tree={r['tree']}), skipping",
                   flush=True)
             continue
         print(f"ta{inst:03d} lb{LB}: solving (budget {BUDGET_S:.0f}s)...",
               flush=True)
-        try:
-            row = solve(inst, LB, BUDGET_S)
-        except AssertionError:
-            # solve()'s best==optimum check: a WRONG ANSWER is never a
-            # transient — abort the campaign loudly
-            raise
-        except Exception as e:
-            # the remote tunnel occasionally drops a compile/execute
-            # mid-flight (BENCHMARKS.md documents the stall/crash
-            # classes); one fresh attempt, then move on so one bad
-            # instance cannot eat the campaign
-            print(f"ta{inst:03d} lb{LB}: attempt failed ({e}); "
-                  "retrying once", flush=True)
-            time.sleep(30)
-            try:
-                row = solve(inst, LB, BUDGET_S)
-            except AssertionError:
-                raise
-            except Exception as e2:
-                print(f"ta{inst:03d} lb{LB}: FAILED twice ({e2}); "
-                      "skipping", flush=True)
-                continue
+        row = supervise(inst, LB)
+        if row is None:
+            continue
         with open(OUT, "a") as f:
             f.write(json.dumps(row) + "\n")
         tag = "SOLVED" if row["done"] else "partial"
         print(f"ta{inst:03d} lb{LB}: {tag} t={row['elapsed_s']}s "
-              f"tree={row['tree']} pushed/s={row['pushed_per_s']}",
-              flush=True)
+              f"tree={row['tree']} pushed/s={row['pushed_per_s']} "
+              f"restarts={row['restarts']}", flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        worker_main(int(sys.argv[2]))
+    else:
+        main()

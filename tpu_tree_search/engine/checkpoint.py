@@ -241,7 +241,8 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   stall_limit: int = 3,
                   raise_on_overflow: bool = True,
                   checkpoint_meta: dict | None = None,
-                  post_segment=None):
+                  post_segment=None,
+                  should_stop=None):
     """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
     bounded segments.
 
@@ -256,6 +257,10 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
       heartbeat/checkpoint, so cross-tier effects (the `-C` host
       session's incumbent merge) land in both (engine/hybrid.HostSession);
     - calls `heartbeat(SegmentReport)` after each segment;
+    - stops early (after checkpointing) when `should_stop(SegmentReport)`
+      returns True — the wall-budget hook for campaign drivers;
+    - `checkpoint_meta` may be a CALLABLE returning the meta dict, re-
+      evaluated at every save (live values like cumulative wall time);
     - raises RuntimeError after `stall_limit` consecutive segments with no
       progress (tree/sol/iters all unchanged) — a compiled-loop stall is a
       bug, not a state, so fail loudly rather than spin (the reference's
@@ -270,7 +275,11 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     stalls = 0
     start_iters = int(_to_np(state.iters).max())
     last = (start_iters, -1, -1)
-    meta_base = dict(checkpoint_meta or {})
+
+    def meta_now(seg):
+        base = checkpoint_meta() if callable(checkpoint_meta) \
+            else dict(checkpoint_meta or {})
+        return {**base, "segment": seg}
 
     def final_save(s, seg):
         # every exit path must leave a CURRENT checkpoint — with
@@ -278,7 +287,7 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         # up to checkpoint_every-1 segments stale and a planned
         # stop-then-resume silently redoes that work
         if checkpoint_path and seg % checkpoint_every != 0:
-            save(checkpoint_path, s, meta={**meta_base, "segment": seg})
+            save(checkpoint_path, s, meta=meta_now(seg))
 
     while True:
         target = start_iters + (seg + 1) * segment_iters
@@ -301,18 +310,19 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         tree = int(f_tree.sum())
         sol = int(f_sol.sum())
         size = int(sizes.sum())
+        per_worker = None
+        if sizes.ndim:                          # stacked distributed state
+            per_worker = {"size": sizes.tolist(),
+                          "steals": f_steals.tolist(),
+                          "best": f_best.tolist()}
+        report = SegmentReport(
+            segment=seg, iters=iters, tree=tree, sol=sol,
+            best=int(f_best.min()), pool_size=size,
+            elapsed=time.perf_counter() - t0, per_worker=per_worker)
         if heartbeat is not None:
-            per_worker = None
-            if sizes.ndim:                      # stacked distributed state
-                per_worker = {"size": sizes.tolist(),
-                              "steals": f_steals.tolist(),
-                              "best": f_best.tolist()}
-            heartbeat(SegmentReport(
-                segment=seg, iters=iters, tree=tree, sol=sol,
-                best=int(f_best.min()), pool_size=size,
-                elapsed=time.perf_counter() - t0, per_worker=per_worker))
+            heartbeat(report)
         if checkpoint_path and seg % checkpoint_every == 0:
-            save(checkpoint_path, state, meta={**meta_base, "segment": seg})
+            save(checkpoint_path, state, meta=meta_now(seg))
         if bool(f_ovf.any()):
             final_save(state, seg)
             if raise_on_overflow:
@@ -325,6 +335,9 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                     f"incomplete; {hint}", state)
             return state
         if size == 0:
+            final_save(state, seg)
+            return state
+        if should_stop is not None and should_stop(report):
             final_save(state, seg)
             return state
         if (iters, tree, sol) == last:

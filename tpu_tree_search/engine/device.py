@@ -638,6 +638,16 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                      if 0 < t < frame
                      and (xla_sweep
                           or pallas_expand.lb2_tile(J, PT, t) > 0)]
+            if not xla_sweep and pallas_expand.lb2_tile(J, PT, frame) == 0:
+                # the frame rung is appended unconditionally (it must
+                # cover every count), but if it misses the tile rule
+                # lb2_bounds takes its XLA fallback there — on the
+                # WIDEST (most expensive) rung. Loud, not silent.
+                import warnings
+                warnings.warn(
+                    f"lb2 sweep frame rung {frame} (J={J}, P={PT}) fails "
+                    "the pallas tile rule; the widest sweep tier will run "
+                    "the XLA scan fallback", stacklevel=2)
             tiers.append(frame)
 
             def prefix(width):
