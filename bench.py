@@ -64,9 +64,12 @@ def bench_one(tables, p, ub, lb_kind: int, chunk: int, iters: int,
 
 def main():
     inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
-    # 32768 parents/step measured best on v5e (25% over 8192: the
-    # remaining per-step costs amortize over more lanes; 65536 regresses)
-    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "32768"))
+    # 65536 parents/step measured best on v5e after the bf16 act matmul
+    # made the pair sweeps ~4x cheaper (r5: 73.5M vs 67.8M at 32768 —
+    # the r2-r4 optimum; per-step fixed costs now dominate, so wider
+    # amortizes further; 81920/98304/131072 regress — the pow2 chunk
+    # keeps every ladder rung lane-aligned)
+    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "65536"))
     # long window: a single dispatch through the runtime costs O(100 ms)
     # host-side; the compiled loop itself is ~0.6 ms/iteration, so short
     # windows under-report the sustained rate real runs see

@@ -131,10 +131,13 @@ def worker_main(inst: int) -> None:
         return spent_before + elapsed
 
     def hb(rep):
+        # the worker clock (t0), NOT rep.elapsed: run_segmented restarts
+        # its elapsed at every overflow-grow re-entry, which would reset
+        # the wall budget after each grow
         emit({"kind": "seg", "seg": rep.segment, "iters": rep.iters,
               "tree": rep.tree, "sol": rep.sol, "best": rep.best,
               "size": rep.pool_size, "capacity": capacity,
-              "spent_s": round(spent_now(rep.elapsed), 2)})
+              "spent_s": round(spent_now(time.perf_counter() - t0), 2)})
         if rep.segment % CKPT_EVERY == 0:
             # run_segmented saves right after this callback; the marker
             # tells the supervisor to allow a long heartbeat gap for the
@@ -158,7 +161,8 @@ def worker_main(inst: int) -> None:
                 run_fn, state, segment_iters=SEG,
                 checkpoint_path=ckpt_path, checkpoint_every=CKPT_EVERY,
                 heartbeat=hb, checkpoint_meta=mk_meta,
-                should_stop=lambda rep: spent_now(rep.elapsed) > BUDGET_S)
+                should_stop=lambda rep: spent_now(
+                    time.perf_counter() - t0) > BUDGET_S)
             break
         except checkpoint.PoolOverflow as e:
             capacity *= 2
@@ -228,10 +232,24 @@ def supervise(inst: int, lb: int) -> dict | None:
     status_path, ckpt_path = paths(inst, lb)
     if os.path.exists(status_path):
         os.unlink(status_path)
-    # a stale checkpoint from a previous campaign would silently skip
-    # work measured under different settings
+    # A checkpoint from a DIFFERENT configuration would silently resume
+    # work measured under other settings — but one matching the current
+    # (inst, lb, chunk) is durable in-flight progress from a killed
+    # campaign supervisor and must be resumed, not discarded.
     if os.path.exists(ckpt_path):
-        os.unlink(ckpt_path)
+        import numpy as np
+        try:
+            with np.load(ckpt_path) as z:
+                match = (int(z["meta_inst"]) == inst
+                         and int(z["meta_lb"]) == lb
+                         and int(z["meta_chunk"]) == CHUNK)
+        except (KeyError, OSError, ValueError):
+            match = False
+        if match:
+            print(f"ta{inst:03d} lb{lb}: resuming from existing "
+                  f"checkpoint {ckpt_path}", flush=True)
+        else:
+            os.unlink(ckpt_path)
 
     restarts = 0
     iters_at_spawn = -1

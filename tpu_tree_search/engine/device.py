@@ -790,15 +790,26 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
         # N/4 cap: ncand hovers just under it on the 20x20 class
         # (~0.93 N/4 steady state; ~7% of iterations exceed it and take
-        # the safe branch). A 5N/16 cap was measured very slightly
+        # a wider branch). A 5N/16 cap was measured very slightly
         # WORSE (47.4M vs 47.9M): widening every steady-branch frame
-        # costs more than the rare safe branch saves.
+        # costs more than the rare safe branch saves. Instead the
+        # overflow iterations get a MIDDLE 3N/8 frame (a lax.switch
+        # rung): they ran the full-N pipeline at ~2x the steady cost,
+        # and nearly all of them fit 3N/8 — the steady branch stays
+        # untouched (measured on ta021: 48.7 -> 51.0M evals/s).
         W = max(N // 4, 128)
+        W2 = 3 * N // 8
         if W >= N:  # toy shapes: no narrow branch exists
             prmu, depth, aux, n_push, hsum, tsum = tail_pipeline(N)(0)
-        else:
+        elif W2 <= W or W2 >= N or W2 % 128 != 0:
             prmu, depth, aux, n_push, hsum, tsum = jax.lax.cond(
                 ncand <= W, tail_pipeline(W), tail_pipeline(N), 0)
+        else:
+            sel = ((ncand > W).astype(jnp.int32)
+                   + (ncand > W2).astype(jnp.int32))
+            prmu, depth, aux, n_push, hsum, tsum = jax.lax.switch(
+                sel, [tail_pipeline(W), tail_pipeline(W2),
+                      tail_pipeline(N)], 0)
 
         if debug_tap:
             state = state._replace(sent=hsum, recv=tsum,

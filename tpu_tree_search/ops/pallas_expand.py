@@ -327,16 +327,16 @@ def lb2_tile(jobs: int, pairs: int, width: int) -> int:
 
 
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
-    """The pair-sweep kernel keeps its (J, P, J) f32 per-step job one-hot
-    resident in VMEM; past ~4 MB it cannot share VMEM with the column
-    tiles. Jobs are additionally capped at 64: mosaic's scoped-VMEM
-    stack behavior changes qualitatively past the validated classes
-    (measured: J=100/P=24/NT=512 allocates 24.8 MB where the J<=50
-    model predicts 2.3 MB — the J-step unrolled temporaries stop being
-    reused). Classes outside either cap take the XLA bitmask path
+    """The pair-sweep kernel keeps its (J, P, J) bf16 per-step job
+    one-hot resident in VMEM; past ~4 MB it cannot share VMEM with the
+    column tiles. Jobs are additionally capped at 64: mosaic's
+    scoped-VMEM stack behavior changes qualitatively past the validated
+    classes (measured: J=100/P=24/NT=512 allocates 24.8 MB where the
+    J<=50 model predicts 2.3 MB — the J-step unrolled temporaries stop
+    being reused). Classes outside either cap take the XLA bitmask path
     (lb2_cols, a lax.scan), which the two-phase route still runs only
     over survivor tiers."""
-    return jobs <= 64 and jobs * pairs * jobs * 4 <= LB2_ONEHOT_VMEM
+    return jobs <= 64 and jobs * pairs * jobs * 2 <= LB2_ONEHOT_VMEM
 
 
 def expand_bounds(tables: BoundTables, prmu_T, depth2, front_T,
@@ -435,13 +435,13 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
     and the per-step active test are one-hot matmuls on the MXU (dynamic
     row indexing inside mosaic is either unsupported or serializes).
 
-    cf_ref (M, NT) child fronts; unsched_ref (J, NT) f32 0/1 per job;
+    cf_ref (M, NT) child fronts; unsched_ref (J, NT) bf16 0/1 per job;
     tables: sel0/sel1 (P, M) f32 pair-machine one-hots, js1h (J, P, J)
-    f32 per-step job one-hots, pt0/pt1/lag (P, J) f32, tails (P, 1) f32.
-    Output bounds (1, NT) i32.
+    bf16 per-step job one-hots, pt0/pt1/lag (P, J) f32, tails (P, 1)
+    f32. Output bounds (1, NT) i32.
     """
     cf_f = cf_ref[:].astype(jnp.float32)            # (M, NT)
-    unsched = unsched_ref[:]                        # (J, NT) f32
+    unsched = unsched_ref[:]                        # (J, NT) bf16
     hi = jax.lax.Precision.HIGHEST
     lb = None
     # All values are small non-negative integers (completion times
@@ -450,6 +450,13 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
     # compare+select: t0 update is one fma (act is exactly 0/1 from the
     # one-hot matmul), and the t1 select is max(t1, act*cand) — valid
     # because cand >= t1 whenever act == 1 and everything is >= 0.
+    #
+    # The ACT matmul runs in bf16: both operands are exactly-
+    # representable 0/1 one-hots and the J-wide dot accumulates to at
+    # most J <= 64 in f32 — bit-exact, and the MXU takes one pass where
+    # an f32 HIGHEST dot decomposes into several. The VALUE matmuls
+    # (sel @ cf: completion times in the thousands, > bf16's 256-exact
+    # integer range) stay f32/HIGHEST.
     for lo in range(0, P, PB):
         nrows = min(PB, P - lo)
         sl = slice(lo, lo + nrows)
@@ -458,7 +465,7 @@ def _lb2_kernel(J: int, M: int, P: int, PB: int,
         t1 = jnp.dot(sel1_ref[sl, :], cf_f, precision=hi,
                      preferred_element_type=jnp.float32)
         for j in range(J):
-            act = jnp.dot(js1h_ref[j, sl, :], unsched, precision=hi,
+            act = jnp.dot(js1h_ref[j, sl, :], unsched,
                           preferred_element_type=jnp.float32)
             t0 = t0 + act * pt0_ref[sl, j:j + 1]
             cand = jnp.maximum(t1, t0 + lag_ref[sl, j:j + 1]) \
@@ -495,7 +502,7 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
     word = (sched_mask if sched_mask.shape[0] == 1
             else jnp.take(sched_mask, vj // 32, axis=0))       # (J|1, N)
     unsched = (((word >> (vj % 32)[:, None]) & jnp.int32(1)) == 0) \
-        .astype(jnp.float32)                                   # (J, N)
+        .astype(jnp.bfloat16)                   # (J, N) 0/1: bf16-exact
     return lb2_bounds_tpu(tables, child_front_cols, unsched, tile=nt)
 
 
@@ -503,7 +510,7 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
 def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
                    tile: int = LB2_TILE):
     """Pallas LB2 over child columns: child_front_cols (M, N) i32,
-    unsched_cols (J, N) f32 — returns (1, N) i32 bounds."""
+    unsched_cols (J, N) bf16 0/1 — returns (1, N) i32 bounds."""
     M, N = child_front_cols.shape
     J = unsched_cols.shape[0]
     P = tables.ma0.shape[0]
@@ -514,7 +521,7 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
     sel0 = (tables.ma0[:, None] == jnp.arange(M)).astype(jnp.float32)
     sel1 = (tables.ma1[:, None] == jnp.arange(M)).astype(jnp.float32)
     js1h = (tables.js.T[:, :, None]
-            == jnp.arange(J)).astype(jnp.float32)       # (J, P, J)
+            == jnp.arange(J)).astype(jnp.bfloat16)      # (J, P, J) one-hot
     # f32 tables: the kernel's whole chain runs in (exact) f32
     pt0 = tables.ptm0_js.astype(jnp.float32)
     pt1 = tables.ptm1_js.astype(jnp.float32)
