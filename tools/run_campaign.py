@@ -152,7 +152,8 @@ def worker_main(inst: int) -> None:
 
     while True:
         def mk_meta():
-            return {"inst": inst, "lb": lb, "chunk": CHUNK, "grows": grows,
+            return {"inst": inst, "lb": lb, "chunk": CHUNK,
+                    "ub_mode": UB_MODE, "grows": grows,
                     "spent_s": round(
                         spent_now(time.perf_counter() - t0), 2)}
 
@@ -242,7 +243,8 @@ def supervise(inst: int, lb: int) -> dict | None:
             with np.load(ckpt_path) as z:
                 match = (int(z["meta_inst"]) == inst
                          and int(z["meta_lb"]) == lb
-                         and int(z["meta_chunk"]) == CHUNK)
+                         and int(z["meta_chunk"]) == CHUNK
+                         and str(z["meta_ub_mode"]) == UB_MODE)
         except (KeyError, OSError, ValueError):
             match = False
         if match:
@@ -292,6 +294,12 @@ def supervise(inst: int, lb: int) -> dict | None:
             except (ProcessLookupError, PermissionError):
                 pass
             proc.wait()
+            # the run is recorded — a surviving final checkpoint (a
+            # drained pool) would make a later re-measurement campaign
+            # "resume" it and instantly re-report THESE counters as a
+            # fresh result
+            if os.path.exists(ckpt_path):
+                os.unlink(ckpt_path)
             row.pop("kind", None)
             row.pop("t", None)
             row["restarts"] = restarts
