@@ -235,3 +235,35 @@ def test_taillard_oracle_table_spotchecks():
     assert taillard.optimal_makespan(56) == 3679
     assert taillard.nb_jobs(14) == 20 and taillard.nb_machines(14) == 10
     assert taillard.nb_jobs(56) == 50 and taillard.nb_machines(56) == 20
+
+
+@pytest.mark.parametrize("jobs,machines", [(80, 5), (100, 10), (200, 20)])
+def test_lb2_bigj_kernel_interpret_matches_scan(jobs, machines):
+    """The streaming big-J pair-sweep kernel (pallas interpreter on CPU)
+    against the XLA bitmask scan on random fronts/masks: bit-exact.
+    These are the J > 64 classes lb2_kernel_fits gates off the register
+    kernel (mosaic scoped-VMEM walls); hardware parity for the compiled
+    kernel is pinned by tests/test_pallas_tpu.py."""
+    from tpu_tree_search.ops import pallas_expand
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    p = rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
+    tables = batched.make_tables(p)
+    N = 1024
+    cf = jnp.asarray(rng.integers(0, 3000, size=(machines, N)), jnp.int32)
+    unsched = rng.random((jobs, N)) < 0.5
+    W = pallas_expand.sched_words(jobs)
+    words = np.zeros((W, N), np.uint32)
+    for v in range(jobs):
+        words[v // 32] |= np.where(unsched[v], np.uint32(0),
+                                   np.uint32(1 << (v % 32)))
+    sched = jnp.asarray(words.view(np.int32))
+    want = np.asarray(pallas_expand.lb2_cols(tables, sched, cf))
+    nt = pallas_expand.lb2_bigj_tile(jobs, machines, N)
+    assert nt > 0, "no streaming tile at test width"
+    got = np.asarray(pallas_expand.lb2_bounds_bigj_tpu(
+        tables, cf, jnp.asarray(unsched.astype(np.float32)), tile=nt,
+        interpret=True))
+    np.testing.assert_array_equal(got, want)

@@ -216,8 +216,12 @@ def test_j500_engine_matches_native():
     rng = np.random.default_rng(11)
     p = rng.integers(1, 100, (M, J)).astype(np.int32)
     assert device.aux_dtype(p) == np.dtype(np.int32)
+    # r5: the dense-XLA route is gone — every class without the pallas
+    # expand kernel now runs the prefilter STRUCTURE (LB1 pre-prune +
+    # tiered sweeps) with XLA fallbacks per stage, and the sweeps ride
+    # the streaming big-J pair kernel (lb2_bounds_bigj_tpu)
     route, _, pair_ok = device.lb2_route(J, M, 190, 64)
-    assert route == "xla" and not pair_ok
+    assert route == "prefilter" and not pair_ok
 
     seeds = np.stack([rng.permutation(J) for _ in range(B)]) \
         .astype(np.int16)
@@ -252,3 +256,65 @@ def test_j500_engine_matches_native():
     assert not bool(out.overflow) and int(jnp.asarray(out.size)) == 0
     assert int(out.best) == best0
     assert int(out.tree) >= 500 and int(out.sol) > 0
+
+
+def test_lb2_bigj_kernel_matches_scan_on_hardware():
+    """The COMPILED streaming big-J pair-sweep kernel
+    (lb2_bounds_bigj_tpu: chain state in VMEM scratch across sequential
+    j grid steps, streamed one-hot blocks) against the XLA bitmask scan,
+    bit-exact, at the 200x20 campaign class and the 100x10 class. The
+    interpret-mode parity lives in tests/test_bounds.py; this is the
+    mosaic-legalization + memory-layout tripwire."""
+    import jax.numpy as jnp
+
+    for jobs, machines, seed in ((200, 20, 3), (100, 10, 5)):
+        rng = np.random.default_rng(seed)
+        p = rng.integers(1, 100, size=(machines, jobs)).astype(np.int32)
+        tables = batched.make_tables(p)
+        N = 4096
+        cf = jnp.asarray(rng.integers(0, 3000, size=(machines, N)),
+                         jnp.int32)
+        unsched = rng.random((jobs, N)) < 0.5
+        W = pallas_expand.sched_words(jobs)
+        words = np.zeros((W, N), np.uint32)
+        for v in range(jobs):
+            words[v // 32] |= np.where(unsched[v], np.uint32(0),
+                                       np.uint32(1 << (v % 32)))
+        sched = jnp.asarray(words.view(np.int32))
+        want = np.asarray(pallas_expand.lb2_cols(tables, sched, cf))
+        nt = pallas_expand.lb2_bigj_tile(jobs, machines, N)
+        assert nt > 0
+        got = np.asarray(pallas_expand.lb2_bounds_bigj_tpu(
+            tables, cf, jnp.asarray(unsched.astype(np.float32)),
+            tile=nt))
+        np.testing.assert_array_equal(got, want,
+                                      err_msg=f"{jobs}x{machines}")
+
+
+def test_j200_two_phase_engine_runs_on_hardware():
+    """The 200x20 campaign class end-to-end on chip through the new
+    route: pallas LB1 expand at the jobs>=128 tile floor of 64, LB1
+    pre-prune, streaming big-J pair sweeps over survivor tiers. The
+    TB=64 kernel must match the XLA oracle bit-for-bit, and a bounded
+    window of the full engine must push nodes."""
+    from tpu_tree_search.engine import device
+
+    rng = np.random.default_rng(17)
+    p = rng.integers(1, 100, (20, 200)).astype(np.int32)
+    tables = batched.make_tables(p)
+
+    tile = pallas_expand.effective_tile(200, 1024, 1024, 1, machines=20)
+    assert tile == 64  # the jobs>=128 floor this test exists to pin
+    assert pallas_expand.kernel_ok(200, tile, 1, machines=20)
+    args = _random_parents(p, 1024, seed=23)
+    bounds_t = pallas_expand.expand_bounds(tables, *args, lb_kind=1,
+                                           tile=tile)
+    bounds_x = pallas_expand.expand_bounds_xla(tables, *args, lb_kind=1,
+                                               tile=tile)
+    np.testing.assert_array_equal(np.asarray(bounds_t),
+                                  np.asarray(bounds_x))
+
+    state = device.init_state(200, 1 << 19, 13000, p_times=p)
+    out = device.run(tables, state, 2, 1024, max_iters=20)
+    assert int(out.iters) > 0
+    assert int(out.tree) > 0

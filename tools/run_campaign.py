@@ -60,8 +60,20 @@ CKPT_EVERY = int(os.environ.get("TTS_CKPT_EVERY", "8"))
 UB_MODE = os.environ.get("TTS_UB", "opt")
 STALL_GRACE = float(os.environ.get("TTS_STALL_GRACE", "900"))
 STALL_FACTOR = float(os.environ.get("TTS_STALL_FACTOR", "4"))
-STALL_MIN = float(os.environ.get("TTS_STALL_MIN", "120"))
+# the floor sits ABOVE the documented ~633 s self-clearing tunnel
+# stalls (BENCHMARKS.md): killing a merely-stalled dispatch crashes the
+# remote TPU worker, and every process that attaches afterwards hangs
+# in init for many minutes — the cure is far worse than the wait
+# (measured: a 156 s-floor kill mid-stall turned a ~600 s delay into a
+# crashed worker + reconnect hang + lost unsaved segments). The
+# supervisor exists for PERMANENT hangs; ~12 min detection latency is
+# noise on the multi-hour runs it protects.
+STALL_MIN = float(os.environ.get("TTS_STALL_MIN", "720"))
 MAX_RESTARTS = int(os.environ.get("TTS_MAX_RESTARTS", "50"))
+# consecutive worker deaths with no iteration progress before giving
+# up: 5, not fewer — after a remote-worker crash the first several
+# respawns can each burn the full init grace just reconnecting
+DEAD_LIMIT = int(os.environ.get("TTS_DEAD_LIMIT", "5"))
 
 
 def paths(inst: int, lb: int):
@@ -327,7 +339,7 @@ def supervise(inst: int, lb: int) -> dict | None:
         print(f"ta{inst:03d} lb{lb}: worker {outcome} "
               f"(restart {restarts}, iters={iters_now}); resuming from "
               f"checkpoint", flush=True)
-        if restarts >= MAX_RESTARTS or dead_without_progress >= 3:
+        if restarts >= MAX_RESTARTS or dead_without_progress >= DEAD_LIMIT:
             print(f"ta{inst:03d} lb{lb}: giving up after {restarts} "
                   f"restarts ({dead_without_progress} without progress)",
                   flush=True)

@@ -389,21 +389,26 @@ def _compact_from_parents(tables: BoundTables, p_prmu, p_depth2, p_aux,
 def lb2_route(jobs: int, machines: int, pairs: int, chunk: int,
               tile: int = 1024) -> tuple[str, int, bool]:
     """THE LB2 routing decision at these shapes: returns
-    (route, TB, pair_kernel_ok), route in {'dense', 'prefilter', 'xla'}
-    — pair_kernel_ok says whether the pallas pair-sweep kernel runs
-    (the prefilter route sweeps via it when True, via the XLA scan when
-    False). Shared by step() and the phase-attribution profiler
-    (utils/phase_timing) so the attribution can never price a path or
-    an implementation the engine does not use.
+    (route, TB, pair_kernel_ok), route in {'dense', 'prefilter'} —
+    pair_kernel_ok says whether the small-J register pair-sweep kernel
+    runs (the prefilter route sweeps via it when True, else via the
+    streaming big-J kernel or the XLA scan, lb2_sweep_tile). Shared by
+    step() and the phase-attribution profiler (utils/phase_timing) so
+    the attribution can never price a path or an implementation the
+    engine does not use.
 
     - 'dense': one-shot dense pair sweep — needs the pallas pair kernel
       (lb2_kernel_fits) at the LB2-capped tile AND a few-pair class.
-    - 'prefilter': pallas LB1 pre-prune + pair sweeps over survivor
-      tiers (pallas or XLA scan per lb2_bounds' own dispatch). When the
-      pair kernel cannot run anyway, the LB2 tile cap's halving is moot
-      and the tile retries at the LB1 cap (the 100-job classes).
-    - 'xla': no pallas kernel fits (wrong backend or J*M*TB over every
-      cap) — the dense XLA fallback.
+    - 'prefilter': LB1 pre-prune + pair sweeps over survivor tiers.
+      Every stage degrades independently to its XLA fallback (the LB1
+      bounds via expand_bounds' own dispatch, the sweeps via
+      lb2_bounds'/sweep_tiers'), so this route covers EVERY class —
+      including the 200/500-job classes whose expand kernel misses the
+      scoped-VMEM cap: sweeping only survivor tiers beats the dense
+      all-children XLA sweep ~10x there (the pair scan is the dominant
+      cost and LB1 removes most of the grid first). When the pair
+      kernel cannot run anyway, the LB2 tile cap's halving is moot and
+      the tile retries at the LB1 cap (the 100-job classes).
     """
     TB = pallas_expand.effective_tile(jobs, chunk, tile, 2,
                                       machines=machines)
@@ -414,8 +419,6 @@ def lb2_route(jobs: int, machines: int, pairs: int, chunk: int,
                                            machines=machines)
         if pallas_expand.kernel_ok(jobs, TB1, 1, machines=machines):
             TB = TB1
-    if not pallas_expand.kernel_ok(jobs, TB, 1, machines=machines):
-        return "xla", TB, pair_ok
     if pair_ok and pairs <= 2 * batched.PAIR_PREFILTER:
         return "dense", TB, pair_ok
     return "prefilter", TB, pair_ok
@@ -510,9 +513,9 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         "the per-node front tables")
     # the tile ALSO defines the expand outputs' column order — derived
     # through the same single functions expand() uses; lb2_route owns
-    # the LB2 route/tile choice (dense vs prefilter vs XLA, including
-    # the LB1-tile retry for the 100-job classes whose pair kernel is
-    # gated off — measured on ta071/ta081, BENCHMARKS.md)
+    # the LB2 route/tile choice (dense vs prefilter, including the
+    # LB1-tile retry for the 100-job classes whose register pair kernel
+    # is gated off — measured on ta071/ta081, BENCHMARKS.md)
     if lb_kind == 2:
         route, TB, _ = lb2_route(J, M, int(tables.ma0.shape[0]), B, tile)
     else:
@@ -626,7 +629,18 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             the swept prefix snug around small survivor sets."""
             PT = int(tbl.ma0.shape[0])
             frame = cf_cols.shape[1]
-            xla_sweep = not pallas_expand.lb2_kernel_fits(J, PT)
+            on_tpu = jax.default_backend() == "tpu"
+
+            def rung_ok(t):
+                # a rung is admitted when the sweep at that width runs
+                # a pallas kernel — lb2_sweep_tile is THE shared
+                # dispatch predicate (register kernel or streaming
+                # big-J), so admission cannot diverge from lb2_bounds.
+                # On CPU every rung is fine (the XLA scan has no tile
+                # rule).
+                return (not on_tpu
+                        or pallas_expand.lb2_sweep_tile(J, PT, M, t) > 0)
+
             # finer than the compaction ladder (rungs here carry only a
             # (1, frame) row): the tail sweep's survivor count sits
             # wherever the head prune left it, and a coarse ladder
@@ -635,10 +649,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
             tiers = [t for t in (k * N // 64 for k in
                                  (1, 2, 3, 4, 5, 6, 8, 10, 12, 14, 16,
                                   20, 24, 32))
-                     if 0 < t < frame
-                     and (xla_sweep
-                          or pallas_expand.lb2_tile(J, PT, t) > 0)]
-            if not xla_sweep and pallas_expand.lb2_tile(J, PT, frame) == 0:
+                     if 0 < t < frame and rung_ok(t)]
+            if on_tpu and not rung_ok(frame):
                 # the frame rung is appended unconditionally (it must
                 # cover every count), but if it misses the tile rule
                 # lb2_bounds takes its XLA fallback there — on the

@@ -270,7 +270,13 @@ def kernel_ok(jobs: int, eff_tile: int, lb_kind: int,
         return False
     lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
     return (eff_tile >= min_tile(jobs)
-            and eff_tile % 128 == 0          # lane-aligned reshapes
+            # lane-aligned reshapes: the kernel's (J, TB) -> (1, J*TB)
+            # flattening needs the flat lane count 128-aligned; TB
+            # itself only has to be 128-aligned below the jobs >= 128
+            # floor (TB=64 at even J keeps J*TB aligned — validated on
+            # hardware at 200x20, tests/test_pallas_tpu.py)
+            and (eff_tile % 128 == 0
+                 or (jobs >= 128 and (jobs * eff_tile) % 128 == 0))
             and jobs * eff_tile <= lane_cap
             and (machines is None
                  or jobs * machines * eff_tile <= EXPAND_TILE_UNITS))
@@ -324,6 +330,19 @@ def lb2_tile(jobs: int, pairs: int, width: int) -> int:
             (rows * jobs + _LB2_SCOPED_BASE) * nt > _LB2_SCOPED_BUDGET):
         nt //= 2
     return nt if nt >= MIN_PALLAS_TILE else 0
+
+
+def lb2_sweep_tile(jobs: int, pairs: int, machines: int,
+                   width: int) -> int:
+    """THE single which-pallas-pair-kernel predicate: the column tile
+    the LB2 sweep at `width` will actually run with — the register
+    kernel's tile (lb2_tile) when lb2_kernel_fits, else the streaming
+    big-J kernel's (lb2_bigj_tile). 0 means the sweep takes the XLA
+    scan. Shared by lb2_bounds' dispatch and device.step's sweep-rung
+    admission so tier admission can never diverge from the dispatch."""
+    if lb2_kernel_fits(jobs, pairs):
+        return lb2_tile(jobs, pairs, width)
+    return lb2_bigj_tile(jobs, machines, width)
 
 
 def lb2_kernel_fits(jobs: int, pairs: int) -> bool:
@@ -491,19 +510,21 @@ def lb2_bounds(tables: BoundTables, child_front_cols, sched_mask):
     child_front_cols; widened to i32 here at entry (full width — a no-op
     for the i32 blocks the engine's compaction path passes)."""
     child_front_cols = child_front_cols.astype(jnp.int32)
-    N = child_front_cols.shape[1]
+    M, N = child_front_cols.shape
     J = tables.js.shape[1]
     P = int(tables.ma0.shape[0])
-    nt = lb2_tile(J, P, N)
-    if (jax.default_backend() != "tpu" or nt == 0
-            or not lb2_kernel_fits(J, P)):
+    nt = lb2_sweep_tile(J, P, M, N)
+    if jax.default_backend() != "tpu" or nt == 0:
         return lb2_cols(tables, sched_mask, child_front_cols)
     vj = jnp.arange(J, dtype=jnp.int32)
     word = (sched_mask if sched_mask.shape[0] == 1
             else jnp.take(sched_mask, vj // 32, axis=0))       # (J|1, N)
     unsched = (((word >> (vj % 32)[:, None]) & jnp.int32(1)) == 0) \
         .astype(jnp.bfloat16)                   # (J, N) 0/1: bf16-exact
-    return lb2_bounds_tpu(tables, child_front_cols, unsched, tile=nt)
+    if lb2_kernel_fits(J, P):
+        return lb2_bounds_tpu(tables, child_front_cols, unsched, tile=nt)
+    return lb2_bounds_bigj_tpu(tables, child_front_cols, unsched,
+                               tile=nt)
 
 
 @functools.partial(jax.jit, static_argnames=("tile",))
@@ -556,6 +577,155 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
         )
         return call(sel0, sel1, js1h, pt0, pt1, lag, tails0, tails1,
                     child_front_cols, unsched_cols)
+
+
+LB2_BIGJ_MIN_TILE = 512
+
+
+def lb2_bigj_tile(jobs: int, machines: int, width: int) -> int:
+    """Column tile for the STREAMING big-J pair sweep
+    (lb2_bounds_bigj_tpu): a power-of-two divisor of `width`, sized so
+    the per-tile VMEM residents — unsched (J, NT) bf16, cf (M, NT) f32,
+    two (PB, NT) f32 chain scratches, the (1, NT) output and the
+    double-buffered per-step blocks — fit the scoped budget. Returns 0
+    when no tile >= LB2_BIGJ_MIN_TILE exists (callers then take the XLA
+    scan)."""
+    nt = min(LB2_TILE, width & -width)
+    per_col = 2 * jobs + 4 * machines + 8 * LB2_PB + 16
+    while nt >= LB2_BIGJ_MIN_TILE and nt * per_col > 12e6:
+        nt //= 2
+    return nt if nt >= LB2_BIGJ_MIN_TILE else 0
+
+
+def _lb2_bigj_kernel(J, P, PB,
+                     sel0_ref, sel1_ref, tails0_ref, tails1_ref,
+                     js_ref, pt0_ref, pt1_ref, lag_ref,
+                     cf_ref, unsched_ref, bounds_ref, t0_ref, t1_ref):
+    """Streaming all-pairs Johnson sweep for J > 64: one grid step per
+    (column tile, pair block, JOB step). The J-step chain that the
+    small-J kernel unrolls in registers (and whose (J, P, J) one-hot
+    must sit whole in VMEM — both walls cap it at J <= 64,
+    lb2_kernel_fits) here carries (PB, NT) f32 chain state in VMEM
+    scratch across sequential j grid steps, while the per-step one-hot
+    block (1, PB, J) bf16 and the (1, PB, 1) pt/lag columns STREAM from
+    HBM. Init (pair-machine selection matmul) and the final
+    per-pair/tails reduction run under pl.when at the chain's
+    endpoints; the output block is revisited across pair blocks with a
+    running max. Same mul/max active-select math as _lb2_kernel —
+    bit-exact f32, bf16 act matmul (0/1 one-hots)."""
+    pb = pl.program_id(1)
+    j = pl.program_id(2)
+    hi = jax.lax.Precision.HIGHEST
+
+    @pl.when(j == 0)
+    def _init():
+        cf = cf_ref[:]
+        t0_ref[:] = jnp.dot(sel0_ref[:], cf, precision=hi,
+                            preferred_element_type=jnp.float32)
+        t1_ref[:] = jnp.dot(sel1_ref[:], cf, precision=hi,
+                            preferred_element_type=jnp.float32)
+
+    act = jnp.dot(js_ref[0], unsched_ref[:],
+                  preferred_element_type=jnp.float32)       # (PB, NT)
+    pt0j = pt0_ref[0]                                       # (PB, 1)
+    pt1j = pt1_ref[0]
+    lagj = lag_ref[0]
+    t0 = t0_ref[:] + act * pt0j
+    cand = jnp.maximum(t1_ref[:], t0 + lagj) + pt1j
+    t1 = jnp.maximum(t1_ref[:], act * cand)
+    t0_ref[:] = t0
+    t1_ref[:] = t1
+
+    @pl.when(j == J - 1)
+    def _fin():
+        per_pair = jnp.maximum(t1 + tails1_ref[:], t0 + tails0_ref[:])
+        blk = jnp.max(per_pair, axis=0, keepdims=True).astype(jnp.int32)
+
+        @pl.when(pb == 0)
+        def _first():
+            bounds_ref[:] = blk
+
+        @pl.when(pb > 0)
+        def _acc():
+            bounds_ref[:] = jnp.maximum(bounds_ref[:], blk)
+
+
+@functools.partial(jax.jit, static_argnames=("tile", "interpret"))
+def lb2_bounds_bigj_tpu(tables: BoundTables, child_front_cols,
+                        unsched_cols, tile: int,
+                        interpret: bool = False):
+    """Streaming pallas LB2 for J > 64 (see _lb2_bigj_kernel):
+    child_front_cols (M, N) i32, unsched_cols (J, N) bf16 0/1 ->
+    (1, N) i32 bounds. `interpret=True` runs the pallas interpreter
+    (CPU) — used by the CPU parity tests; hardware parity is pinned by
+    tests/test_pallas_tpu.py."""
+    M, N = child_front_cols.shape
+    J = unsched_cols.shape[0]
+    P = int(tables.ma0.shape[0])
+    PB = LB2_PB
+    NB = -(-P // PB)
+    PP = NB * PB
+    NT = tile
+    assert N % NT == 0, (N, NT)
+
+    def pad_rows(x, rows, fill=0.0):
+        pad = rows - x.shape[0]
+        if pad == 0:
+            return x
+        return jnp.concatenate(
+            [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)], axis=0)
+
+    with jax.enable_x64(False):
+        sel0 = pad_rows((tables.ma0[:, None]
+                         == jnp.arange(M)).astype(jnp.float32), PP)
+        sel1 = pad_rows((tables.ma1[:, None]
+                         == jnp.arange(M)).astype(jnp.float32), PP)
+        # pad pairs with -3e8 tails: their all-zero chains then lose
+        # every max against any real pair's non-negative bound
+        tails0 = pad_rows(jnp.take(tables.min_tails, tables.ma0)[:, None]
+                          .astype(jnp.float32), PP, -3e8)
+        tails1 = pad_rows(jnp.take(tables.min_tails, tables.ma1)[:, None]
+                          .astype(jnp.float32), PP, -3e8)
+        # per-step tables, job-step-major so grid blocks stream one
+        # (1, PB, ·) slab per (j, pb): one-hots bf16 (exact), pt/lag as
+        # (J, PP, 1) f32 columns (pairs ride the sublanes, matching the
+        # (PB, NT) chain blocks)
+        js = pad_rows((tables.js.T[:, :, None]
+                       == jnp.arange(J)).astype(jnp.bfloat16)
+                      .transpose(1, 0, 2), PP).transpose(1, 0, 2)
+        pt0 = pad_rows(tables.ptm0_js.astype(jnp.float32), PP) \
+            .T[:, :, None]
+        pt1 = pad_rows(tables.ptm1_js.astype(jnp.float32), PP) \
+            .T[:, :, None]
+        lag = pad_rows(tables.lag_js.astype(jnp.float32), PP) \
+            .T[:, :, None]
+        cf = child_front_cols.astype(jnp.float32)
+        unsched = unsched_cols.astype(jnp.bfloat16)
+
+        kernel = functools.partial(_lb2_bigj_kernel, J, P, PB)
+        call = pl.pallas_call(
+            kernel,
+            grid=(N // NT, NB, J),
+            in_specs=[
+                pl.BlockSpec((PB, M), lambda t, pb, j: (pb, 0)),    # sel0
+                pl.BlockSpec((PB, M), lambda t, pb, j: (pb, 0)),    # sel1
+                pl.BlockSpec((PB, 1), lambda t, pb, j: (pb, 0)),    # tails0
+                pl.BlockSpec((PB, 1), lambda t, pb, j: (pb, 0)),    # tails1
+                pl.BlockSpec((1, PB, J), lambda t, pb, j: (j, pb, 0)),
+                pl.BlockSpec((1, PB, 1), lambda t, pb, j: (j, pb, 0)),
+                pl.BlockSpec((1, PB, 1), lambda t, pb, j: (j, pb, 0)),
+                pl.BlockSpec((1, PB, 1), lambda t, pb, j: (j, pb, 0)),
+                pl.BlockSpec((M, NT), lambda t, pb, j: (0, t)),     # cf
+                pl.BlockSpec((J, NT), lambda t, pb, j: (0, t)),     # unsched
+            ],
+            out_specs=pl.BlockSpec((1, NT), lambda t, pb, j: (0, t)),
+            out_shape=jax.ShapeDtypeStruct((1, N), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((PB, NT), jnp.float32),
+                            pltpu.VMEM((PB, NT), jnp.float32)],
+            interpret=interpret,
+        )
+        return call(sel0, sel1, tails0, tails1, js, pt0, pt1, lag,
+                    cf, unsched)
 
 
 def _to_cols(x, G: int, TB: int, J: int):
@@ -684,7 +854,12 @@ def min_tile(jobs: int) -> int:
     """Mosaic's lane-reshape floor for the expand kernels: 256 in
     general; 128 is validated for the wide classes (jobs >= 64 keeps
     the J*tile lane count >= 8192 — measured bit-exact at J=100/TB=128,
-    which the 100x20 class needs to fit the scoped-VMEM stack)."""
+    which the 100x20 class needs to fit the scoped-VMEM stack); 64 for
+    jobs >= 128 (lane count still >= 8192; the 200x20 class needs
+    TB=64 to fit the J*M*TB scoped-VMEM unit cap — validated bit-exact
+    at J=200/TB=64 on hardware, tests/test_pallas_tpu.py)."""
+    if jobs >= 128:
+        return 64
     return 128 if jobs >= 64 else 256
 
 

@@ -102,6 +102,21 @@ def _pop_and_bound(tables: BoundTables, state, lb_kind: int, chunk: int,
         _, _, bounds = pallas_expand.expand(tables, p_prmu, p_depth,
                                             p_aux, lb_kind=2, tile=TB)
         return bounds
+    if lb_kind == 2:
+        # J > 64: production sweeps ride the streaming big-J pallas
+        # kernel when its tile exists (lb2_bounds' own dispatch via
+        # lb2_sweep_tile) — price THROUGH lb2_bounds so the proxy uses
+        # the same implementation, not the dense-XLA scan (pricing the
+        # wrong implementation is the round-2 bug class
+        # tools/validate_attribution.py exists to catch)
+        lb1b = pallas_expand.expand_bounds(tables, p_prmu, p_depth,
+                                           p_aux, lb_kind=1, tile=TB)
+        cf = pallas_expand._xla_parts(tables, p_prmu, p_depth,
+                                      p_aux.astype(jnp.int32))[4]
+        G = p_prmu.shape[1] // TB
+        cf_cols = pallas_expand._to_cols(cf.astype(jnp.int32), G, TB, J)
+        sched = pallas_expand.sched_mask_cols(p_prmu, p_depth, TB)
+        return lb1b + pallas_expand.lb2_bounds(tables, cf_cols, sched)
     return pallas_expand.expand_bounds(tables, p_prmu, p_depth, p_aux,
                                        lb_kind=lb_kind, tile=TB)
 
