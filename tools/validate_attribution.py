@@ -99,7 +99,11 @@ def main():
         # attribution's real error bar for BOTH bounds.
         import jax
         import jax.numpy as jnp
-        K = 64
+        # 256 reps (r5, was 64): the LB1 bracket is ~0.3 ms, so per-rep
+        # wall slack that the two-trip differencing cannot cancel
+        # (device scheduling bubbles, loop-carry overhead) amortizes
+        # only with a long window — K=64 read +38.6% on LB1 (r4)
+        K = int(os.environ.get("TTS_BRACKET_REPS", "256"))
 
         def make_loop(reps):
             @jax.jit
