@@ -99,6 +99,13 @@ def worker_main(inst: int) -> None:
 
     import jax
 
+    # honor a JAX_PLATFORMS=cpu request (the CPU-mesh tests): without
+    # this the "CPU" durability tests silently ran their workers on the
+    # live TPU (the sitecustomize preload pins the TPU plugin)
+    from tpu_tree_search.utils import device_info
+
+    device_info.apply_platform_override()
+
     from tpu_tree_search.engine import checkpoint, device
     from tpu_tree_search.ops import batched
     from tpu_tree_search.problems import taillard

@@ -15,6 +15,21 @@ import contextlib
 import jax
 
 
+def apply_platform_override() -> None:
+    """Honor a JAX_PLATFORMS request that names a non-TPU backend. The
+    environment preloads jax via sitecustomize and pins the TPU plugin,
+    so the env var alone cannot flip the platform — the jax.config path
+    can. THE single copy of this recipe (fresh subprocesses — campaign
+    workers, test children, __graft_entry__ — call it before their
+    first backend touch; without it "CPU" subprocesses silently run on
+    the live TPU)."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want and "tpu" not in want:
+        jax.config.update("jax_platforms", want)
+
+
 def describe_devices() -> list[dict]:
     """One record per addressable device (platform, kind, process, memory
     stats when the backend exposes them)."""
