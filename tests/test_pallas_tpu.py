@@ -318,3 +318,40 @@ def test_j200_two_phase_engine_runs_on_hardware():
     out = device.run(tables, state, 2, 1024, max_iters=20)
     assert int(out.iters) > 0
     assert int(out.tree) > 0
+
+
+def test_j200_seeded_matches_native():
+    """J=200 bounded-subtree parity on chip — the big-J analogue of
+    test_j500_engine_matches_native, now through the ROUND-5 route:
+    pallas LB1 expand at the jobs>=128 TB=64 floor, LB1 pre-prune, and
+    the streaming big-J pair-sweep kernel over survivor tiers. Near-leaf
+    bounds are exactly tight here too (ub=best0 explores 0 nodes —
+    measured on the native oracle), so the invariant follows the repo's
+    ub=inf convention: both engines must prove the same subtree optimum
+    through completely different traversals."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search import native
+    from tpu_tree_search.engine import device
+
+    J, M, B = 200, 20, 32
+    rng = np.random.default_rng(19)
+    p = rng.integers(1, 100, (M, J)).astype(np.int32)
+    seeds = np.stack([rng.permutation(J) for _ in range(B)]) \
+        .astype(np.int16)
+    depth = np.array([186 + (i % 6) for i in range(B)], np.int16)
+    _, _, best0, _ = native.search_from(p, seeds, depth, lb_kind=2,
+                                        init_ub=2**31 - 1)
+    ub = int(best0) + 150
+    tree, sol, best, _ = native.search_from(p, seeds, depth, lb_kind=2,
+                                            init_ub=ub)
+    assert tree >= 200, tree
+    assert best == best0
+
+    tables = batched.make_tables(p)
+    state = device.init_state(J, 1 << 17, ub, prmu0=seeds, depth0=depth,
+                              p_times=p)
+    out = device.run(tables, state, 2, 64)
+    assert not bool(out.overflow) and int(jnp.asarray(out.size)) == 0
+    assert int(out.best) == best0
+    assert int(out.tree) >= 200 and int(out.sol) > 0
