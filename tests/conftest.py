@@ -40,6 +40,12 @@ else:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        # newer jax: the device count is a config knob (env flags are
+        # read too early when sitecustomize preloads jax)
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # older jax reads XLA_FLAGS above at first backend init instead
+        pass
 
     assert jax.device_count() == 8, jax.devices()

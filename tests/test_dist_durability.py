@@ -119,19 +119,31 @@ def test_dist_segmented_checkpoint_resume(tmp_path):
     assert len(reports[0].per_worker["steals"]) == 8
 
 
-def test_dist_checkpoint_resume_mesh_mismatch(tmp_path):
-    """Resuming on a different worker count fails loudly, not wrongly."""
-    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=5)
+def test_dist_checkpoint_elastic_resume_fewer_workers(tmp_path):
+    """An 8-worker checkpoint resumes on a 2-worker mesh (elastic
+    resume: the pools are concatenated and water-filled across the new
+    mesh) and still reaches the exact uninterrupted totals — at ub=opt
+    the explored set is exploration-order independent, so any lost or
+    duplicated node would shift the counts. (This replaced the hard
+    'resume needs the same worker count' error: on real fleets a
+    preempted job rarely gets the same topology back.)"""
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=7)
+    opt = inst.brute_force_optimum()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
     ckpt = tmp_path / "dist8.npz"
-    distributed.search(inst.p_times, lb_kind=1, init_ub=None, chunk=4,
-                       capacity=1 << 12, min_seed=8, segment_iters=2,
-                       checkpoint_path=str(ckpt), max_rounds=2,
-                       heartbeat=None)
+    part = distributed.search(inst.p_times, lb_kind=1, init_ub=opt,
+                              chunk=4, capacity=1 << 12, min_seed=8,
+                              segment_iters=2, checkpoint_path=str(ckpt),
+                              max_rounds=2, heartbeat=None)
     assert ckpt.exists()
-    with pytest.raises(ValueError, match="worker count"):
-        distributed.search(inst.p_times, lb_kind=1, init_ub=None,
-                           n_devices=2, chunk=4, capacity=1 << 12,
-                           checkpoint_path=str(ckpt), heartbeat=None)
+    assert not part.complete, "partial run finished — nothing to resume"
+    with pytest.warns(RuntimeWarning, match="resharding"):
+        res = distributed.search(inst.p_times, lb_kind=1, init_ub=opt,
+                                 n_devices=2, chunk=4, capacity=1 << 12,
+                                 checkpoint_path=str(ckpt), heartbeat=None)
+    assert res.complete
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
 
 
 def test_grow_stacked_state():
@@ -261,6 +273,131 @@ def test_supervisor_relaunch_resumes_checkpoint(tmp_path):
     assert (rows[0]["tree"], rows[0]["best"], rows[0]["iters"]) == \
         CAMPAIGN_GOLDEN
     assert not ckpt.exists(), "completed run must remove its checkpoint"
+
+
+def test_supervisor_recovers_from_repeated_kill_injection(tmp_path):
+    """Preemption torture: TTS_FAULTS=kill_after_segment=2 rides the
+    supervisor's env into EVERY respawned worker, so each incarnation
+    is killed (exit 137) two segments after it resumes. Progress still
+    converges — every death leaves a fresh checkpoint behind — and the
+    final counters are bit-identical to an unkilled run."""
+    out = tmp_path / "campaign.jsonl"
+    env = _campaign_env(tmp_path, out,
+                        TTS_FAULTS="kill_after_segment=2",
+                        TTS_STALL_GRACE="180", TTS_STALL_MIN="4")
+    proc = subprocess.run(_campaign_cmd(), env=env, timeout=900,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 1, proc.stdout
+    row = rows[0]
+    assert row["restarts"] >= 1, (row, proc.stdout)
+    assert row["done"], row
+    assert (row["tree"], row["best"], row["iters"]) == CAMPAIGN_GOLDEN
+
+
+def test_campaign_partial_budget_keeps_checkpoint_and_extends(tmp_path):
+    """ADVICE r5: the supervisor used to unlink the checkpoint on
+    budget-exhausted PARTIAL rows and the rerun skip-key ignored
+    budget/done — so a larger-budget rerun silently skipped the
+    instance and the in-flight progress was unrecoverable. Now a
+    partial row keeps its checkpoint, a same-budget rerun still skips,
+    and a larger-budget rerun RESUMES it to the bit-identical solved
+    counters."""
+    out = tmp_path / "campaign.jsonl"
+    ckpt = tmp_path / "tts_ta003_lb2.ckpt.npz"
+    env = _campaign_env(tmp_path, out, TTS_BUDGET_S="0.01")
+    r = subprocess.run(_campaign_cmd(), env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 1 and rows[0]["done"] is False, rows
+    assert ckpt.exists(), "partial row must keep its checkpoint"
+
+    # same budget: nothing new to measure — skip, no new row
+    r2 = subprocess.run(_campaign_cmd(), env=env, timeout=600,
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "skipping" in r2.stdout, r2.stdout
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 1
+
+    # larger budget: resume the kept checkpoint and finish — counters
+    # bit-identical to an uninterrupted run (the stall-test invariant)
+    env3 = _campaign_env(tmp_path, out)          # default budget 600 s
+    r3 = subprocess.run(_campaign_cmd(), env=env3, timeout=600,
+                        capture_output=True, text=True)
+    assert r3.returncode == 0, r3.stdout + r3.stderr
+    assert "extending partial row" in r3.stdout, r3.stdout
+    assert "resuming from existing checkpoint" in r3.stdout, r3.stdout
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 2 and rows[1]["done"], rows
+    assert (rows[1]["tree"], rows[1]["best"], rows[1]["iters"]) == \
+        CAMPAIGN_GOLDEN
+    assert not ckpt.exists(), "solved run must retire its checkpoint"
+
+
+def test_supervisor_screens_out_corrupt_checkpoint(tmp_path):
+    """A mid-file-corrupted checkpoint (torn write: zlib.error /
+    BadZipFile on read, neither a KeyError/OSError/ValueError) must be
+    screened out and deleted at campaign startup, not crash the
+    supervisor."""
+    from tpu_tree_search.utils import faults
+
+    out = tmp_path / "campaign.jsonl"
+    ckpt = tmp_path / "tts_ta003_lb2.ckpt.npz"
+    env = _campaign_env(tmp_path, out, TTS_BUDGET_S="0.01")
+    r = subprocess.run(_campaign_cmd(), env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert ckpt.exists()
+    faults.corrupt_file(ckpt)
+
+    env2 = _campaign_env(tmp_path, out)
+    r2 = subprocess.run(_campaign_cmd(), env=env2, timeout=600,
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert rows[-1]["done"], rows
+    assert (rows[-1]["tree"], rows[-1]["best"], rows[-1]["iters"]) == \
+        CAMPAIGN_GOLDEN
+
+
+def test_worker_resumes_stacked_distributed_checkpoint(tmp_path):
+    """ADVICE r5: worker resume called int(np.asarray(state.iters)) and
+    died with TypeError on a stacked distributed checkpoint, turning a
+    config mistake into repeated worker deaths. Now it collapses the
+    stack onto the single device via the elastic reshard and completes
+    with exact accounting (warm-up counters ride the meta)."""
+    from tpu_tree_search.problems import taillard
+
+    out = tmp_path / "campaign.jsonl"
+    status = tmp_path / "tts_ta003_lb2.status.jsonl"
+    ckpt = tmp_path / "tts_ta003_lb2.ckpt.npz"
+    p = taillard.processing_times(3)
+    opt = taillard.optimal_makespan(3)
+    part = distributed.search(p, lb_kind=2, init_ub=opt, n_devices=2,
+                              chunk=8, capacity=1 << 16, min_seed=8,
+                              segment_iters=20, max_rounds=10,
+                              checkpoint_path=str(ckpt), heartbeat=None)
+    assert ckpt.exists()
+    assert not part.complete, "partial run finished — nothing to resume"
+
+    cmd = _campaign_cmd()[:-1] + ["--worker", "3"]
+    env = _campaign_env(tmp_path, out)
+    proc = subprocess.run(cmd, env=env, timeout=600,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    recs = [json.loads(ln) for ln in status.read_text().splitlines()
+            if ln.strip()]
+    kinds = [r["kind"] for r in recs]
+    assert "reshard" in kinds, kinds
+    done = [r for r in recs if r["kind"] == "done"]
+    assert done and done[0]["done"], recs
+    assert done[0]["best"] == opt == 1081
+    # explored-node accounting exact across the 2-worker -> 1-device
+    # reshard: warm-up + device counters add up to the campaign golden
+    assert done[0]["tree"] == CAMPAIGN_GOLDEN[0]
 
 
 def test_dist_ub_opt_unchanged_counts():

@@ -37,8 +37,20 @@ TTS_WORKDIR (status/checkpoint files, default /tmp), TTS_SEG (default
 default 8), TTS_UB ("opt" | "inf", default opt), TTS_STALL_GRACE
 (seconds before the first heartbeat may be declared dead, default 900 —
 covers a cold 50x20 compile), TTS_MAX_RESTARTS (default 50).
+Resilience knobs ride through to the worker's run_segmented:
+TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S (transient-error backoff) and
+TTS_SEG_TIMEOUT_S (per-segment wall watchdog — the in-process
+complement of this supervisor's heartbeat-age kill). Checkpoints are
+atomic + checksummed with a rotating `.prev` last-good; a worker that
+finds its current snapshot torn rolls back to the last-good one
+(engine/checkpoint.load_resilient). A budget-exhausted PARTIAL row
+keeps its checkpoint, and a rerun with a larger TTS_BUDGET_S resumes
+it instead of skipping (only `done` rows retire their checkpoints).
 Test hooks (worker side): TTS_TEST_STALL_AT_SEG=N — after writing
-segment N's heartbeat, hang forever (simulates a dead tunnel dispatch).
+segment N's heartbeat, hang forever (simulates a dead tunnel
+dispatch); TTS_FAULTS — deterministic fault injection
+(utils/faults.py: kill_after_segment / corrupt_checkpoint /
+delay_segment / fail_host_fetch), inherited by every respawned worker.
 """
 
 import json
@@ -47,6 +59,8 @@ import signal
 import subprocess
 import sys
 import time
+import zipfile
+import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -79,6 +93,20 @@ DEAD_LIMIT = int(os.environ.get("TTS_DEAD_LIMIT", "5"))
 def paths(inst: int, lb: int):
     base = os.path.join(WORKDIR, f"tts_ta{inst:03d}_lb{lb}")
     return base + ".status.jsonl", base + ".ckpt.npz"
+
+
+# the rotating last-good sibling every atomic save leaves beside the
+# checkpoint (engine/checkpoint.LAST_GOOD_SUFFIX — duplicated here so
+# the supervisor process never imports jax: attaching a second process
+# to a remote TPU runtime conflicts with its own worker)
+def last_good(path: str) -> str:
+    return path + ".prev"
+
+
+def unlink_checkpoint(ckpt_path: str) -> None:
+    for p in (ckpt_path, last_good(ckpt_path)):
+        if os.path.exists(p):
+            os.unlink(p)
 
 
 # ----------------------------------------------------------------- worker
@@ -127,8 +155,56 @@ def worker_main(inst: int) -> None:
         max(device.default_capacity(jobs, m), 4 * CHUNK * jobs)
     grows = 0
     spent_before = 0.0
-    if os.path.exists(ckpt_path):
-        state, meta = checkpoint.load(ckpt_path, p_times=p)
+    warm_tree = warm_sol = 0
+    state = None
+    if checkpoint.resume_path(ckpt_path):
+        # load_resilient: a torn current snapshot (the worker was killed
+        # mid-save) rolls back to the rotating last-good sibling instead
+        # of crash-looping the respawn cycle
+        try:
+            state, meta, used = checkpoint.load_resilient(ckpt_path,
+                                                          p_times=p)
+        except checkpoint.CheckpointSchemaError as e:
+            # a newer-schema checkpoint is an operator problem (wrong
+            # build), not damage: abort the campaign loudly via the
+            # fatal channel — the supervisor would otherwise respawn
+            # the same crash DEAD_LIMIT times and silently drop the
+            # instance
+            emit({"kind": "fatal", "reason": str(e)[:300]})
+            sys.exit(3)
+        except checkpoint.CheckpointCorrupt as e:
+            # EVERY candidate unreadable: delete the husks and restart
+            # the instance from scratch — losing the (garbage) file is
+            # recovery, crash-looping until DEAD_LIMIT is not.
+            # CheckpointSchemaError stays fatal on purpose (a valid
+            # newer-format file is an operator problem, not damage).
+            emit({"kind": "corrupt_restart", "reason": str(e)[:200]})
+            unlink_checkpoint(ckpt_path)
+    if state is not None:
+        if str(used) != str(ckpt_path):
+            emit({"kind": "rollback", "path": str(used)})
+        if np.asarray(meta.get("host_depth", np.zeros(0))).size:
+            # a -C distributed checkpoint carries carved host-tier seed
+            # rows; silently dropping them would lose subtrees — refuse
+            # loudly, the distributed engine owns that resume path
+            emit({"kind": "fatal",
+                  "reason": "checkpoint carries a host-tier share; "
+                            "resume it with the distributed engine"})
+            sys.exit(3)
+        if np.asarray(state.prmu).ndim == 3:
+            # a stacked distributed checkpoint (e.g. TTS_WORKDIR pointed
+            # at a file the distributed engine wrote): collapse it onto
+            # this single device instead of dying on the shape — the
+            # shared helper owns the sizing invariant (footprint +
+            # usable-row headroom)
+            state = checkpoint.collapse_to_single_device(state, CHUNK,
+                                                         jobs)
+            emit({"kind": "reshard", "workers": 1})
+        # warm-up counters live in the checkpoint's meta, not the state
+        # (distributed.search tracks them the same way); carry them so
+        # the final row's accounting stays exact across elastic resumes
+        warm_tree = int(meta.get("warmup_tree", 0))
+        warm_sol = int(meta.get("warmup_sol", 0))
         capacity = state.prmu.shape[-1]
         grows = int(meta.get("grows", 0))
         spent_before = float(meta.get("spent_s", 0.0))
@@ -139,7 +215,7 @@ def worker_main(inst: int) -> None:
             grows += 1
             state = checkpoint.grow(state, capacity)
             emit({"kind": "grow", "capacity": capacity})
-        emit({"kind": "resume", "iters": int(np.asarray(state.iters)),
+        emit({"kind": "resume", "iters": int(np.asarray(state.iters).max()),
               "capacity": capacity, "spent_s": spent_before})
     else:
         state = device.init_state(jobs, capacity, ub, p_times=p)
@@ -173,6 +249,7 @@ def worker_main(inst: int) -> None:
         def mk_meta():
             return {"inst": inst, "lb": lb, "chunk": CHUNK,
                     "ub_mode": UB_MODE, "grows": grows,
+                    "warmup_tree": warm_tree, "warmup_sol": warm_sol,
                     "spent_s": round(
                         spent_now(time.perf_counter() - t0), 2)}
 
@@ -194,6 +271,8 @@ def worker_main(inst: int) -> None:
                               state.best, state.size, state.evals))
     iters, tree, sol, best, size, evals = (int(np.asarray(v).max())
                                            for v in fetched)
+    tree += warm_tree
+    sol += warm_sol
     spent = spent_now(time.perf_counter() - t0)
     done = size == 0
     row = {"inst": inst, "jobs": jobs, "machines": m, "lb": lb,
@@ -255,22 +334,34 @@ def supervise(inst: int, lb: int) -> dict | None:
     # A checkpoint from a DIFFERENT configuration would silently resume
     # work measured under other settings — but one matching the current
     # (inst, lb, chunk) is durable in-flight progress from a killed
-    # campaign supervisor and must be resumed, not discarded.
-    if os.path.exists(ckpt_path):
-        import numpy as np
+    # campaign supervisor and must be resumed, not discarded. Both the
+    # current file and its rotating last-good sibling are screened: a
+    # torn current is deleted (the worker would only fall back anyway)
+    # while a good last-good survives to be the worker's rollback.
+    import numpy as np
+    resumable = False
+    for cand in (ckpt_path, last_good(ckpt_path)):
+        if not os.path.exists(cand):
+            continue
         try:
-            with np.load(ckpt_path) as z:
+            with np.load(cand) as z:
                 match = (int(z["meta_inst"]) == inst
                          and int(z["meta_lb"]) == lb
                          and int(z["meta_chunk"]) == CHUNK
                          and str(z["meta_ub_mode"]) == UB_MODE)
-        except (KeyError, OSError, ValueError):
+        except (KeyError, OSError, ValueError, EOFError,
+                zipfile.BadZipFile, zlib.error):
+            # the same error surface checkpoint.load treats as
+            # corruption — a torn file must be screened out here, not
+            # crash the whole campaign at startup
             match = False
         if match:
-            print(f"ta{inst:03d} lb{lb}: resuming from existing "
-                  f"checkpoint {ckpt_path}", flush=True)
+            resumable = True
         else:
-            os.unlink(ckpt_path)
+            os.unlink(cand)
+    if resumable:
+        print(f"ta{inst:03d} lb{lb}: resuming from existing "
+              f"checkpoint {ckpt_path}", flush=True)
 
     restarts = 0
     iters_at_spawn = -1
@@ -313,12 +404,21 @@ def supervise(inst: int, lb: int) -> dict | None:
             except (ProcessLookupError, PermissionError):
                 pass
             proc.wait()
-            # the run is recorded — a surviving final checkpoint (a
-            # drained pool) would make a later re-measurement campaign
-            # "resume" it and instantly re-report THESE counters as a
-            # fresh result
-            if os.path.exists(ckpt_path):
-                os.unlink(ckpt_path)
+            # ONLY a solved (done=true, drained-pool) run retires its
+            # checkpoint — a surviving final checkpoint would make a
+            # later re-measurement campaign "resume" it and instantly
+            # re-report THESE counters as a fresh result. A
+            # budget-exhausted PARTIAL row keeps the checkpoint: it is
+            # recoverable in-flight progress, and a rerun with a larger
+            # TTS_BUDGET_S extends it instead of starting over
+            # (ADVICE.md round 5, the unconditional unlink made partial
+            # progress unrecoverable).
+            if row.get("done") is True:
+                unlink_checkpoint(ckpt_path)
+            elif os.path.exists(ckpt_path):
+                print(f"ta{inst:03d} lb{lb}: budget exhausted — keeping "
+                      f"checkpoint {ckpt_path} for a larger-budget rerun",
+                      flush=True)
             row.pop("kind", None)
             row.pop("t", None)
             row["restarts"] = restarts
@@ -366,14 +466,27 @@ def main():
                     done[(r["inst"], r["lb"], r.get("chunk", CHUNK))] = r
     insts = [int(x) for x in sys.argv[1:]]
     for inst in insts:
-        if (inst, LB, CHUNK) in done:
-            r = done[(inst, LB, CHUNK)]
-            print(f"ta{inst:03d} lb{LB}: already done "
+        r = done.get((inst, LB, CHUNK))
+        # the skip key includes done/budget, not just (inst, lb, chunk):
+        # a PARTIAL row only retires its instance up to the budget it
+        # was measured at — a rerun with a larger TTS_BUDGET_S resumes
+        # the kept checkpoint and extends it (ADVICE.md round 5: the old
+        # key silently skipped exactly the reruns partial rows exist
+        # for)
+        if r is not None and (r.get("done", True)
+                              or float(r.get("budget_s", BUDGET_S))
+                              >= BUDGET_S):
+            tag = "done" if r.get("done", True) else \
+                f"partial at budget {r.get('budget_s')}s"
+            print(f"ta{inst:03d} lb{LB}: already {tag} "
                   f"(chunk={r.get('chunk', CHUNK)} "
-                  f"budget={r.get('budget_s', '?')} "
                   f"t={r['elapsed_s']}s tree={r['tree']}), skipping",
                   flush=True)
             continue
+        if r is not None:
+            print(f"ta{inst:03d} lb{LB}: extending partial row "
+                  f"(budget {r.get('budget_s')}s -> {BUDGET_S:.0f}s)",
+                  flush=True)
         print(f"ta{inst:03d} lb{LB}: solving (budget {BUDGET_S:.0f}s)...",
               flush=True)
         row = supervise(inst, LB)

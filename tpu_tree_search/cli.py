@@ -71,6 +71,23 @@ def _pfsp_parser(sub):
     p.add_argument("--grow-capacity", type=int, default=None,
                    help="re-home a resumed checkpoint into a larger pool "
                         "(recovery after an overflow abort)")
+    from .utils import config as _cfg
+    p.add_argument("--retry-attempts", type=int, default=None,
+                   help="transient-error retries per segment operation "
+                        f"(default {_cfg.RETRY_ATTEMPTS_DEFAULT}; "
+                        "exponential backoff base "
+                        f"{_cfg.RETRY_BASE_S_DEFAULT}s — also via "
+                        "TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S)")
+    p.add_argument("--segment-timeout", type=float, default=None,
+                   help="per-segment wall-clock watchdog in seconds "
+                        "(0/default: off; a hung device dispatch raises "
+                        "instead of waiting forever — also via "
+                        "TTS_SEG_TIMEOUT_S)")
+    p.add_argument("--faults", type=str, default=None,
+                   help="deterministic fault-injection spec for "
+                        "resilience drills, e.g. "
+                        "'kill_after_segment=3,fail_host_fetch=1' "
+                        "(utils/faults.py; also via TTS_FAULTS)")
 
 
 def _nq_parser(sub):
@@ -117,6 +134,16 @@ def run_pfsp(args) -> int:
         args.capacity = device.default_capacity(jobs, machines)
     init_ub = taillard.optimal_makespan(args.inst) if args.ub == 1 else None
     n_dev = args.D if args.D > 0 else len(jax.devices())
+    # resilience knobs travel as env so every run_segmented in the call
+    # tree (direct, distributed.search's, a respawned campaign worker's)
+    # sees the same policy
+    if getattr(args, "retry_attempts", None) is not None:
+        os.environ["TTS_RETRY_ATTEMPTS"] = str(args.retry_attempts)
+    if getattr(args, "segment_timeout", None) is not None:
+        os.environ["TTS_SEG_TIMEOUT_S"] = str(args.segment_timeout)
+    if getattr(args, "faults", None):
+        from .utils import faults
+        faults.configure(args.faults)
     # -C composes with EVERY tier: single-device (hybrid.search),
     # single-device segmented (_run_pfsp_segmented's host session),
     # multi-device and the segmented/checkpointed flagship
@@ -356,8 +383,15 @@ def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0,
     warm_tree = warm_sol = 0
     h_prmu = np.zeros((0, jobs), np.int16)
     h_depth = np.zeros(0, np.int16)
-    if args.checkpoint and os.path.exists(args.checkpoint):
-        state, meta = checkpoint.load(args.checkpoint, p_times=p)
+    if args.checkpoint and checkpoint.resume_path(args.checkpoint):
+        # load_resilient: a torn snapshot rolls back to its rotating
+        # last-good sibling; a stacked (distributed) snapshot collapses
+        # onto this single device via the same elastic reshard a
+        # mesh-size change uses
+        state, meta, _ = checkpoint.load_resilient(args.checkpoint,
+                                                   p_times=p)
+        state = checkpoint.collapse_to_single_device(state, args.chunk,
+                                                     jobs)
         if args.grow_capacity:
             state = checkpoint.grow(state, args.grow_capacity)
         warm_tree = int(meta.get("warmup_tree", 0))

@@ -16,16 +16,117 @@ reference also lacks.
 from __future__ import annotations
 
 import dataclasses
+import os
 import pathlib
+import threading
 import time
+import warnings
+import zipfile
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import faults
 from .device import SearchState
 
 
 POOL_FIELDS = ("prmu", "depth", "aux")
+
+# Checkpoint schema version, embedded in every file. Loaders accept
+# every version <= CURRENT (older layouts upgrade on load: row-major
+# pools transpose, pre-aux files reconstruct); a file from a NEWER
+# schema fails loudly (CheckpointSchemaError) instead of being
+# misparsed as garbage state.
+#   1 (implicit): row-major full-pool snapshots, no aux, no meta
+#   2: feature-major live-row snapshots + capacity/pool_layout meta
+#   3: = 2 plus embedded CRC32 + explicit schema version
+SCHEMA_VERSION = 3
+
+LAST_GOOD_SUFFIX = ".prev"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """The checkpoint file is torn/corrupt (bad zip, CRC mismatch,
+    missing members). load_resilient treats this as 'skip to the
+    last-good snapshot', never 'resume wrong state'."""
+
+
+class CheckpointSchemaError(RuntimeError):
+    """The checkpoint was written by a NEWER schema than this build
+    reads. Not corruption — falling back to an older snapshot would
+    silently discard valid progress, so this is never swallowed."""
+
+
+class SegmentTimeout(RuntimeError):
+    """A segment exceeded its wall-clock watchdog. Deliberately NOT a
+    transient error: a hung device dispatch does not unhang on retry —
+    the caller (campaign supervisor) must kill and respawn the process."""
+
+
+def _transient_errors() -> tuple:
+    """Error types worth retrying: host/filesystem I/O, injected faults,
+    and the runtime's transport errors (a dropped remote-TPU tunnel
+    surfaces as XlaRuntimeError, an OSError subclass in some versions)."""
+    errs = [OSError, faults.InjectedFault]
+    try:
+        from jax.errors import JaxRuntimeError
+        errs.append(JaxRuntimeError)
+    except ImportError:
+        pass
+    return tuple(errs)
+
+
+TRANSIENT_ERRORS = _transient_errors()
+
+
+def _retry(fn, what: str, attempts: int, base_s: float):
+    """Run `fn` with exponential-backoff retry on transient errors.
+    Non-transient exceptions (wrong answers, schema errors, timeouts)
+    propagate immediately — retrying a deterministic failure only
+    delays the loud abort."""
+    for attempt in range(max(attempts, 1)):
+        try:
+            return fn()
+        except TRANSIENT_ERRORS as e:
+            if attempt >= attempts - 1:
+                raise
+            delay = base_s * (2 ** attempt)
+            warnings.warn(
+                f"transient {what} failure "
+                f"(attempt {attempt + 1}/{attempts}): {e!r}; "
+                f"retrying in {delay:.2f}s", RuntimeWarning, stacklevel=2)
+            time.sleep(delay)
+
+
+def _with_watchdog(fn, timeout_s: float | None, what: str):
+    """Run `fn` under a wall-clock watchdog: raises SegmentTimeout if it
+    exceeds `timeout_s` (None/0 disables). The work runs on a daemon
+    thread so a genuinely hung device call cannot also hang process
+    exit — the supervisor's kill+respawn remains the recovery path; the
+    timeout just converts a silent infinite wait into a loud error."""
+    if not timeout_s or timeout_s <= 0:
+        return fn()
+    box: dict = {}
+
+    def target():
+        try:
+            box["result"] = fn()
+        except BaseException as e:      # noqa: BLE001 — re-raised below
+            box["error"] = e
+
+    th = threading.Thread(target=target, daemon=True,
+                          name="tts-segment-watchdog")
+    th.start()
+    th.join(timeout_s)
+    if th.is_alive():
+        raise SegmentTimeout(
+            f"{what} exceeded the {timeout_s:.1f}s wall-clock watchdog "
+            "(hung device dispatch?); kill and resume from the last "
+            "checkpoint")
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
 
 
 def _to_np(x) -> np.ndarray:
@@ -44,10 +145,49 @@ def _fetch_many(xs: tuple) -> tuple:
     a single device_get puts all transfers in flight together, so the
     batch costs ~one latency instead of len(xs). Multihost shards fall
     back to the collective allgather path per leaf."""
+    faults.fire("host_fetch")      # deterministic transient-error hook
     if any(not getattr(x, "is_fully_addressable", True) for x in xs):
         return tuple(_to_np(x) for x in xs)
     import jax
     return tuple(np.asarray(v) for v in jax.device_get(xs))
+
+
+def _payload_crc(arrays: dict) -> int:
+    """CRC32 over every stored array's name, dtype, shape and raw bytes
+    (sorted by name, `meta_crc32` itself excluded) — the end-to-end
+    integrity check a torn write or bit flip cannot survive. The zip
+    layer's per-member CRCs already catch most damage; this one also
+    covers damage the zip container cannot see (a member swapped in
+    whole, an interrupted rewrite that left a stale-but-valid zip)."""
+    crc = 0
+    for name in sorted(arrays):
+        if name == "meta_crc32":
+            continue
+        a = np.ascontiguousarray(np.asarray(arrays[name]))
+        crc = zlib.crc32(name.encode(), crc)
+        crc = zlib.crc32(str(a.dtype).encode(), crc)
+        crc = zlib.crc32(np.asarray(a.shape, np.int64).tobytes(), crc)
+        crc = zlib.crc32(a.tobytes(), crc)
+    return crc & 0xFFFFFFFF
+
+
+def last_good_path(path: str | pathlib.Path) -> pathlib.Path:
+    """The rotating last-good snapshot that rides beside `path`."""
+    path = pathlib.Path(path)
+    return path.with_name(path.name + LAST_GOOD_SUFFIX)
+
+
+def resume_path(path: str | pathlib.Path) -> pathlib.Path | None:
+    """The file a resume should try first: `path` if present, else its
+    last-good sibling (the current file vanished mid-rotation), else
+    None (nothing to resume — a stale .tmp from an interrupted first
+    save is NOT resumable: it was never fsync'd + renamed, so its
+    contents carry no durability promise)."""
+    path = pathlib.Path(path)
+    if path.exists():
+        return path
+    prev = last_good_path(path)
+    return prev if prev.exists() else None
 
 
 def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
@@ -59,6 +199,14 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     (fetching + compressing the full arrays made checkpoints cost more
     than the segments they protected). The declared capacity is kept in
     the file so load() re-homes the rows into an identical pool.
+
+    Torn-write-proof by construction: the bytes (with an embedded CRC32
+    + schema version) go to a temp file that is flushed and fsync'd
+    BEFORE any rename; the previous snapshot rotates to a `.prev`
+    last-good sibling and the temp file renames into place. A crash at
+    any point leaves either the old snapshot, the rotated last-good, or
+    the new snapshot — never a half-written file under the resume path
+    (load_resilient picks the newest loadable one).
     """
     sizes = np.atleast_1d(_to_np(state.size))
     n = int(sizes.max())
@@ -70,9 +218,11 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     arrays["meta_capacity"] = np.asarray(state.prmu.shape[-1])
     arrays["meta_pool_layout"] = np.asarray(1)   # 1 = feature-major
     if meta:
-        if "capacity" in meta:
-            raise ValueError("meta key 'capacity' is reserved for the "
-                             "pool re-home size")
+        reserved = {"capacity", "pool_layout", "schema_version", "crc32"} \
+            & meta.keys()
+        if reserved:
+            raise ValueError(f"meta keys {sorted(reserved)} are reserved "
+                             "by the checkpoint format")
         for k, v in meta.items():
             arrays[f"meta_{k}"] = np.asarray(v)
     # Multi-controller: every process reaches this point (the _to_np
@@ -84,21 +234,84 @@ def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None)
     import jax
     if jax.process_index() != 0:
         return
+    arrays["meta_schema_version"] = np.asarray(SCHEMA_VERSION)
+    arrays["meta_crc32"] = np.asarray(_payload_crc(arrays), np.uint32)
     path = pathlib.Path(path)
     tmp = path.with_suffix(".tmp.npz")
-    np.savez_compressed(tmp, **arrays)
-    tmp.rename(path)
+    with open(tmp, "wb") as f:
+        np.savez_compressed(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    # rotate current -> last-good, then temp -> current. Both renames
+    # are atomic; a kill between them leaves no current file and
+    # resume_path/load_resilient fall back to the last-good sibling.
+    if path.exists():
+        os.replace(path, last_good_path(path))
+    os.replace(tmp, path)
+    try:
+        # fsync the directory so the renames themselves are durable
+        # (without it a power loss can resurrect the pre-rename view)
+        dfd = os.open(path.parent or pathlib.Path("."), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass   # not every filesystem supports directory fsync
 
 
 def load(path: str | pathlib.Path,
          p_times: np.ndarray | None = None) -> tuple[SearchState, dict]:
-    """Load a snapshot. Pre-aux checkpoints (before the pool carried
-    per-node [front | remain] tables) are upgraded on load by
-    reconstructing aux from the live rows — pass the instance's
-    `p_times` for that; without it such files raise a clear error."""
-    with np.load(pathlib.Path(path)) as z:
-        arrays = {f: z[f] for f in SearchState._fields if f in z.files}
-        meta = {k[5:]: z[k] for k in z.files if k.startswith("meta_")}
+    """Load a snapshot, verifying integrity first. Pre-aux checkpoints
+    (before the pool carried per-node [front | remain] tables) are
+    upgraded on load by reconstructing aux from the live rows — pass the
+    instance's `p_times` for that; without it such files raise a clear
+    error.
+
+    Raises CheckpointCorrupt on a torn/damaged file (bad zip, CRC
+    mismatch, missing members — every read error, so a caller never
+    resumes wrong state) and CheckpointSchemaError on a file written by
+    a newer schema than this build reads."""
+    path = pathlib.Path(path)
+    try:
+        with np.load(path) as z:
+            # full materialization doubles as the zip-member CRC pass
+            # (zipfile verifies each member's own CRC as it inflates)
+            raw = {k: z[k] for k in z.files}
+    except (zipfile.BadZipFile, zlib.error, OSError, EOFError, ValueError,
+            KeyError) as e:
+        # zipfile errors can embed whole raw headers — keep the reason
+        # human-sized, the chained exception preserves the full detail
+        reason = str(e)
+        if len(reason) > 200:
+            reason = reason[:200] + "... [truncated]"
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is unreadable (torn write or "
+            f"corruption): {reason}") from e
+    version = int(raw.get("meta_schema_version", 2 if "meta_capacity"
+                          in raw else 1))
+    if version > SCHEMA_VERSION:
+        raise CheckpointSchemaError(
+            f"checkpoint {path} uses schema version {version}; this "
+            f"build reads <= {SCHEMA_VERSION} — upgrade the reader, do "
+            "not fall back to an older snapshot")
+    if "meta_crc32" in raw:
+        want = int(raw["meta_crc32"])
+        got = _payload_crc(raw)
+        if got != want:
+            raise CheckpointCorrupt(
+                f"checkpoint {path} failed its embedded CRC32 "
+                f"(stored {want:#010x}, recomputed {got:#010x})")
+    missing = [f for f in SearchState._fields
+               if f != "aux" and f not in raw]
+    if missing:
+        raise CheckpointCorrupt(
+            f"checkpoint {path} is missing state fields {missing} "
+            "(truncated or partial write)")
+    arrays = {f: raw[f] for f in SearchState._fields if f in raw}
+    meta = {k[5:]: raw[k] for k in raw if k.startswith("meta_")}
+    meta.pop("schema_version", None)
+    meta.pop("crc32", None)
     feature_major = bool(meta.pop("pool_layout", 0))
     if not feature_major:
         # legacy row-major snapshot: transpose pool matrices on load; a
@@ -148,43 +361,180 @@ def load(path: str | pathlib.Path,
     return state, meta
 
 
-def aux_dtype_of(path) -> np.dtype:
-    """The aux dtype a resume of `path` will end up with, read from the
-    zip member's npy HEADER only (decompressing the array to learn its
-    dtype costs a full second pass over a possibly multi-hundred-MB
-    member). Legacy pre-aux checkpoints reconstruct as int32 (load()
-    above). Lives here because it encodes this module's file format."""
-    import zipfile
+def load_resilient(path: str | pathlib.Path,
+                   p_times: np.ndarray | None = None
+                   ) -> tuple[SearchState, dict, pathlib.Path]:
+    """Load `path`, falling back to its rotating last-good sibling when
+    the current file is torn/corrupt (or missing after an interrupted
+    rotation). Returns (state, meta, loaded_path) — callers that priced
+    anything off the file (aux dtype, capacity) must use `loaded_path`,
+    not `path`.
 
-    with zipfile.ZipFile(path) as zf:
-        if "aux.npy" not in zf.namelist():
-            return np.dtype(np.int32)
+    A corrupt current snapshot costs at most the work since the
+    PREVIOUS checkpoint; it never poisons the run. Only when every
+    candidate is unreadable does this raise, listing what was tried.
+    CheckpointSchemaError is deliberately not caught: a valid
+    newer-schema file must not be silently shadowed by an older one."""
+    path = pathlib.Path(path)
+    candidates = [path, last_good_path(path)]
+    errors = []
+    for cand in candidates:
+        if not cand.exists():
+            errors.append(f"{cand}: missing")
+            continue
         try:
-            with zf.open("aux.npy") as f:
-                version = np.lib.format.read_magic(f)
-                if version == (1, 0):
-                    _, _, dtype = np.lib.format.read_array_header_1_0(f)
-                elif version == (2, 0):
-                    _, _, dtype = np.lib.format.read_array_header_2_0(f)
-                else:
-                    # (3, 0) headers (utf8 field names) share the 2.0
-                    # wire format for plain dtypes; parse via numpy's
-                    # version-dispatching reader when present, else the
-                    # 2.0 reader
-                    read = getattr(np.lib.format, "_read_array_header",
-                                   None)
-                    if read is not None:
-                        _, _, dtype = read(f, version)
-                    else:
-                        _, _, dtype = \
-                            np.lib.format.read_array_header_2_0(f)
-        except (ValueError, OSError) as e:
-            # a corrupt/truncated member must surface as a clear resume
-            # error, not an uncaught header-parse exception mid-load
-            raise RuntimeError(
-                f"unreadable aux.npy header in checkpoint {path}: {e}"
-            ) from e
-    return np.dtype(dtype)
+            state, meta = load(cand, p_times=p_times)
+        except CheckpointCorrupt as e:
+            warnings.warn(
+                f"skipping corrupt checkpoint {cand}: {e}",
+                RuntimeWarning, stacklevel=2)
+            errors.append(f"{cand}: {e}")
+            if cand == path:
+                # Quarantine the torn CURRENT file: leaving it in place
+                # lets the next save() rotate it over the good
+                # last-good, and a crash between save's two renames
+                # would then leave nothing loadable at all. Renamed
+                # aside (not unlinked) so the damage stays available
+                # for forensics. Process 0 only — on a multi-controller
+                # shared filesystem every process runs this resume path
+                # and concurrent renames of one file race.
+                try:
+                    import jax
+                    if jax.process_index() == 0:
+                        os.replace(cand, str(cand) + ".corrupt")
+                except OSError:
+                    pass
+            continue
+        if cand != path:
+            warnings.warn(
+                f"resuming from last-good snapshot {cand} (current "
+                "checkpoint torn/missing); work since the previous "
+                "checkpoint interval will be redone",
+                RuntimeWarning, stacklevel=2)
+        return state, meta, cand
+    raise CheckpointCorrupt(
+        "no loadable checkpoint: " + "; ".join(errors))
+
+
+def reshard_state(state: SearchState, new_workers: int,
+                  squeeze: bool = False) -> SearchState:
+    """Elastic resume: re-home an N-worker stacked snapshot (or a
+    single-device one) onto `new_workers` pools, so a preempted job
+    restarts on whatever slice is available (M < N and M > N both
+    work — the failure mode real fleets actually have is "came back
+    with a different topology").
+
+    Host-side and lossless: every worker's live rows (rows [0, size) by
+    the pool invariant) are concatenated and round-robin striped across
+    the M new pools — the same water-filling split the balance
+    exchange converges to (parallel/balance.waterfill_counts: per-pool
+    counts differ by <= 1) and the same striping idiom as warm-up
+    seeding (distributed._shard_frontier). Capacity doubles as needed
+    so the widest stripe fits; callers with tighter usable-row limits
+    (scratch margins, balance headroom) grow() further on top.
+
+    Counter semantics across the reshard:
+    - tree/sol/evals/sent/recv/steals: global totals preserved — summed
+      onto worker 0 (only the totals are ever reported; per-worker
+      attribution does not survive a topology change by definition);
+    - iters: replicated at the old max, so a cumulative per-worker
+      iteration ceiling keeps meaning "this much MORE work per worker";
+    - best: min-replicated (the incumbent is global);
+    - overflow: cleared — the resumed run's first step re-detects a
+      genuinely over-full pool via the same lossless no-commit path.
+
+    `squeeze=True` with new_workers=1 returns an UNSTACKED single-device
+    state (the shape device.run expects) instead of a (1, ...) stack.
+    """
+    if new_workers < 1:
+        raise ValueError(f"new_workers must be >= 1, got {new_workers}")
+    if squeeze and new_workers != 1:
+        raise ValueError("squeeze=True requires new_workers == 1")
+    from ..parallel import balance as bal
+
+    arrs = SearchState(*(np.asarray(x) for x in state))
+    if arrs.prmu.ndim == 2:            # single-device snapshot: lift
+        arrs = SearchState(*(a[None, ...] for a in arrs))
+    if arrs.prmu.ndim != 3:
+        raise ValueError(
+            f"reshard_state needs a (D, jobs, capacity) stacked or "
+            f"(jobs, capacity) single-device pool, got {arrs.prmu.shape}")
+    D, jobs, capacity = arrs.prmu.shape
+    A = arrs.aux.shape[1]
+    M = new_workers
+    sizes = np.atleast_1d(arrs.size).astype(np.int64)
+
+    # concatenate live rows in worker order (bottom-to-top per pool)
+    live_prmu = np.concatenate(
+        [arrs.prmu[d, :, :sizes[d]] for d in range(D)], axis=1)
+    live_depth = np.concatenate(
+        [arrs.depth[d, :sizes[d]] for d in range(D)])
+    live_aux = np.concatenate(
+        [arrs.aux[d, :, :sizes[d]] for d in range(D)], axis=1)
+
+    total = int(sizes.sum())
+    counts = bal.waterfill_counts(total, M)
+    while counts.max() > capacity:
+        capacity *= 2
+
+    prmu = np.zeros((M, jobs, capacity), arrs.prmu.dtype)
+    depth = np.zeros((M, capacity), arrs.depth.dtype)
+    aux = np.zeros((M, A, capacity), arrs.aux.dtype)
+    for m in range(M):
+        stripe = slice(m, None, M)     # round-robin, water-filled
+        n = int(counts[m])
+        prmu[m, :, :n] = live_prmu[:, stripe]
+        depth[m, :n] = live_depth[stripe]
+        aux[m, :, :n] = live_aux[:, stripe]
+
+    def on_zero(total_val, dtype):
+        v = np.zeros(M, dtype)
+        v[0] = total_val
+        return v
+
+    out = SearchState(
+        prmu=prmu, depth=depth, aux=aux,
+        size=counts.astype(np.int32),
+        best=np.full(M, int(np.min(arrs.best)), np.int32),
+        tree=on_zero(int(np.sum(arrs.tree)), np.int64),
+        sol=on_zero(int(np.sum(arrs.sol)), np.int64),
+        iters=np.full(M, int(np.max(arrs.iters)), np.int64),
+        evals=on_zero(int(np.sum(arrs.evals)), np.int64),
+        sent=on_zero(int(np.sum(arrs.sent)), np.int64),
+        recv=on_zero(int(np.sum(arrs.recv)), np.int64),
+        steals=on_zero(int(np.sum(arrs.steals)), np.int64),
+        overflow=np.zeros(M, bool),
+    )
+    if squeeze:
+        out = SearchState(*(a[0] for a in out))
+    return SearchState(*(jnp.asarray(a) for a in out))
+
+
+def collapse_to_single_device(state: SearchState, chunk: int,
+                              jobs: int) -> SearchState:
+    """Collapse a stacked (D, jobs, cap) snapshot onto ONE device: the
+    elastic reshard to a single squeezed pool, pre-sized for the mesh
+    run's TOTAL footprint (D x per-worker capacity — the one pool now
+    carries every worker's rows and their future growth) and then
+    doubled until the live rows clear the usable-row limit
+    (device.row_limit's chunk*jobs scratch margin), so a nearly-full
+    stacked snapshot cannot overflow on its first resumed segment.
+    Shared by the CLI's and the campaign worker's resume paths — the
+    sizing invariant lives in exactly one place."""
+    from .device import row_limit
+
+    shape = np.asarray(state.prmu).shape
+    if len(shape) != 3:
+        return state                     # already single-device
+    stacked_total = int(shape[0] * shape[-1])
+    out = reshard_state(state, 1, squeeze=True)
+    grown = max(int(out.prmu.shape[-1]), stacked_total)
+    need = int(np.asarray(out.size).max())
+    while row_limit(grown, chunk, jobs) < max(need, 1):
+        grown *= 2
+    if grown != out.prmu.shape[-1]:
+        out = grow(out, grown)
+    return out
 
 
 class PoolOverflow(RuntimeError):
@@ -242,7 +592,10 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   raise_on_overflow: bool = True,
                   checkpoint_meta: dict | None = None,
                   post_segment=None,
-                  should_stop=None):
+                  should_stop=None,
+                  retry_attempts: int | None = None,
+                  retry_base_s: float | None = None,
+                  segment_timeout_s: float | None = None):
     """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
     bounded segments.
 
@@ -269,7 +622,40 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
       (after checkpointing, so the state is recoverable) unless
       `raise_on_overflow=False`, in which case the caller must check
       `state.overflow` before trusting the counters.
+
+    Resilience (the layer the reference lacks end to end): segment
+    execution, checkpoint writes and the per-segment scalar fetch are
+    retried `retry_attempts` times with exponential backoff
+    (`retry_base_s * 2^k`) on TRANSIENT errors only (I/O, runtime
+    transport, injected faults — see TRANSIENT_ERRORS); a
+    `segment_timeout_s` wall-clock watchdog converts a hung device
+    dispatch into a loud SegmentTimeout (never retried — the
+    supervisor's kill+respawn is the recovery for hangs). Defaults read
+    TTS_RETRY_ATTEMPTS (3), TTS_RETRY_BASE_S (0.5) and
+    TTS_SEG_TIMEOUT_S (0 = off). Deterministic fault injection for all
+    of these lives in utils/faults.py (TTS_FAULTS).
     """
+    from ..utils import config as _cfg
+    if retry_attempts is None:
+        retry_attempts = int(os.environ.get(
+            "TTS_RETRY_ATTEMPTS", _cfg.RETRY_ATTEMPTS_DEFAULT))
+    if retry_base_s is None:
+        retry_base_s = float(os.environ.get(
+            "TTS_RETRY_BASE_S", _cfg.RETRY_BASE_S_DEFAULT))
+    if segment_timeout_s is None:
+        segment_timeout_s = float(os.environ.get(
+            "TTS_SEG_TIMEOUT_S", _cfg.SEGMENT_TIMEOUT_S_DEFAULT))
+    import jax
+    if jax.process_count() > 1:
+        # Multi-controller: run_fn, save and the scalar fetch all
+        # contain COLLECTIVES (process_allgather, the SPMD loop). A
+        # per-process retry re-enters its collective alone while the
+        # other processes have moved on — mismatched collective order
+        # is a distributed hang, strictly worse than the transient it
+        # retries. Fail loudly instead; multihost recovery is
+        # restart-the-job-level (every process resumes from the shared
+        # checkpoint), not retry-in-place.
+        retry_attempts = 1
     t0 = time.perf_counter()
     seg = 0
     stalls = 0
@@ -281,19 +667,36 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
             else dict(checkpoint_meta or {})
         return {**base, "segment": seg}
 
+    def do_save(s, seg_no):
+        _retry(lambda: save(checkpoint_path, s, meta=meta_now(seg_no)),
+               "checkpoint save", retry_attempts, retry_base_s)
+        # torn-write / corruption injection targets the just-written
+        # file — the load-side rollback to last-good is what it tests
+        faults.fire("post_checkpoint", segment=seg_no,
+                    path=checkpoint_path)
+
     def final_save(s, seg):
         # every exit path must leave a CURRENT checkpoint — with
         # checkpoint_every > 1, returning without this leaves the file
         # up to checkpoint_every-1 segments stale and a planned
         # stop-then-resume silently redoes that work
         if checkpoint_path and seg % checkpoint_every != 0:
-            save(checkpoint_path, s, meta=meta_now(seg))
+            do_save(s, seg)
 
     while True:
         target = start_iters + (seg + 1) * segment_iters
         if max_total_iters is not None:
             target = min(target, start_iters + max_total_iters)
-        state = run_fn(state, target)
+        faults.fire("segment_start", segment=seg + 1)
+        # run_fn is functional (the incoming state is untouched on
+        # failure), so a retried segment redoes identical work; the
+        # watchdog wraps each attempt separately
+        prev_state = state
+        state = _retry(
+            lambda: _with_watchdog(
+                lambda: run_fn(prev_state, target),
+                segment_timeout_s, f"segment {seg + 1}"),
+            "segment execution", retry_attempts, retry_base_s)
         if post_segment is not None:
             state = post_segment(state)
         seg += 1
@@ -302,9 +705,16 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         # (~0.15 s on the tunnel; six of them cost ~0.9 s per segment —
         # measured as the gap between segment wall time and the compiled
         # loop's in-trace step cost, BENCHMARKS.md round 3)
-        fetched = _fetch_many((state.iters, state.tree, state.sol,
-                               state.size, state.best, state.steals,
-                               state.overflow))
+        # the watchdog must cover this fetch too: dispatch is ASYNC, so
+        # a hung device computation lets run_fn return its futures
+        # instantly and the block happens HERE, waiting on the results
+        fetched = _retry(
+            lambda: _with_watchdog(
+                lambda: _fetch_many((state.iters, state.tree, state.sol,
+                                     state.size, state.best, state.steals,
+                                     state.overflow)),
+                segment_timeout_s, f"segment {seg} result fetch"),
+            "per-segment host fetch", retry_attempts, retry_base_s)
         f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf = fetched
         iters = int(f_iters.max())
         tree = int(f_tree.sum())
@@ -322,7 +732,15 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         if heartbeat is not None:
             heartbeat(report)
         if checkpoint_path and seg % checkpoint_every == 0:
-            save(checkpoint_path, state, meta=meta_now(seg))
+            do_save(state, seg)
+        # preemption injection point: fires at the END of segment k,
+        # after any checkpoint that segment wrote. Deliberately NOT
+        # checkpoint-aligned — real preemptions are not either; with
+        # checkpoint_every > 1 the on-disk snapshot may be up to
+        # checkpoint_every-1 segments older and recovery redoes that
+        # interval (the kill-then-resume-elsewhere shape elastic
+        # resume exists for)
+        faults.fire("post_segment", segment=seg)
         if bool(f_ovf.any()):
             final_save(state, seg)
             if raise_on_overflow:

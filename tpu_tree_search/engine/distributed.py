@@ -539,9 +539,15 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     host_fraction-th warm-up node (on resume: rows carved off the top of
     the checkpointed pools), incumbents merged both ways at every
     segment boundary — a host tier forces segmented execution so the
-    exchange points exist."""
-    import os
+    exchange points exist.
 
+    Resume is ELASTIC: a checkpoint written by an N-worker mesh loads
+    on whatever mesh is available — the pools are resharded
+    (checkpoint.reshard_state: concatenate + water-fill) when worker
+    counts differ, so a preempted job restarts on a smaller or larger
+    slice with no explored node lost. A torn/corrupt current snapshot
+    rolls back to its rotating last-good sibling
+    (checkpoint.load_resilient) instead of poisoning the run."""
     from . import checkpoint, hybrid
 
     if mesh is None:
@@ -552,14 +558,16 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         tables = batched.make_tables(p_times)
     from .device import aux_dtype as _aux_dtype
     adt = _aux_dtype(p_times)
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        # resume keeps the SAVED pools' aux dtype (an old int32-aux
-        # checkpoint stays int32, and a pre-aux legacy file is
-        # RECONSTRUCTED as int32 by checkpoint.load), so the balance
-        # byte budget must be priced off the file, not the fresh-run
-        # dtype. Only the npy header is read — np.load()[...] would
-        # decompress the whole array for one .dtype attribute.
-        adt = checkpoint.aux_dtype_of(checkpoint_path)
+    resumed = None
+    if checkpoint_path and checkpoint.resume_path(checkpoint_path):
+        # load BEFORE sizing the balance buffers: resume keeps the
+        # SAVED pools' aux dtype (an old int32-aux checkpoint stays
+        # int32, and a pre-aux legacy file is RECONSTRUCTED as int32 by
+        # checkpoint.load), so the byte budget must be priced off the
+        # loaded state, not the fresh-run dtype
+        resumed = checkpoint.load_resilient(checkpoint_path,
+                                            p_times=p_times)[:2]
+        adt = np.asarray(resumed[0].aux).dtype
     if transfer_cap is None:
         transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
                                             mesh.devices.size,
@@ -577,14 +585,27 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     session = None
     h_prmu = np.zeros((0, jobs), np.int16)
     h_depth = np.zeros(0, np.int16)
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        host_state, meta = checkpoint.load(checkpoint_path, p_times=p_times)
-        if np.asarray(host_state.prmu).ndim != 3 \
-                or host_state.prmu.shape[0] != n_dev:
-            raise ValueError(
-                f"checkpoint {checkpoint_path} holds "
-                f"{np.asarray(host_state.prmu).shape} pools; resume needs "
-                f"the same worker count (mesh has {n_dev})")
+    if resumed is not None:
+        host_state, meta = resumed
+        shape = np.asarray(host_state.prmu).shape
+        if len(shape) != 3 or shape[0] != n_dev:
+            # elastic resume: re-split the snapshot's pools across THIS
+            # mesh (preemption rarely hands back the same topology)
+            old_workers = shape[0] if len(shape) == 3 else 1
+            import warnings
+            warnings.warn(
+                f"resharding checkpoint {checkpoint_path} from "
+                f"{old_workers} to {n_dev} workers (elastic resume)",
+                RuntimeWarning, stacklevel=2)
+            host_state = checkpoint.reshard_state(host_state, n_dev)
+        # re-home into a capacity whose usable-row limit (scratch margin
+        # + balance headroom) covers the fullest resharded pool
+        cap0 = cap = host_state.prmu.shape[-1]
+        need = int(np.asarray(host_state.size).max())
+        while driver.limit(cap) < max(need, 1):
+            cap *= 2
+        if cap != cap0:
+            host_state = checkpoint.grow(host_state, cap)
         # a checkpoint written by a -C run carries the host tier's seed
         # nodes (they were carved OUT of the pools): resume must either
         # re-seed the session from them or push them back — dropping

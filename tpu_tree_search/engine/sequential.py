@@ -21,6 +21,7 @@ a solution.
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -40,16 +41,23 @@ class SearchResult:
     explored_tree: int
     explored_sol: int
     best: int
+    complete: bool = True   # False: truncated (max_nodes / deadline_s)
 
 
 def pfsp_search(instance: PFSPInstance, lb: int = LB1,
                 init_ub: int | None = None,
-                max_nodes: int | None = None) -> SearchResult:
+                max_nodes: int | None = None,
+                deadline_s: float | None = None) -> SearchResult:
     """Depth-first B&B over one PFSP instance (reference: pfsp_c.c:26-73).
 
     `init_ub=None` means an infinite initial incumbent (`-u 0`); pass the
     known optimum for the `-u 1` mode. `max_nodes` caps popped nodes for
-    truncated-search tests (None = run to completion).
+    truncated-search tests (None = run to completion). `deadline_s` is a
+    wall-clock budget: the Python oracle is the slowest component of
+    every verification run, and an oracle call that outgrows its test
+    budget should degrade to a truncated result (complete=False) a
+    caller can detect, not hang the suite — the same fail-loud posture
+    the engine's own watchdog takes (engine/checkpoint.run_segmented).
     """
     jobs, machines = instance.jobs, instance.machines
     lb1 = ref.make_lb1_data(instance.p_times)
@@ -64,9 +72,14 @@ def pfsp_search(instance: PFSPInstance, lb: int = LB1,
         (np.arange(jobs, dtype=np.int16), 0)
     ]
     popped = 0
+    deadline = (None if deadline_s is None
+                else time.perf_counter() + deadline_s)
 
     while stack:
         if max_nodes is not None and popped >= max_nodes:
+            break
+        if (deadline is not None and popped % 256 == 0
+                and time.perf_counter() > deadline):
             break
         prmu, depth = stack.pop()
         popped += 1
@@ -93,7 +106,8 @@ def pfsp_search(instance: PFSPInstance, lb: int = LB1,
                 stack.append((child, depth + 1))
                 tree += 1
 
-    return SearchResult(explored_tree=tree, explored_sol=sol, best=best)
+    return SearchResult(explored_tree=tree, explored_sol=sol, best=best,
+                        complete=not stack)
 
 
 def nqueens_search(n: int, g: int = 1,
@@ -121,4 +135,5 @@ def nqueens_search(n: int, g: int = 1,
     # `g` only scales the safety-check work in the reference; results are
     # independent of it, so the oracle ignores it.
     del g
-    return SearchResult(explored_tree=tree, explored_sol=sol, best=sol)
+    return SearchResult(explored_tree=tree, explored_sol=sol, best=sol,
+                        complete=not stack)

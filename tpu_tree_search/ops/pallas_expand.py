@@ -272,11 +272,18 @@ def kernel_ok(jobs: int, eff_tile: int, lb_kind: int,
     return (eff_tile >= min_tile(jobs)
             # lane-aligned reshapes: the kernel's (J, TB) -> (1, J*TB)
             # flattening needs the flat lane count 128-aligned; TB
-            # itself only has to be 128-aligned below the jobs >= 128
-            # floor (TB=64 at even J keeps J*TB aligned — validated on
-            # hardware at 200x20, tests/test_pallas_tpu.py)
+            # itself only has to be 128-aligned down to the hardware-
+            # validated TB=64 family (min_tile's jobs >= 128 floor,
+            # J*64 still 128-aligned at even J — validated bit-exact at
+            # 200x20, tests/test_pallas_tpu.py). A trusted
+            # caller-supplied tile below 64 (TB=32, TB=16...) can also
+            # satisfy the raw (jobs*eff_tile) % 128 == 0 arithmetic,
+            # but no such mosaic layout has ever run on hardware —
+            # admit ONLY the validated family and let everything else
+            # take the XLA fallback (ADVICE.md round 5).
             and (eff_tile % 128 == 0
-                 or (jobs >= 128 and (jobs * eff_tile) % 128 == 0))
+                 or (jobs >= 128 and eff_tile == 64
+                     and (jobs * eff_tile) % 128 == 0))
             and jobs * eff_tile <= lane_cap
             and (machines is None
                  or jobs * machines * eff_tile <= EXPAND_TILE_UNITS))

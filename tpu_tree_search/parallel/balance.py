@@ -32,6 +32,27 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def waterfill_counts(total: int, m: int) -> np.ndarray:
+    """(m,) per-worker pool sizes for an m-way water-filled split of
+    `total` nodes: the terminal fixed point exchange_plan's
+    surplus/deficit flow converges to (max-min difference <= 1, lower
+    worker ids carry the remainder — exactly the counts a round-robin
+    stripe `d::m` produces, matching the warm-up seeding's
+    roundRobin_distribution idiom).
+
+    Host-side numpy on purpose: this is the elastic-resume half of the
+    water-filling machinery (engine/checkpoint.reshard_state re-splits
+    an N-worker snapshot across M workers with it), which runs on the
+    host between segments, not inside the compiled loop."""
+    if m < 1:
+        raise ValueError(f"m must be >= 1, got {m}")
+    if total < 0:
+        raise ValueError(f"total must be >= 0, got {total}")
+    return (total // m
+            + (np.arange(m) < total % m).astype(np.int64))
 
 
 def exchange_plan(sizes: jax.Array, cap: int, min_transfer: int) -> jax.Array:

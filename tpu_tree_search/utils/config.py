@@ -11,6 +11,16 @@ from __future__ import annotations
 
 import dataclasses
 
+# Resilience defaults — THE single source for engine/checkpoint.
+# run_segmented's env fallbacks (TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S /
+# TTS_SEG_TIMEOUT_S) and PFSPConfig below both read these, so the
+# documented knob and the actual behavior cannot drift apart. Module
+# constants (not the dataclass) because engine code importing the
+# dataclass for three scalars would be the wrong direction of coupling.
+RETRY_ATTEMPTS_DEFAULT = 3
+RETRY_BASE_S_DEFAULT = 0.5
+SEGMENT_TIMEOUT_S_DEFAULT = 0.0   # 0 = watchdog off
+
 
 @dataclasses.dataclass
 class PFSPConfig:
@@ -36,6 +46,12 @@ class PFSPConfig:
     capacity: int = 1 << 20   # per-device pool rows
     balance_period: int = 4   # steps between collective balance rounds
     csv: str | None = None    # append a reference-schema CSV row here
+    # Resilience knobs deliberately do NOT live on this dataclass: the
+    # override channel is env vars (TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S
+    # / TTS_SEG_TIMEOUT_S / TTS_FAULTS) or CLI flags, because the
+    # campaign supervisor's worker subprocesses must inherit them across
+    # respawns — a Python object cannot ride a respawn. The defaults are
+    # the module constants above.
 
     @property
     def balancing_enabled(self) -> bool:

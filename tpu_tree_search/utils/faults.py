@@ -1,0 +1,173 @@
+"""Deterministic fault injection for the resilience layer.
+
+None of the recovery paths (checkpoint rollback, segment retry, campaign
+respawn, elastic resume) can be trusted without a way to make the
+failures happen on demand. This module is that way: a handful of named
+injection points threaded through the segmented driver
+(engine/checkpoint.run_segmented), the host-fetch path
+(checkpoint._fetch_many) and the campaign supervisor
+(tools/run_campaign.py), each firing deterministically from an
+env-/config-driven plan — so every fault a production run can hit has a
+repeatable test (tests/test_resilience.py).
+
+The plan is declared as a comma-separated spec, either via the
+``TTS_FAULTS`` environment variable (it survives the campaign
+supervisor's worker respawns — the worker subprocess inherits it) or
+programmatically via :func:`configure`:
+
+    TTS_FAULTS="kill_after_segment=3"        # os._exit(137) after seg 3's
+                                             # checkpoint (preemption)
+    TTS_FAULTS="corrupt_checkpoint=2"        # flip bytes in the file
+                                             # written at segment 2
+                                             # (torn/corrupt write)
+    TTS_FAULTS="delay_segment=2:1.5"         # sleep 1.5 s before seg 2
+                                             # (slow dispatch)
+    TTS_FAULTS="fail_host_fetch=1"           # first 1 host fetches raise
+                                             # InjectedFault (transient
+                                             # device/tunnel error)
+
+Specs compose: ``"delay_segment=2:0.1,kill_after_segment=4"``. Unknown
+names raise at parse time — a typo'd fault spec that silently injects
+nothing would green-light an untested recovery path.
+
+Counters ("once" semantics, e.g. fail_host_fetch) are per-process: a
+respawned worker re-arms them, which is exactly the transient-error
+model (the retried operation succeeds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+
+class InjectedFault(RuntimeError):
+    """A deliberately injected transient fault (retryable by design)."""
+
+
+# exit code used by the kill injection; distinct from Python tracebacks
+# (1) and the campaign's wrong-answer abort (3), and conventionally
+# SIGKILL's 128+9 — what a real preemption looks like to the supervisor
+KILL_EXIT_CODE = 137
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """Parsed injection plan; all fields optional (None/0 = disarmed)."""
+
+    kill_after_segment: int | None = None    # os._exit after this segment
+    corrupt_checkpoint: int | None = None    # flip bytes in the file
+                                             # written at this segment
+    delay_segment: tuple[int, float] | None = None   # (segment, seconds)
+    fail_host_fetch: int = 0                 # fail the first N fetches
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        plan = cls()
+        for item in spec.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            name, _, val = item.partition("=")
+            name = name.strip()
+            if name == "kill_after_segment":
+                plan.kill_after_segment = int(val)
+            elif name == "corrupt_checkpoint":
+                plan.corrupt_checkpoint = int(val)
+            elif name == "delay_segment":
+                seg, _, secs = val.partition(":")
+                plan.delay_segment = (int(seg), float(secs or 0.1))
+            elif name == "fail_host_fetch":
+                plan.fail_host_fetch = int(val)
+            else:
+                raise ValueError(
+                    f"unknown fault {name!r} in TTS_FAULTS spec {spec!r}")
+        return plan
+
+
+# module state: the active plan and the per-process fire counters
+_plan: FaultPlan | None = None
+_configured = False        # False: (re)read TTS_FAULTS lazily
+_fetch_failures = 0
+
+
+def configure(plan: FaultPlan | str | None) -> None:
+    """Install a plan programmatically (tests); None disarms entirely."""
+    global _plan, _configured, _fetch_failures
+    _plan = FaultPlan.parse(plan) if isinstance(plan, str) else plan
+    _configured = True
+    _fetch_failures = 0
+
+
+def reset() -> None:
+    """Back to env-driven lazy configuration (test teardown)."""
+    global _plan, _configured, _fetch_failures
+    _plan = None
+    _configured = False
+    _fetch_failures = 0
+
+
+def active() -> FaultPlan | None:
+    """The current plan (lazily parsed from TTS_FAULTS), or None."""
+    global _plan, _configured
+    if not _configured:
+        spec = os.environ.get("TTS_FAULTS", "")
+        _plan = FaultPlan.parse(spec) if spec else None
+        _configured = True
+    return _plan
+
+
+def corrupt_file(path, offset_frac: float = 0.5, n_bytes: int = 64) -> None:
+    """Flip `n_bytes` bytes in the middle of `path` in place — the
+    deterministic stand-in for a torn write / bit rot. Flipping (XOR
+    0xFF) the compressed payload breaks both the zip member CRC and the
+    checkpoint's own embedded CRC32, so every integrity tier sees it."""
+    size = os.path.getsize(path)
+    off = max(0, min(int(size * offset_frac), size - n_bytes))
+    with open(path, "r+b") as f:
+        f.seek(off)
+        chunk = f.read(n_bytes)
+        f.seek(off)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def fire(point: str, segment: int | None = None, path=None) -> None:
+    """Trigger the injection point `point` if the active plan arms it.
+
+    Points (all no-ops without a matching plan entry):
+    - "segment_start"   (segment=k): sleep if delay_segment targets k.
+    - "post_checkpoint" (segment=k, path=...): corrupt the just-written
+      checkpoint file if corrupt_checkpoint targets k.
+    - "post_segment"    (segment=k): os._exit(KILL_EXIT_CODE) if
+      kill_after_segment targets k — fires at the END of segment k,
+      after any checkpoint that segment wrote. Like a real preemption
+      it is NOT checkpoint-aligned: with checkpoint_every > 1 the
+      snapshot on disk may be older and resume redoes that interval.
+    - "host_fetch": raise InjectedFault while the fail_host_fetch
+      budget lasts (then succeed — the transient-error model).
+    """
+    plan = active()
+    if plan is None:
+        return
+    if point == "segment_start":
+        if plan.delay_segment and segment == plan.delay_segment[0]:
+            time.sleep(plan.delay_segment[1])
+    elif point == "post_checkpoint":
+        if (plan.corrupt_checkpoint is not None
+                and segment == plan.corrupt_checkpoint
+                and path is not None and os.path.exists(path)):
+            corrupt_file(path)
+    elif point == "post_segment":
+        if (plan.kill_after_segment is not None
+                and segment == plan.kill_after_segment):
+            # a preemption does not run exit handlers or flush buffers;
+            # os._exit is the honest simulation
+            os._exit(KILL_EXIT_CODE)
+    elif point == "host_fetch":
+        global _fetch_failures
+        if _fetch_failures < plan.fail_host_fetch:
+            _fetch_failures += 1
+            raise InjectedFault(
+                f"injected host-fetch failure "
+                f"{_fetch_failures}/{plan.fail_host_fetch}")
