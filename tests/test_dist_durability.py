@@ -199,8 +199,13 @@ def _campaign_env(tmp_path, out, **over):
 
 def _campaign_cmd():
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # the supervisor tests pin the LEGACY process-per-instance path
+    # (kept one release behind the deprecated --no-serve flag);
+    # serve-mode coverage is test_campaign_serve_mode_same_rows below
+    # and tests/test_service.py
     return [sys.executable, "-u",
-            os.path.join(repo, "tools", "run_campaign.py"), "3"]
+            os.path.join(repo, "tools", "run_campaign.py"),
+            "--no-serve", "3"]
 
 
 def test_supervisor_stall_resume(tmp_path):
@@ -361,6 +366,82 @@ def test_supervisor_screens_out_corrupt_checkpoint(tmp_path):
     assert rows[-1]["done"], rows
     assert (rows[-1]["tree"], rows[-1]["best"], rows[-1]["iters"]) == \
         CAMPAIGN_GOLDEN
+
+
+def test_campaign_serve_mode_same_rows(tmp_path):
+    """The campaign's default path is now the search service
+    (tools/run_campaign.py serve_main): one process, every instance
+    submitted to an in-process SearchServer, the SAME JSONL row schema.
+    The ta003 totals must match the legacy golden (tree/best are
+    engine-invariant under ub=opt; iters is not asserted — the service
+    runs the distributed engine with a BFS warm-up, the legacy worker
+    the root-seeded single-device loop), a solved row must retire its
+    checkpoint, and the executable-cache summary line must report the
+    compile count."""
+    out = tmp_path / "campaign.jsonl"
+    ckpt = tmp_path / "tts_ta003_lb2.ckpt.npz"
+    env = _campaign_env(tmp_path, out)
+    cmd = [c for c in _campaign_cmd() if c != "--no-serve"]
+    r = subprocess.run(cmd, env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "executor cache" in r.stdout, r.stdout
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 1, r.stdout
+    row = rows[0]
+    assert row["done"], row
+    assert (row["tree"], row["best"]) == CAMPAIGN_GOLDEN[:2]
+    # same schema as the legacy supervisor's rows
+    for key in ("inst", "jobs", "machines", "lb", "chunk", "budget_s",
+                "ub_mode", "done", "elapsed_s", "tree", "sol", "best",
+                "evals", "iters", "pool_at_stop", "pushed_per_s",
+                "evals_per_s", "restarts"):
+        assert key in row, key
+    assert not ckpt.exists(), "solved run must retire its checkpoint"
+
+    # rerun: the done row retires the instance in serve mode too
+    r2 = subprocess.run(cmd, env=env, timeout=600,
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "skipping" in r2.stdout, r2.stdout
+    assert len(out.read_text().splitlines()) == 1
+
+
+def test_campaign_serve_partial_budget_extends(tmp_path):
+    """Serve-mode budget semantics match the legacy supervisor's: a
+    budget-exhausted instance lands a partial row (DEADLINE) keeping a
+    checkpoint that carries the legacy config meta (inst/lb/chunk/
+    ub_mode — the --no-serve supervisor's screen accepts it) AND the
+    cumulative spent_s clock; a larger-budget rerun EXTENDS from the
+    checkpoint to the bit-identical solved counters."""
+    out = tmp_path / "campaign.jsonl"
+    ckpt = tmp_path / "tts_ta003_lb2.ckpt.npz"
+    cmd = [c for c in _campaign_cmd() if c != "--no-serve"]
+    env = _campaign_env(tmp_path, out, TTS_BUDGET_S="0.01")
+    r = subprocess.run(cmd, env=env, timeout=600,
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 1 and rows[0]["done"] is False, rows
+    assert ckpt.exists(), "partial row must keep its checkpoint"
+    with np.load(ckpt) as z:
+        assert int(z["meta_inst"]) == 3 and int(z["meta_lb"]) == 2
+        assert int(z["meta_chunk"]) == 32
+        assert str(z["meta_ub_mode"]) == "opt"
+        assert float(z["meta_spent_s"]) > 0.0
+
+    env2 = _campaign_env(tmp_path, out)          # default budget 600 s
+    r2 = subprocess.run(cmd, env=env2, timeout=600,
+                        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "extending partial row" in r2.stdout, r2.stdout
+    rows = [json.loads(ln) for ln in out.read_text().splitlines() if ln]
+    assert len(rows) == 2 and rows[1]["done"], rows
+    assert (rows[1]["tree"], rows[1]["best"]) == CAMPAIGN_GOLDEN[:2]
+    # cumulative clock: the second row's elapsed includes the first
+    # run's spend (budget continuity across server lifetimes)
+    assert rows[1]["elapsed_s"] >= float(np.float64(rows[0]["elapsed_s"]))
+    assert not ckpt.exists(), "solved run must retire its checkpoint"
 
 
 def test_worker_resumes_stacked_distributed_checkpoint(tmp_path):

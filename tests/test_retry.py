@@ -1,0 +1,125 @@
+"""Unit tests for the shared exponential-backoff helper (utils/retry).
+
+Extracted from the PR-1 inline copies around segment execution and
+checkpoint I/O; the service's re-dispatch tier uses it too, so the
+policy (transient-only, exponential, loud) gets pinned down here once.
+"""
+
+import pytest
+
+from tpu_tree_search.utils import retry
+
+
+class Boom(RuntimeError):
+    pass
+
+
+class Other(RuntimeError):
+    pass
+
+
+def test_success_passthrough():
+    calls = []
+    assert retry.retry_call(lambda: calls.append(1) or 42,
+                            transient=(Boom,)) == 42
+    assert len(calls) == 1
+
+
+def test_retries_transient_with_exponential_backoff():
+    delays = []
+    attempts = {"n": 0}
+
+    def flaky():
+        attempts["n"] += 1
+        if attempts["n"] < 3:
+            raise Boom("transient")
+        return "ok"
+
+    out = retry.retry_call(flaky, attempts=4, base_s=0.5,
+                           transient=(Boom,),
+                           on_retry=lambda a, d, e: delays.append(d),
+                           sleep=lambda s: None)
+    assert out == "ok"
+    assert attempts["n"] == 3
+    assert delays == [0.5, 1.0]        # base * 2**k, no jitter
+
+
+def test_non_transient_propagates_immediately():
+    attempts = {"n": 0}
+
+    def bad():
+        attempts["n"] += 1
+        raise Other("deterministic")
+
+    with pytest.raises(Other):
+        retry.retry_call(bad, attempts=5, transient=(Boom,),
+                         sleep=lambda s: None)
+    assert attempts["n"] == 1
+
+
+def test_exhaustion_reraises_last_transient():
+    attempts = {"n": 0}
+
+    def always():
+        attempts["n"] += 1
+        raise Boom(f"try {attempts['n']}")
+
+    with pytest.raises(Boom, match="try 3"):
+        retry.retry_call(always, attempts=3, transient=(Boom,),
+                         on_retry=lambda a, d, e: None,
+                         sleep=lambda s: None)
+    assert attempts["n"] == 3
+
+
+def test_attempts_floor_is_one():
+    attempts = {"n": 0}
+
+    def always():
+        attempts["n"] += 1
+        raise Boom("x")
+
+    with pytest.raises(Boom):
+        retry.retry_call(always, attempts=0, transient=(Boom,),
+                         sleep=lambda s: None)
+    assert attempts["n"] == 1
+
+
+def test_default_on_retry_warns():
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise Boom("once")
+        return 1
+
+    with pytest.warns(RuntimeWarning, match="transient widget failure"):
+        assert retry.retry_call(flaky, what="widget", attempts=2,
+                                base_s=0.0, transient=(Boom,)) == 1
+
+
+def test_backoff_schedule():
+    assert retry.backoff_delays(4, 0.25) == [0.25, 0.5, 1.0]
+    assert retry.backoff_delays(1, 0.25) == []
+    assert retry.backoff_delay(3, 0.5) == 4.0
+
+
+def test_checkpoint_retry_uses_shared_helper():
+    """engine/checkpoint._retry is the shared helper bound to the
+    engine's TRANSIENT_ERRORS (injected faults retry; ValueError not)."""
+    from tpu_tree_search.engine import checkpoint
+    from tpu_tree_search.utils import faults
+
+    state = {"n": 0}
+
+    def flaky():
+        state["n"] += 1
+        if state["n"] == 1:
+            raise faults.InjectedFault("transient")
+        return "ok"
+
+    with pytest.warns(RuntimeWarning):
+        assert checkpoint._retry(flaky, "op", 3, 0.0) == "ok"
+    with pytest.raises(ValueError):
+        checkpoint._retry(lambda: (_ for _ in ()).throw(ValueError("x")),
+                          "op", 3, 0.0)

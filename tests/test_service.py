@@ -1,0 +1,310 @@
+"""Search-service tests on the virtual 8-device CPU mesh.
+
+The serving subsystem's contract, pinned deterministically:
+
+- concurrent requests on disjoint submeshes produce node counts
+  BIT-IDENTICAL to standalone `distributed.search` runs at the same
+  worker count (the submesh is just a mesh; the engine is unmodified);
+- priority preemption stops a victim at a segment boundary, checkpoints
+  it, serves the high-priority request, then RESUMES the victim to the
+  same exact totals;
+- the executable cache serves same-shape requests from one compile;
+- per-request fault injection (utils/faults.scoped) stays confined to
+  its submesh, and a corrupted checkpoint rolls back to the rotating
+  last-good snapshot on resume instead of failing the request.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import checkpoint, distributed
+from tpu_tree_search.parallel.mesh import partition_submeshes
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import (AdmissionError, SearchRequest,
+                                     SearchServer)
+
+# engine knobs shared by every request/baseline so counts are comparable
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+def small(seed, jobs=7):
+    return PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def baselines():
+    """Standalone distributed.search totals at 4 workers (the submesh
+    size every 2-submesh test serves at)."""
+    out = {}
+    for seed, jobs in [(0, 7), (1, 7), (2, 7), (3, 7), (5, 8), (6, 7)]:
+        inst = small(seed, jobs)
+        got = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                                 n_devices=4, **KW)
+        out[seed] = (got.explored_tree, got.explored_sol, got.best)
+    return out
+
+
+def wait_state(srv, rid, state, timeout=120.0):
+    from tpu_tree_search.service import TERMINAL_STATES
+
+    t0 = time.monotonic()
+    while True:
+        now = srv.status(rid)["state"]
+        if now == state:
+            return
+        # fail FAST on a wrong terminal state instead of burning the
+        # whole timeout polling a record that can never change again
+        assert now not in TERMINAL_STATES, (
+            f"{rid} reached terminal {now} while waiting for {state}: "
+            f"{srv.status(rid)}")
+        assert time.monotonic() - t0 < timeout, (
+            f"{rid} never reached {state}: {srv.status(rid)}")
+        time.sleep(0.02)
+
+
+def totals(rec):
+    res = rec.result
+    return (res.explored_tree, res.explored_sol, res.best)
+
+
+def test_partition_submeshes_shapes():
+    for n, per in [(1, 8), (2, 4), (4, 2), (8, 1)]:
+        meshes = partition_submeshes(n)
+        assert len(meshes) == n
+        assert all(m.devices.size == per for m in meshes)
+        ids = [int(d.id) for m in meshes for d in m.devices.flat]
+        assert sorted(ids) == ids == list(range(8))  # disjoint, contiguous
+    with pytest.raises(ValueError, match="do not split"):
+        partition_submeshes(3)
+    with pytest.raises(ValueError, match=">= 1"):
+        partition_submeshes(0)
+
+
+def test_concurrent_requests_bitident_and_cache_reuse(baselines, tmp_path):
+    """The acceptance demo: 4 concurrent requests on 2 submeshes, each
+    bit-identical to its standalone run, with >= 1 executable-cache hit
+    (requests 2..N per submesh reuse request 1's compile)."""
+    insts = {s: small(s) for s in range(4)}
+    with SearchServer(n_submeshes=2, workdir=tmp_path,
+                      segment_iters=256) as srv:
+        rids = {s: srv.submit(SearchRequest(p_times=i.p_times, lb_kind=1,
+                                            **KW))
+                for s, i in insts.items()}
+        for s, rid in rids.items():
+            rec = srv.result(rid, timeout=300)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            assert totals(rec) == baselines[s]
+        snap = srv.status_snapshot()
+    # the snapshot is the service's observability surface: JSON-safe,
+    # with queue/submesh/cache/request views all present
+    json.dumps(snap)
+    assert snap["executor_cache"]["hits"] >= 1
+    assert snap["executor_cache"]["misses"] <= 2     # one per submesh
+    assert snap["counters"]["done"] == 4
+    assert len(snap["submeshes"]) == 2
+    assert all(sm["running"] is None for sm in snap["submeshes"])
+    reqs = snap["requests"]
+    assert {r["state"] for r in reqs.values()} == {"DONE"}
+    # per-worker explored-node spread rides the snapshot (utils/stats)
+    assert all("tree_per_worker" in r["result"] for r in reqs.values())
+
+
+def test_executor_cache_same_shape_hits_lb_misses(tmp_path):
+    """Satellite: two same-shape instances share exactly one
+    trace/compile; a differing lb_kind misses."""
+    a, b = small(0), small(1)                    # same (jobs, machines)
+    with SearchServer(n_submeshes=1, workdir=tmp_path,
+                      segment_iters=256) as srv:
+        for p, lb in [(a.p_times, 1), (b.p_times, 1), (a.p_times, 2)]:
+            rid = srv.submit(SearchRequest(p_times=p, lb_kind=lb, **KW))
+            assert srv.result(rid, timeout=300).state == "DONE"
+        snap = srv.status_snapshot()["executor_cache"]
+    # request 1 compiles (miss), request 2 reuses it (hit: same shape,
+    # same lb — the tables are runtime args), request 3 re-compiles
+    # (miss: lb_kind specializes the trace)
+    assert snap == {"entries": 2, "hits": 1, "misses": 2}
+
+
+def test_priority_preemption_and_checkpoint_resume(baselines, tmp_path):
+    """Two low-priority requests hold both submeshes; a high-priority
+    arrival preempts exactly one, runs to completion, and the preempted
+    request resumes from its checkpoint to bit-identical totals."""
+    slow, fast = small(5, jobs=8), small(6)
+    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+        slow_ids = [srv.submit(SearchRequest(
+            p_times=slow.p_times, lb_kind=1, priority=0,
+            segment_iters=32, checkpoint_every=1,
+            faults="delay_every=0.15", **KW)) for _ in range(2)]
+        for rid in slow_ids:
+            wait_state(srv, rid, "RUNNING")
+        hi = srv.submit(SearchRequest(p_times=fast.p_times, lb_kind=1,
+                                      priority=10, segment_iters=256,
+                                      **KW))
+        rec_hi = srv.result(hi, timeout=300)
+        assert rec_hi.state == "DONE", (rec_hi.state, rec_hi.error)
+        assert totals(rec_hi) == baselines[6]
+        assert srv.counters["preemptions"] >= 1
+        recs = [srv.result(rid, timeout=600) for rid in slow_ids]
+    assert all(r.state == "DONE" for r in recs), \
+        [(r.state, r.error) for r in recs]
+    assert sum(r.preemptions for r in recs) >= 1
+    for r in recs:                     # resume is exact, not approximate
+        assert totals(r) == baselines[5]
+
+
+def test_fault_injection_isolated_to_one_submesh(baselines, tmp_path):
+    """Satellite: a delay_segment fault on request A must not block
+    request B on the other submesh — B finishes while A is still held
+    by its injected stall, then A completes with unchanged counts."""
+    a, b = small(2), small(3)
+    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+        ra = srv.submit(SearchRequest(p_times=a.p_times, lb_kind=1,
+                                      segment_iters=64,
+                                      faults="delay_segment=1:5.0", **KW))
+        wait_state(srv, ra, "RUNNING")
+        rb = srv.submit(SearchRequest(p_times=b.p_times, lb_kind=1,
+                                      segment_iters=256, **KW))
+        rec_b = srv.result(rb, timeout=300)
+        assert rec_b.state == "DONE"
+        assert totals(rec_b) == baselines[3]
+        # B is done; A is still inside its injected 5 s stall
+        assert srv.status(ra)["state"] == "RUNNING"
+        rec_a = srv.result(ra, timeout=300)
+    assert rec_a.state == "DONE"
+    assert totals(rec_a) == baselines[2]
+
+
+def test_corrupt_checkpoint_on_preemption_resumes_from_last_good(
+        baselines, tmp_path):
+    """Satellite: corrupt the CURRENT checkpoint while a request sits
+    preempted; the resume must roll back to the rotating `.prev`
+    last-good snapshot (never load garbage, never FAIL the request) and
+    still reach bit-identical totals."""
+    inst = small(5, jobs=8)
+    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+        # segment_iters=16 keeps dozens of segments ahead of the
+        # preempt below — the stop must land while work remains
+        rid = srv.submit(SearchRequest(
+            p_times=inst.p_times, lb_kind=1, segment_iters=16,
+            checkpoint_every=1, faults="delay_every=0.1", **KW))
+        # let it checkpoint at least twice so a .prev sibling exists
+        t0 = time.monotonic()
+        while srv.status(rid)["progress"].get("segment", 0) < 2:
+            assert time.monotonic() - t0 < 120
+            time.sleep(0.02)
+        assert srv.preempt(rid, hold=True)
+        wait_state(srv, rid, "PREEMPTED")
+        rec = srv.records[rid]
+        ckpt = rec.checkpoint_path
+        assert os.path.exists(ckpt) and os.path.exists(ckpt + ".prev")
+        from tpu_tree_search.utils import faults as faults_mod
+        faults_mod.corrupt_file(ckpt)
+        # prove the current snapshot really is unreadable: the resume
+        # that follows can only have come from the last-good sibling
+        with pytest.raises(checkpoint.CheckpointCorrupt):
+            checkpoint.load(ckpt, p_times=inst.p_times)
+        assert srv.release(rid)
+        final = srv.result(rid, timeout=600)
+    assert final.state == "DONE", (final.state, final.error)
+    assert final.dispatches >= 2
+    assert totals(final) == baselines[5]
+
+
+def test_deadline_stops_with_partial_result(tmp_path):
+    """A request over its compute deadline lands in DEADLINE with its
+    partial counters and keeps its checkpoint (a larger-deadline
+    resubmission of the same tag extends the work)."""
+    inst = small(5, jobs=8)
+    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+        rid = srv.submit(SearchRequest(
+            p_times=inst.p_times, lb_kind=1, deadline_s=0.5,
+            segment_iters=16, checkpoint_every=1,
+            faults="delay_every=0.2", tag="budgeted", **KW))
+        rec = srv.result(rid, timeout=300)
+        snap = srv.status(rid)
+    assert rec.state == "DEADLINE"
+    assert rec.result is not None and not rec.result.complete
+    assert snap["result"]["complete"] is False
+    assert os.path.exists(rec.checkpoint_path)   # kept for extension
+
+
+def test_admission_control_and_cancel(tmp_path):
+    """Bounded queue: overflow and invalid requests are rejected with a
+    reason; queued requests cancel cleanly; close() cancels the rest.
+    autostart=False keeps everything deterministic — nothing runs."""
+    inst = small(0)
+    srv = SearchServer(n_submeshes=2, workdir=tmp_path, max_queue_depth=2,
+                       autostart=False)
+    mk = lambda **kw: SearchRequest(p_times=inst.p_times, **KW, **kw)
+    r1, r2 = srv.submit(mk()), srv.submit(mk())
+    with pytest.raises(AdmissionError, match="queue full"):
+        srv.submit(mk())
+    assert srv.queue.rejected == 1
+    with pytest.raises(AdmissionError, match="invalid request"):
+        srv.submit(mk(lb_kind=7))
+    with pytest.raises(KeyError):
+        srv.status("req-nope")
+    assert srv.cancel(r1) is True
+    assert srv.status(r1)["state"] == "CANCELLED"
+    assert srv.cancel(r1) is False                 # already terminal
+    r3 = srv.submit(mk())                          # depth freed by cancel
+    snap = srv.status_snapshot()
+    assert snap["queue"]["depth"] == 2
+    assert snap["queue"]["waiting"] == [r2, r3]
+    srv.close()
+    assert srv.status(r2)["state"] == "CANCELLED"
+    assert srv.status(r3)["state"] == "CANCELLED"
+    with pytest.raises(AdmissionError, match="server closed"):
+        srv.submit(mk())
+
+
+def test_duplicate_active_tag_rejected(tmp_path):
+    """Two live requests must not share a checkpoint family: a tag
+    resubmitted while its request is non-terminal is rejected."""
+    inst = small(0)
+    srv = SearchServer(n_submeshes=2, workdir=tmp_path, autostart=False)
+    srv.submit(SearchRequest(p_times=inst.p_times, tag="t", **KW))
+    with pytest.raises(AdmissionError, match="already active"):
+        srv.submit(SearchRequest(p_times=inst.p_times, tag="t", **KW))
+    srv.close()
+
+
+def test_spool_roundtrip(baselines, tmp_path):
+    """The serve/client file protocol: a dropped request file comes back
+    as a result file with the DONE snapshot; a malformed request file
+    gets a REJECTED result instead of hanging its client."""
+    import threading
+
+    from tpu_tree_search.service import spool
+
+    inst = small(1)
+    spool_dir = tmp_path / "spool"
+    stop = threading.Event()
+    with SearchServer(n_submeshes=2, workdir=tmp_path / "wd",
+                      segment_iters=256) as srv:
+        th = threading.Thread(
+            target=spool.serve_spool,
+            args=(srv, spool_dir),
+            kwargs=dict(poll_s=0.05, should_exit=stop.is_set),
+            daemon=True)
+        th.start()
+        try:
+            sid = spool.submit_file(
+                spool_dir, {"p_times": inst.p_times.tolist(), "lb": 1,
+                            "chunk": KW["chunk"],
+                            "capacity": KW["capacity"],
+                            "min_seed": KW["min_seed"]})
+            bad = spool.submit_file(spool_dir, {"lb": 1})   # no instance
+            res = spool.wait_result(spool_dir, sid, timeout=300)
+            rej = spool.wait_result(spool_dir, bad, timeout=60)
+        finally:
+            stop.set()
+            th.join(timeout=30)
+    assert res["state"] == "DONE"
+    assert (res["result"]["explored_tree"], res["result"]["explored_sol"],
+            res["result"]["best"]) == baselines[1]
+    assert rej["state"] == "REJECTED" and "inst" in rej["error"]

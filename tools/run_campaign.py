@@ -1,6 +1,29 @@
-"""Campaign driver: solve Taillard instances end-to-end on one chip,
-with a per-instance wall budget, partial-progress reporting, and
-AUTOMATIC STALL RECOVERY.
+"""Campaign driver: solve Taillard instances end-to-end with a
+per-instance compute budget, partial-progress reporting, and automatic
+recovery.
+
+TWO EXECUTION MODES:
+
+- **serve (default)**: the campaign is the first client of the search
+  service (tpu_tree_search/service/): ONE long-lived process submits
+  every selected instance to an in-process SearchServer, polls, and
+  writes the same JSONL rows. No per-instance process spin-up, and the
+  executable cache compiles each (jobs x machines, lb, submesh) shape
+  ONCE for the whole campaign instead of once per instance —
+  `--submeshes K` additionally solves K instances concurrently on a
+  partitioned mesh. Budget exhaustion maps to the service's DEADLINE
+  state (checkpoint kept; a rerun with a larger TTS_BUDGET_S resumes
+  it), and the legacy checkpoint naming is preserved, so in-flight
+  legacy checkpoints resume under serve mode (elastically resharded).
+- **--no-serve (DEPRECATED, kept for one release)**: the original
+  process-per-instance supervisor below — worker subprocess per
+  instance, heartbeat-age stall kill + respawn. Still the right tool
+  when the device runtime itself is expected to wedge whole processes
+  (the remote-TPU tunnel stalls it was built for); the serve path keeps
+  everything in one process and cannot kill a truly hung dispatch.
+
+Legacy architecture (--no-serve), per-instance wall budget and
+AUTOMATIC STALL RECOVERY:
 
 Generalizes tools/run_single_device_table.py (VERDICT r3 #7, the 20x20
 table) to the reference's wider campaign groups (VERDICT r4 #1): the
@@ -34,7 +57,8 @@ Env: TTS_BUDGET_S (default 7200), TTS_LB (default 2), TTS_CHUNK
 (default 32768), TTS_CAMPAIGN_OUT (default /tmp/campaign.jsonl),
 TTS_WORKDIR (status/checkpoint files, default /tmp), TTS_SEG (default
 2000 iters/segment), TTS_CKPT_EVERY (segments between checkpoints,
-default 8), TTS_UB ("opt" | "inf", default opt), TTS_STALL_GRACE
+default 8), TTS_UB ("opt" | "inf", default opt), TTS_SUBMESHES (serve
+mode: concurrent submeshes, default 1), TTS_STALL_GRACE
 (seconds before the first heartbeat may be declared dead, default 900 —
 covers a cold 50x20 compile), TTS_MAX_RESTARTS (default 50).
 Resilience knobs ride through to the worker's run_segmented:
@@ -454,7 +478,13 @@ def supervise(inst: int, lb: int) -> dict | None:
         time.sleep(min(30, 5 * dead_without_progress + 2))
 
 
-def main():
+def select_instances(insts: list[int]) -> list[int]:
+    """Drop instances already retired by a row in OUT (shared by both
+    modes). The skip key includes done/budget, not just (inst, lb,
+    chunk): a PARTIAL row only retires its instance up to the budget it
+    was measured at — a rerun with a larger TTS_BUDGET_S resumes the
+    kept checkpoint and extends it (ADVICE.md round 5: the old key
+    silently skipped exactly the reruns partial rows exist for)."""
     done = {}
     if os.path.exists(OUT):
         with open(OUT) as f:
@@ -464,15 +494,9 @@ def main():
                     # rows from before the chunk field default to the
                     # current CHUNK (they predate configurable rechecks)
                     done[(r["inst"], r["lb"], r.get("chunk", CHUNK))] = r
-    insts = [int(x) for x in sys.argv[1:]]
+    out = []
     for inst in insts:
         r = done.get((inst, LB, CHUNK))
-        # the skip key includes done/budget, not just (inst, lb, chunk):
-        # a PARTIAL row only retires its instance up to the budget it
-        # was measured at — a rerun with a larger TTS_BUDGET_S resumes
-        # the kept checkpoint and extends it (ADVICE.md round 5: the old
-        # key silently skipped exactly the reruns partial rows exist
-        # for)
         if r is not None and (r.get("done", True)
                               or float(r.get("budget_s", BUDGET_S))
                               >= BUDGET_S):
@@ -487,21 +511,175 @@ def main():
             print(f"ta{inst:03d} lb{LB}: extending partial row "
                   f"(budget {r.get('budget_s')}s -> {BUDGET_S:.0f}s)",
                   flush=True)
+        out.append(inst)
+    return out
+
+
+def append_row(row: dict) -> None:
+    with open(OUT, "a") as f:
+        f.write(json.dumps(row) + "\n")
+    tag = "SOLVED" if row["done"] else "partial"
+    print(f"ta{row['inst']:03d} lb{row['lb']}: {tag} "
+          f"t={row['elapsed_s']}s tree={row['tree']} "
+          f"pushed/s={row['pushed_per_s']} "
+          f"restarts={row.get('restarts', 0)}", flush=True)
+
+
+# ----------------------------------------------------------- serve mode
+
+def serve_main(insts: list[int], n_submeshes: int) -> None:
+    """The campaign as the search service's first client: ONE process,
+    every instance submitted up front, results polled in order — the
+    executable cache compiles each instance CLASS once for the whole
+    campaign, and `n_submeshes > 1` solves that many instances
+    concurrently. Budget exhaustion is the service's DEADLINE state
+    (checkpoint kept under the legacy name, so --no-serve and serve
+    runs resume each other's partials)."""
+    from tpu_tree_search.utils import compile_cache, device_info
+
+    compile_cache.enable()
+    device_info.apply_platform_override()
+
+    import numpy as np  # noqa: F401 (platform init order)
+
+    from tpu_tree_search.problems import taillard
+    from tpu_tree_search.service import SearchRequest, SearchServer
+
+    todo = select_instances(insts)
+    if not todo:
+        return
+    with SearchServer(n_submeshes=n_submeshes, workdir=WORKDIR,
+                      max_queue_depth=max(64, len(todo) + 1),
+                      segment_iters=SEG,
+                      checkpoint_every=CKPT_EVERY) as srv:
+        from tpu_tree_search.engine import device
+
+        rids = {}
+        for inst in todo:
+            p = taillard.processing_times(inst)
+            ub = (taillard.optimal_makespan(inst) if UB_MODE == "opt"
+                  else None)
+            # the legacy worker's capacity floor (4*chunk*jobs headroom
+            # above the class default); the distributed driver still
+            # grows losslessly on overflow, this just avoids paying the
+            # grow+recompile on instances the floor was tuned for
+            capacity = int(os.environ.get("TTS_CAPACITY", "0")) or \
+                max(device.default_capacity(p.shape[1], p.shape[0]),
+                    4 * CHUNK * p.shape[1])
+            rids[inst] = srv.submit(SearchRequest(
+                p_times=p, lb_kind=LB, init_ub=ub, chunk=CHUNK,
+                capacity=capacity, deadline_s=BUDGET_S,
+                # the legacy worker's checkpoint base name AND config
+                # meta (inst/lb/chunk/ub_mode): serve-mode campaigns
+                # resume --no-serve partials and vice versa — the
+                # legacy supervisor's config screen accepts these files
+                tag=f"tts_ta{inst:03d}_lb{LB}",
+                checkpoint_meta={"inst": inst, "lb": LB, "chunk": CHUNK,
+                                 "ub_mode": UB_MODE}))
+            print(f"ta{inst:03d} lb{LB}: submitted "
+                  f"(budget {BUDGET_S:.0f}s)", flush=True)
+        for inst in todo:
+            rec = srv.result(rids[inst])
+            row = _serve_row(inst, rec)
+            if row is None:
+                continue
+            if (row["done"] and UB_MODE == "opt"
+                    and row["best"] != taillard.optimal_makespan(inst)):
+                raise RuntimeError(
+                    f"ta{inst:03d} lb{LB}: wrong answer: "
+                    f"best={row['best']} != optimum "
+                    f"{taillard.optimal_makespan(inst)}")
+            append_row(row)
+        snap = srv.status_snapshot()
+        print(f"campaign served {snap['counters']['done']} done / "
+              f"{snap['counters']['deadline']} partial; executor cache "
+              f"{snap['executor_cache']['hits']} hits / "
+              f"{snap['executor_cache']['misses']} compiles", flush=True)
+
+
+def _serve_row(inst: int, rec) -> dict | None:
+    """A service RequestRecord -> the campaign's JSONL row schema."""
+    from tpu_tree_search.problems import taillard
+
+    p = taillard.processing_times(inst)
+    m, jobs = p.shape
+    res = rec.result
+    if res is None or rec.state in ("FAILED", "CANCELLED"):
+        print(f"ta{inst:03d} lb{LB}: {rec.state} "
+              f"({rec.error or 'no result'}); no row", flush=True)
+        return None
+    spent = rec.spent_s()
+    per = res.per_device
+    evals = int(sum(per.get("evals", [0])))
+    iters = int(max(per.get("iters", [0])))
+    pool = int(sum(per.get("final_size", [0])))
+    done = rec.state == "DONE" and res.complete
+    return {"inst": inst, "jobs": jobs, "machines": m, "lb": LB,
+            "chunk": CHUNK, "budget_s": BUDGET_S, "ub_mode": UB_MODE,
+            "done": done, "elapsed_s": round(spent, 2),
+            "tree": int(res.explored_tree), "sol": int(res.explored_sol),
+            "best": int(res.best), "evals": evals, "iters": iters,
+            "capacity": int(rec.request.capacity or 0),
+            "grows": 0, "pool_at_stop": pool,
+            "pushed_per_s": round(res.explored_tree / max(spent, 1e-9), 1),
+            "evals_per_s": round(evals / max(spent, 1e-9), 1),
+            "restarts": rec.dispatches - 1}
+
+
+# ----------------------------------------------------------- entry point
+
+def legacy_main(insts: list[int]) -> None:
+    for inst in select_instances(insts):
         print(f"ta{inst:03d} lb{LB}: solving (budget {BUDGET_S:.0f}s)...",
               flush=True)
         row = supervise(inst, LB)
         if row is None:
             continue
-        with open(OUT, "a") as f:
-            f.write(json.dumps(row) + "\n")
-        tag = "SOLVED" if row["done"] else "partial"
-        print(f"ta{inst:03d} lb{LB}: {tag} t={row['elapsed_s']}s "
-              f"tree={row['tree']} pushed/s={row['pushed_per_s']} "
-              f"restarts={row['restarts']}", flush=True)
+        append_row(row)
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="Solve Taillard instances to a per-instance compute "
+                    "budget, writing JSONL result rows. Default mode "
+                    "runs ONE in-process search service "
+                    "(tpu_tree_search/service/) and submits every "
+                    "instance to it — no per-instance process/compile.",
+        epilog="Env knobs: TTS_BUDGET_S TTS_LB TTS_CHUNK "
+               "TTS_CAMPAIGN_OUT TTS_WORKDIR TTS_SEG TTS_CKPT_EVERY "
+               "TTS_UB TTS_SUBMESHES (see the module docstring).")
+    ap.add_argument("instances", nargs="+", type=int,
+                    help="Taillard instance ids (e.g. 31 32 ... 50)")
+    ap.add_argument("--no-serve", action="store_true",
+                    help="DEPRECATED: use the legacy process-per-"
+                         "instance supervisor (worker subprocess + "
+                         "heartbeat stall kill/respawn) instead of the "
+                         "search service. Kept for one release for "
+                         "runtimes where a hung device dispatch must be "
+                         "killed at the process level; it will be "
+                         "removed — migrate to the default serve mode.")
+    ap.add_argument("--submeshes", type=int,
+                    default=int(os.environ.get("TTS_SUBMESHES", "1")),
+                    help="serve mode: partition the device mesh into "
+                         "this many equal submeshes and solve that many "
+                         "instances concurrently (default 1)")
+    args = ap.parse_args(argv)
+    if args.no_serve:
+        print("warning: --no-serve (process-per-instance supervisor) is "
+              "deprecated and will be removed after one release; the "
+              "service path is the default", flush=True)
+        legacy_main(args.instances)
+    else:
+        serve_main(args.instances, args.submeshes)
 
 
 if __name__ == "__main__":
-    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
-        worker_main(int(sys.argv[2]))
+    # worker dispatch is positional-flag tolerant ("--no-serve --worker
+    # 3" and "--worker 3" both reach worker_main): the supervisor
+    # respawns workers with the flags it was launched with
+    if "--worker" in sys.argv[1:]:
+        worker_main(int(sys.argv[sys.argv.index("--worker") + 1]))
     else:
         main()

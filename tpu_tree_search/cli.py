@@ -7,6 +7,13 @@ command lines against the TPU engine:
 
     python -m tpu_tree_search pfsp -i 14 -l 1 -u 1 -D 1
     python -m tpu_tree_search nqueens -N 13 -g 1
+
+Beyond the reference's one-shot runs, `serve` starts the long-lived
+search service (tpu_tree_search/service/) over a file spool and
+`client` submits requests to it:
+
+    python -m tpu_tree_search serve --spool /tmp/tts-spool --submeshes 2
+    python -m tpu_tree_search client --spool /tmp/tts-spool -i 21 -l 1
 """
 
 from __future__ import annotations
@@ -88,6 +95,103 @@ def _pfsp_parser(sub):
                         "resilience drills, e.g. "
                         "'kill_after_segment=3,fail_host_fetch=1' "
                         "(utils/faults.py; also via TTS_FAULTS)")
+
+
+def _serve_parser(sub):
+    from .utils import config as _cfg
+    p = sub.add_parser(
+        "serve",
+        help="run the in-process search service over a file spool "
+             "(service/: submesh scheduling, priority preemption, "
+             "executable reuse)")
+    p.add_argument("--spool", type=str, required=True,
+                   help="directory watched for <id>.req.json request "
+                        "files; results land beside them as "
+                        "<id>.res.json (see service/spool.py for the "
+                        "payload schema)")
+    p.add_argument("--submeshes", type=int, default=1,
+                   help="partition the device mesh into this many equal "
+                        "submeshes, one concurrent request each "
+                        "(must divide the device count)")
+    p.add_argument("--workdir", type=str, default=None,
+                   help="checkpoint directory for preempted/deadline "
+                        "requests (default: a fresh temp dir)")
+    p.add_argument("--queue-depth", type=int,
+                   default=_cfg.SERVICE_QUEUE_DEPTH_DEFAULT,
+                   help="admission bound: requests beyond this are "
+                        "rejected with a reason, not buffered")
+    p.add_argument("--segment-iters", type=int,
+                   default=_cfg.SERVICE_SEGMENT_ITERS_DEFAULT,
+                   help="segment length between stop-flag checks — the "
+                        "preemption/deadline reaction granularity")
+    p.add_argument("--idle-exit", type=float, default=None,
+                   help="exit after this many seconds with no queued or "
+                        "running work (default: serve forever)")
+    p.add_argument("--status-every", type=float, default=30.0,
+                   help="print a JSON status snapshot every N seconds "
+                        "(0 disables)")
+
+
+def _client_parser(sub):
+    p = sub.add_parser(
+        "client",
+        help="submit one request to a running `serve` spool and wait")
+    p.add_argument("--spool", type=str, required=True)
+    p.add_argument("-i", "--inst", type=int, required=True,
+                   help="Taillard instance id")
+    p.add_argument("-l", "--lb", type=int, default=1, choices=(0, 1, 2))
+    p.add_argument("-u", "--ub", type=int, default=1, choices=(0, 1),
+                   help="1: seed the incumbent with the known optimum")
+    p.add_argument("--priority", type=int, default=0,
+                   help="higher preempts lower on a full mesh")
+    p.add_argument("--deadline", type=float, default=None,
+                   help="compute budget in seconds (accumulated "
+                        "execution time, not queue wait)")
+    p.add_argument("--chunk", type=int, default=None)
+    p.add_argument("--capacity", type=int, default=None)
+    p.add_argument("--tag", type=str, default=None,
+                   help="checkpoint tag; resubmitting a DEADLINE "
+                        "request's tag with a larger budget extends it")
+    p.add_argument("--timeout", type=float, default=None,
+                   help="give up waiting for the result after N seconds")
+
+
+def run_serve(args) -> int:
+    from .service import SearchServer, spool
+
+    with SearchServer(n_submeshes=args.submeshes, workdir=args.workdir,
+                      max_queue_depth=args.queue_depth,
+                      segment_iters=args.segment_iters) as srv:
+        print(f"serving: {args.submeshes} submesh(es) x "
+              f"{srv.slots[0].mesh.devices.size} device(s), "
+              f"spool {args.spool}", flush=True)
+        served = spool.serve_spool(
+            srv, args.spool, idle_exit_s=args.idle_exit,
+            status_every_s=args.status_every or None,
+            emit=lambda s: print(s, flush=True))
+    print(f"served {served} request(s)", flush=True)
+    return 0
+
+
+def run_client(args) -> int:
+    import json
+
+    from .service import spool
+
+    payload = {"inst": args.inst, "lb": args.lb,
+               "ub": "opt" if args.ub == 1 else None,
+               "priority": args.priority, "deadline_s": args.deadline,
+               "chunk": args.chunk, "capacity": args.capacity,
+               "tag": args.tag}
+    sid = spool.submit_file(args.spool, payload)
+    print(f"submitted {sid}", flush=True)
+    try:
+        res = spool.wait_result(args.spool, sid, timeout=args.timeout)
+    except TimeoutError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(res, indent=1))
+    return 0 if res.get("state") == "DONE" else 1
 
 
 def _nq_parser(sub):
@@ -521,6 +625,8 @@ def main(argv=None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
     _pfsp_parser(sub)
     _nq_parser(sub)
+    _serve_parser(sub)
+    _client_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
                         "gpu_info, common/gpu_util.cu:5-17)")
@@ -551,6 +657,10 @@ def main(argv=None) -> int:
     compile_cache.enable()
     if args.cmd == "pfsp":
         return run_pfsp(args)
+    if args.cmd == "serve":
+        return run_serve(args)
+    if args.cmd == "client":
+        return run_client(args)
     if args.cmd == "devices":
         from .utils.device_info import print_device_info
         print_device_info()

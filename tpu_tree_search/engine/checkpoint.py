@@ -28,6 +28,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils import faults
+from ..utils.retry import retry_call
 from .device import SearchState
 
 
@@ -81,22 +82,13 @@ TRANSIENT_ERRORS = _transient_errors()
 
 
 def _retry(fn, what: str, attempts: int, base_s: float):
-    """Run `fn` with exponential-backoff retry on transient errors.
+    """Run `fn` with exponential-backoff retry on transient errors
+    (utils/retry.retry_call bound to this module's TRANSIENT_ERRORS).
     Non-transient exceptions (wrong answers, schema errors, timeouts)
     propagate immediately — retrying a deterministic failure only
     delays the loud abort."""
-    for attempt in range(max(attempts, 1)):
-        try:
-            return fn()
-        except TRANSIENT_ERRORS as e:
-            if attempt >= attempts - 1:
-                raise
-            delay = base_s * (2 ** attempt)
-            warnings.warn(
-                f"transient {what} failure "
-                f"(attempt {attempt + 1}/{attempts}): {e!r}; "
-                f"retrying in {delay:.2f}s", RuntimeWarning, stacklevel=2)
-            time.sleep(delay)
+    return retry_call(fn, what=what, attempts=attempts, base_s=base_s,
+                      transient=TRANSIENT_ERRORS)
 
 
 def _with_watchdog(fn, timeout_s: float | None, what: str):
@@ -108,10 +100,17 @@ def _with_watchdog(fn, timeout_s: float | None, what: str):
     if not timeout_s or timeout_s <= 0:
         return fn()
     box: dict = {}
+    # the caller's fault plan must ride into the worker thread: a
+    # thread-SCOPED plan (faults.scoped — the service's per-request
+    # injection) lives in thread-local state the daemon thread cannot
+    # see, and injection points inside fn (host_fetch) would silently
+    # stop firing whenever the watchdog is armed
+    plan = faults.active()
 
     def target():
         try:
-            box["result"] = fn()
+            with faults.scoped(plan):
+                box["result"] = fn()
         except BaseException as e:      # noqa: BLE001 — re-raised below
             box["error"] = e
 

@@ -427,7 +427,8 @@ class _DistDriver:
     block also fits above the limit (see _balance_round)."""
 
     def __init__(self, mesh, tables, make_local_step, balance_period: int,
-                 transfer_cap: int, min_transfer: int, limit_fn):
+                 transfer_cap: int, min_transfer: int, limit_fn,
+                 loop_cache=None, loop_key: tuple = ()):
         self.mesh = mesh
         self.tables = tables
         self.make_local_step = make_local_step
@@ -438,16 +439,42 @@ class _DistDriver:
         self.n_recv = mesh.devices.size * transfer_cap
         self._loops: dict[int, object] = {}
         self.spec_state = tuple(P(AX) for _ in SearchState._fields)
+        # Cross-driver executable reuse: `loop_cache` is any object with
+        # get_or_build(key, build) (service/executors.ExecutorCache).
+        # The compiled loop takes the problem TABLES as a runtime
+        # argument, so it depends only on shapes/specialization — two
+        # same-shape instances (e.g. all ten Taillard ta021-030) at the
+        # same lb/chunk on the same submesh share ONE trace + compile.
+        # `loop_key` carries the caller-side specialization (problem
+        # kind, jobs, machines, lb_kind, chunk, aux dtype); the driver
+        # appends everything else the trace closes over (device
+        # identities, capacity, balance knobs, row limit).
+        self.loop_cache = loop_cache
+        self.loop_key = tuple(loop_key) + tuple(
+            int(d.id) for d in mesh.devices.flat)
 
     def limit(self, capacity: int) -> int:
         return min(self.limit_fn(capacity), capacity - self.n_recv)
 
     def _loop(self, capacity: int):
         if capacity not in self._loops:
-            self._loops[capacity] = build_dist_loop(
+            build = lambda: build_dist_loop(  # noqa: E731
                 self.mesh, self.tables, self.make_local_step,
                 self.balance_period, self.transfer_cap, self.min_transfer,
                 limit=self.limit(capacity))
+            if self.loop_cache is not None:
+                # consult the shared cache ONCE per driver+capacity (the
+                # local memo absorbs the per-segment lookups), so its
+                # hit/miss counters read as requests-that-reused /
+                # actual-compiles
+                key = self.loop_key + (capacity, self.balance_period,
+                                       self.transfer_cap,
+                                       self.min_transfer,
+                                       self.limit(capacity))
+                self._loops[capacity] = self.loop_cache.get_or_build(
+                    key, build)
+            else:
+                self._loops[capacity] = build()
         return self._loops[capacity]
 
     def commit(self, state: SearchState) -> SearchState:
@@ -511,7 +538,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            checkpoint_path: str | None = None,
            checkpoint_every: int = 1,
            heartbeat=None, host_fraction: int = 0,
-           host_threads: int = 0) -> DistResult:
+           host_threads: int = 0,
+           stop_event=None, should_stop=None,
+           loop_cache=None, checkpoint_meta_extra=None) -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
@@ -547,7 +576,21 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     counts differ, so a preempted job restarts on a smaller or larger
     slice with no explored node lost. A torn/corrupt current snapshot
     rolls back to its rotating last-good sibling
-    (checkpoint.load_resilient) instead of poisoning the run."""
+    (checkpoint.load_resilient) instead of poisoning the run.
+
+    Service hooks (service/server.py drives these): `stop_event` (any
+    object with is_set()) and/or `should_stop(SegmentReport)` force
+    segmented execution and stop the search cleanly at the next segment
+    boundary — with a `checkpoint_path` the final state is saved first,
+    so a preempted request later RESUMES (possibly on a different-sized
+    submesh via the elastic reshard) instead of restarting.
+    `loop_cache` (get_or_build(key, build)) shares the compiled SPMD
+    loop across searches with identical specialization — the
+    serve-many-compile-once path (service/executors.ExecutorCache).
+    `checkpoint_meta_extra` (dict or callable returning one) is merged
+    into every checkpoint's meta — the service rides its cumulative
+    spent_s clock on it so compute budgets survive preempt/resume
+    across server lifetimes."""
     from . import checkpoint, hybrid
 
     if mesh is None:
@@ -580,7 +623,10 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     driver = _DistDriver(
         mesh, tables, make_local_step, balance_period, transfer_cap,
         min_transfer,
-        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs))
+        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs),
+        loop_cache=loop_cache,
+        loop_key=("pfsp", jobs, p_times.shape[0], lb_kind, chunk,
+                  str(adt)))
 
     session = None
     h_prmu = np.zeros((0, jobs), np.int16)
@@ -651,8 +697,13 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
 
     max_iters = (None if max_rounds is None
                  else max_rounds * balance_period)
+    stop_fn = None
+    if stop_event is not None or should_stop is not None:
+        def stop_fn(rep):
+            return ((stop_event is not None and stop_event.is_set())
+                    or (should_stop is not None and should_stop(rep)))
     if (segment_iters is None and checkpoint_path is None
-            and session is None):
+            and session is None and stop_fn is None):
         out = driver.run(state, max_iters)
     else:
         ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol,
@@ -665,6 +716,14 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                                    np.zeros((0, jobs), np.int16)),
                      "host_depth": (h_depth if session else
                                     np.zeros(0, np.int16))}
+        if checkpoint_meta_extra is not None:
+            base_meta = ckpt_meta
+
+            def ckpt_meta():
+                extra = (checkpoint_meta_extra()
+                         if callable(checkpoint_meta_extra)
+                         else checkpoint_meta_extra)
+                return {**base_meta, **extra}
 
         def run_fn(s, target):
             return driver.run(s, max_iters=target)
@@ -674,7 +733,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             checkpoint_path=checkpoint_path, heartbeat=heartbeat,
             checkpoint_every=checkpoint_every,
             max_total_iters=max_iters, checkpoint_meta=ckpt_meta,
-            post_segment=(session.post_segment if session else None))
+            post_segment=(session.post_segment if session else None),
+            should_stop=stop_fn)
 
     h_tree = h_sol = h_expanded = 0
     host_stats = {}

@@ -31,6 +31,38 @@ def worker_mesh(n_devices: int | None = None,
     return Mesh(np.asarray(devices), (WORKER_AXIS,))
 
 
+def partition_submeshes(n_submeshes: int,
+                        devices: list | None = None) -> list[Mesh]:
+    """Partition the device set into `n_submeshes` equal, disjoint 1-D
+    worker meshes (8 devices -> 2 submeshes of 4, 4 of 2, ...).
+
+    The search service schedules one request per submesh, so a
+    submesh is exactly the worker_mesh() shape the engines already
+    compile against — a request served on a submesh runs the same SPMD
+    program a standalone `n_devices=len(submesh)` run would, with
+    bit-identical node counts (device identity never enters the search;
+    only the worker count does).
+
+    Devices are split contiguously so each submesh keeps the locality
+    of the underlying topology (on real hardware, neighbouring chips on
+    the ICI torus; the platform's device order is already
+    locality-sorted). The device count must divide evenly: silently
+    dropping a remainder would strand capacity the operator believes is
+    serving.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_submeshes < 1:
+        raise ValueError(f"n_submeshes must be >= 1, got {n_submeshes}")
+    if len(devices) % n_submeshes:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {n_submeshes} "
+            f"equal submeshes; pick a divisor of the device count")
+    per = len(devices) // n_submeshes
+    return [worker_mesh(devices=list(devices[i * per:(i + 1) * per]))
+            for i in range(n_submeshes)]
+
+
 def shard_map(fn, mesh, in_specs, out_specs):
     """Version-tolerant shard_map wrapper.
 

@@ -1,0 +1,26 @@
+"""Search-as-a-service: an in-process async request scheduler.
+
+Public surface:
+
+- `SearchRequest` / request states — the request model (request.py)
+- `SearchServer` — submit/status/cancel/result over partitioned
+  submeshes with priority preemption and executable reuse (server.py)
+- `AdmissionError` — bounded-queue rejection (queueing.py)
+- `ExecutorCache` — serve-many-compile-once executable cache
+  (executors.py)
+- `spool` — file-based front-end used by the `serve`/`client` CLI
+  (spool.py)
+"""
+
+from .executors import ExecutorCache
+from .queueing import AdmissionError, RequestQueue
+from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
+                      RUNNING, TERMINAL_STATES, RequestRecord, SearchRequest)
+from .server import SearchServer
+
+__all__ = [
+    "AdmissionError", "ExecutorCache", "RequestQueue", "RequestRecord",
+    "SearchRequest", "SearchServer",
+    "QUEUED", "RUNNING", "PREEMPTED", "DONE", "CANCELLED", "DEADLINE",
+    "FAILED", "TERMINAL_STATES",
+]

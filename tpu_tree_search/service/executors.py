@@ -1,0 +1,66 @@
+"""Compiled-executable cache for the search service.
+
+The distributed loop costs seconds to minutes to trace + compile (the
+one-off cost utils/compile_cache amortizes ACROSS processes via XLA's
+persistent disk cache). This cache is the IN-PROCESS tier above it: the
+compiled callable itself, keyed by everything the trace specializes on —
+problem kind, (jobs, machines), lb_kind, chunk, aux dtype, the submesh's
+device identities, capacity and the balance knobs — and explicitly NOT
+on the instance data (the problem tables are runtime arguments to the
+compiled loop; see engine/distributed.build_dist_loop).
+
+That key design is the serve-many-compile-once property: all ten
+instances of a Taillard class (same jobs x machines) served at the same
+bound on the same submesh share ONE trace and ONE executable — request 1
+pays the compile, requests 2..10 start exploring immediately. The
+hit/miss counters ride the server's JSON status snapshot so the reuse is
+observable (and testable) in production, not assumed.
+
+Between this cache (same process) and compile_cache.enable() (XLA's
+persistent disk cache, same program shape across processes), a restarted
+server re-serves a warm traffic mix with ~1 s loads instead of ~45 s
+compiles.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+class ExecutorCache:
+    """Thread-safe get-or-build cache of compiled search loops.
+
+    `get_or_build(key, build)` is the whole interface
+    (engine/distributed._DistDriver consults it when a `loop_cache` is
+    injected). Builds run under the lock: two requests racing to build
+    the SAME key must not trace twice — and distinct keys are distinct
+    submeshes or shapes, whose builds are cheap closures anyway (jit is
+    lazy; XLA compilation happens at first call, outside the lock).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fns: dict[tuple, object] = {}
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: tuple, build):
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is not None:
+                self.hits += 1
+                return fn
+            self.misses += 1
+            fn = build()
+            self._fns[key] = fn
+            return fn
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._fns)
+
+    def snapshot(self) -> dict:
+        """JSON-safe stats for the status API."""
+        with self._lock:
+            return {"entries": len(self._fns), "hits": self.hits,
+                    "misses": self.misses}

@@ -1,0 +1,112 @@
+"""Bounded priority queue with admission control.
+
+The wait line in front of the scheduler: higher `priority` pops first,
+FIFO within a priority level (submission sequence breaks ties, and a
+preempted request keeps its original sequence number so preemption does
+not send it to the back of its class). Depth is bounded — a full queue
+REJECTS new work with a reason (`AdmissionError`) instead of buffering
+unboundedly, which is what separates a server under load from a server
+that falls over: the client learns immediately and can back off,
+re-prioritize, or go elsewhere.
+
+Requeued (preempted) entries do not count against the admission bound —
+they were already admitted; bouncing them on re-entry would turn
+preemption into silent request loss.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+
+from .request import PREEMPTED, QUEUED, RequestRecord
+
+
+class AdmissionError(RuntimeError):
+    """Request rejected at the door; `.reason` says why."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class RequestQueue:
+    """Thread-safe bounded max-priority queue of RequestRecords.
+
+    Entries whose state is no longer QUEUED/PREEMPTED (cancelled while
+    waiting, deadline-expired in line) are dropped lazily at pop time —
+    cancellation never has to hunt through the heap.
+    """
+
+    def __init__(self, max_depth: int):
+        if max_depth < 1:
+            raise ValueError(f"max_depth must be >= 1, got {max_depth}")
+        self.max_depth = max_depth
+        self._lock = threading.Lock()
+        self._heap: list[tuple[int, int, RequestRecord]] = []
+        self.rejected = 0          # admission-control rejections (stats)
+
+    def _prune(self) -> None:
+        # drop stale heads (cancelled/expired while queued)
+        while self._heap and self._heap[0][2].state not in (QUEUED,
+                                                            PREEMPTED):
+            heapq.heappop(self._heap)
+
+    def __len__(self) -> int:
+        with self._lock:
+            self._prune()
+            return sum(1 for _, _, r in self._heap
+                       if r.state in (QUEUED, PREEMPTED))
+
+    def admit(self, rec: RequestRecord) -> None:
+        """Admit a NEW request; raises AdmissionError when full."""
+        with self._lock:
+            self._prune()
+            depth = sum(1 for _, _, r in self._heap
+                        if r.state in (QUEUED, PREEMPTED))
+            if depth >= self.max_depth:
+                self.rejected += 1
+                raise AdmissionError(
+                    f"queue full: depth {depth} at the admission bound "
+                    f"{self.max_depth}; retry later or raise the bound")
+            heapq.heappush(self._heap,
+                           (-rec.request.priority, rec.seq, rec))
+
+    def requeue(self, rec: RequestRecord) -> None:
+        """Put a preempted/re-dispatched request back in line.
+        Bypasses the admission bound (the request was already admitted)."""
+        with self._lock:
+            heapq.heappush(self._heap,
+                           (-rec.request.priority, rec.seq, rec))
+
+    def pop_best(self) -> RequestRecord | None:
+        """Highest-priority waiting request, or None if empty."""
+        with self._lock:
+            self._prune()
+            if not self._heap:
+                return None
+            return heapq.heappop(self._heap)[2]
+
+    def best_priority(self) -> int | None:
+        """Priority of the head of the line (None if empty) — the
+        scheduler's preemption trigger."""
+        with self._lock:
+            self._prune()
+            return (self._heap[0][2].request.priority
+                    if self._heap else None)
+
+    def count_priority_above(self, priority: int) -> int:
+        """How many waiting requests outrank `priority` — the
+        scheduler's bound on how many preemptions are justified."""
+        with self._lock:
+            self._prune()
+            return sum(1 for _, _, r in self._heap
+                       if r.state in (QUEUED, PREEMPTED)
+                       and r.request.priority > priority)
+
+    def waiting_ids(self) -> list[str]:
+        """Queued request ids in pop order (status snapshots)."""
+        with self._lock:
+            self._prune()
+            return [r.id for _, _, r in sorted(self._heap)
+                    if r.state in (QUEUED, PREEMPTED)]

@@ -1,0 +1,170 @@
+"""Request model and lifecycle records for the search service.
+
+A `SearchRequest` is everything a client must say to get an instance
+solved: the problem table, the bound, an optional seed incumbent, and
+the serving policy knobs (priority, compute deadline, checkpoint tag).
+The server wraps each admitted request in a `RequestRecord` — the
+mutable lifecycle object that carries queue/run state, live progress
+counters (fed by the engine's per-segment heartbeat), and the terminal
+result.
+
+Lifecycle::
+
+    QUEUED -> RUNNING -> DONE
+                 |-> PREEMPTED -> (requeued) -> RUNNING -> ...
+                 |-> DEADLINE / CANCELLED / FAILED
+    QUEUED -> CANCELLED
+
+PREEMPTED is the only non-terminal detour: the request's state was
+checkpointed at the stop boundary, so the next dispatch RESUMES it —
+possibly on a different-sized submesh (the checkpoint layer's elastic
+reshard). DONE / CANCELLED / DEADLINE / FAILED are terminal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+# request states
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+PREEMPTED = "PREEMPTED"
+DONE = "DONE"
+CANCELLED = "CANCELLED"
+DEADLINE = "DEADLINE"
+FAILED = "FAILED"
+
+TERMINAL_STATES = frozenset({DONE, CANCELLED, DEADLINE, FAILED})
+
+
+@dataclasses.dataclass
+class SearchRequest:
+    """One solve request.
+
+    `deadline_s` bounds the request's ACCUMULATED EXECUTION time (summed
+    across dispatches), not its wall-clock time in the queue — the same
+    semantics as the campaign driver's per-instance TTS_BUDGET_S: a
+    request that waited behind others is not charged for the wait. A
+    request over its deadline is stopped at the next segment boundary
+    and lands in the DEADLINE terminal state with its partial counters
+    (and its checkpoint kept, so a later request with a larger deadline
+    can resume the work via the same `tag`).
+
+    `tag` names the request's checkpoint family inside the server's
+    workdir; it defaults to the assigned request id. Reusing a tag
+    across server lifetimes resumes the on-disk state.
+
+    `faults` is a TEST-ONLY per-request fault-injection spec
+    (utils/faults syntax), applied thread-scoped so it fires only in
+    this request's executor — the deterministic-service-test hook.
+    """
+
+    p_times: np.ndarray
+    lb_kind: int = 1
+    init_ub: int | None = None
+    priority: int = 0            # higher preempts lower
+    deadline_s: float | None = None
+    tag: str | None = None
+    # engine knobs (None = server/engine default)
+    chunk: int = 64
+    capacity: int | None = None
+    balance_period: int = 4
+    min_seed: int = 32
+    segment_iters: int | None = None
+    checkpoint_every: int | None = None
+    faults: str | None = None
+    # extra meta merged into every checkpoint this request writes (the
+    # campaign driver stamps inst/lb/chunk/ub_mode so the legacy
+    # supervisor's config screen accepts serve-mode checkpoints)
+    checkpoint_meta: dict | None = None
+
+    def validate(self) -> str | None:
+        """Admission-side validation; returns a rejection reason or None."""
+        p = np.asarray(self.p_times)
+        if p.ndim != 2 or p.shape[0] < 1 or p.shape[1] < 2:
+            return (f"p_times must be a (machines, jobs>=2) table, "
+                    f"got shape {p.shape}")
+        if self.lb_kind not in (0, 1, 2):
+            return f"lb_kind must be 0, 1 or 2, got {self.lb_kind}"
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            return f"deadline_s must be positive, got {self.deadline_s}"
+        if self.chunk < 1:
+            return f"chunk must be >= 1, got {self.chunk}"
+        return None
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """Server-side lifecycle record for one admitted request."""
+
+    id: str
+    request: SearchRequest
+    state: str = QUEUED
+    submitted_t: float = 0.0
+    started_t: float | None = None      # current dispatch's start
+    finished_t: float | None = None
+    spent_prev_s: float = 0.0           # execution time of past dispatches
+    submesh: int | None = None
+    dispatches: int = 0
+    preemptions: int = 0
+    failures: int = 0                   # submesh failures (re-dispatched)
+    error: str | None = None
+    checkpoint_path: str | None = None
+    hold: bool = False                  # preempted-and-held (ops drain)
+    progress: dict = dataclasses.field(default_factory=dict)
+    result: object | None = None        # DistResult (final or partial)
+    seq: int = 0                        # FIFO tiebreak within a priority
+    stop_reason: str | None = None      # why the current stop was asked
+    done_event: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+
+    def spent_s(self, now: float | None = None) -> float:
+        """Accumulated execution seconds (the deadline clock)."""
+        spent = self.spent_prev_s
+        if self.state == RUNNING and self.started_t is not None:
+            spent += (now if now is not None else time.monotonic()) \
+                - self.started_t
+        return spent
+
+    def over_deadline(self, now: float | None = None) -> bool:
+        d = self.request.deadline_s
+        return d is not None and self.spent_s(now) > d
+
+    def snapshot(self) -> dict:
+        """JSON-safe view for the status API."""
+        out = {
+            "id": self.id,
+            "state": self.state,
+            "priority": self.request.priority,
+            "deadline_s": self.request.deadline_s,
+            "lb_kind": self.request.lb_kind,
+            "shape": list(np.asarray(self.request.p_times).shape),
+            "submesh": self.submesh,
+            "dispatches": self.dispatches,
+            "preemptions": self.preemptions,
+            "failures": self.failures,
+            "spent_s": round(self.spent_s(), 3),
+            "error": self.error,
+            "progress": dict(self.progress),
+        }
+        res = self.result
+        if res is not None:
+            out["result"] = {
+                "best": int(res.best),
+                "explored_tree": int(res.explored_tree),
+                "explored_sol": int(res.explored_sol),
+                "complete": bool(res.complete),
+            }
+            tree = np.asarray(res.per_device.get("tree", []))
+            if tree.size:
+                # per-worker spread of the explored-node counters —
+                # the reference's boxplot bundle (utils/stats) riding
+                # the status API instead of a CSV post-pass
+                from ..utils import stats
+                bs = stats.compute_boxplot_stats(tree)
+                out["result"]["tree_per_worker"] = dataclasses.asdict(bs)
+        return out

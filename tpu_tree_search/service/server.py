@@ -1,0 +1,547 @@
+"""In-process asynchronous search server.
+
+The serving layer the reference architecture never had: its engine (and
+the repo's campaign driver until this PR) burns one process — one MPI
+world, one trace + compile — per instance. `SearchServer` is the
+tree-search analogue of a continuous-batching inference server: a
+long-lived process that multiplexes many concurrent solve requests onto
+the device mesh.
+
+Architecture::
+
+    submit() --admission--> RequestQueue --scheduler--> submesh slots
+                                              |             |
+                                        preempt/deadline    executor thread
+                                              |             per dispatch:
+                                        stop_event ----> distributed.search
+                                                          (segmented, ckpt)
+
+- The global mesh is partitioned into equal SUBMESHES
+  (parallel/mesh.partition_submeshes); each submesh serves one request
+  at a time with the unmodified SPMD engine, so a served request's node
+  counts are bit-identical to a standalone `distributed.search` run at
+  the same worker count.
+- The scheduler (one daemon thread) assigns the highest-priority queued
+  request to a free submesh, stops over-deadline requests, and PREEMPTS
+  a running lower-priority request when a higher-priority one waits with
+  no free submesh. Stops land at segment boundaries via the engine's
+  stop_event hook; the stopped state is checkpointed first, so a
+  preempted request later RESUMES — on whatever submesh is free, even a
+  different-sized one (checkpoint.reshard_state's elastic resume).
+- Compiled executables are shared across requests through an
+  ExecutorCache keyed by shape/bound/submesh — all instances of a
+  Taillard class share one compile (serve many, compile once).
+- A submesh failure (transient runtime/IO error escaping the engine's
+  own retry tier) re-dispatches the request with exponential backoff
+  (utils/retry); `service_retry_attempts` failures turn it FAILED.
+
+Everything is observable through `status_snapshot()` — a JSON-safe dict
+with queue depth, per-submesh occupancy, executor-cache hit rates and
+per-request counters — and per-request `status()` / `result()`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import os
+import pathlib
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+from ..utils import config as cfg
+from ..utils import faults
+from ..utils.retry import backoff_delay
+from .executors import ExecutorCache
+from .queueing import AdmissionError, RequestQueue
+from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
+                      RUNNING, TERMINAL_STATES, RequestRecord, SearchRequest)
+
+__all__ = ["SearchServer", "AdmissionError", "SearchRequest"]
+
+
+def _prior_spent_s(checkpoint_path: str) -> float:
+    """Accumulated execution seconds recorded in an existing checkpoint
+    under this tag (the `spent_s` meta key both the service and the
+    legacy campaign worker write), or 0.0 when there is none / it is
+    unreadable — budget continuity must never block a submission."""
+    for cand in (checkpoint_path, checkpoint_path + ".prev"):
+        try:
+            with np.load(cand) as z:
+                return float(z["meta_spent_s"])
+        except Exception:  # noqa: BLE001 — missing/torn/legacy file
+            continue
+    return 0.0
+
+
+class _Slot:
+    """One submesh and the request currently running on it."""
+
+    def __init__(self, index: int, mesh):
+        self.index = index
+        self.mesh = mesh
+        self.record: RequestRecord | None = None
+        self.thread: threading.Thread | None = None
+        self.stop_event: threading.Event | None = None
+
+    @property
+    def device_ids(self) -> list[int]:
+        return [int(d.id) for d in self.mesh.devices.flat]
+
+
+class SearchServer:
+    """Async search-as-a-service over a partitioned device mesh.
+
+    Lifecycle: construct (optionally inside a ``with`` block), `submit()`
+    requests, `status()`/`result()` them, `close()`. The scheduler
+    thread starts immediately unless ``autostart=False`` (submissions
+    then queue up until `start()` — useful for admission-control tests
+    and for pre-loading a batch before serving begins).
+    """
+
+    def __init__(self, n_submeshes: int = 1, devices=None,
+                 workdir: str | None = None,
+                 max_queue_depth: int = cfg.SERVICE_QUEUE_DEPTH_DEFAULT,
+                 segment_iters: int = cfg.SERVICE_SEGMENT_ITERS_DEFAULT,
+                 checkpoint_every: int = cfg.SERVICE_CHECKPOINT_EVERY_DEFAULT,
+                 poll_s: float = cfg.SERVICE_POLL_S_DEFAULT,
+                 service_retry_attempts: int =
+                 cfg.SERVICE_RETRY_ATTEMPTS_DEFAULT,
+                 service_retry_base_s: float =
+                 cfg.SERVICE_RETRY_BASE_S_DEFAULT,
+                 autostart: bool = True):
+        from ..parallel.mesh import partition_submeshes
+
+        self.slots = [_Slot(i, m) for i, m in
+                      enumerate(partition_submeshes(n_submeshes,
+                                                    devices=devices))]
+        self.workdir = pathlib.Path(
+            workdir if workdir is not None
+            else tempfile.mkdtemp(prefix="tts_service_"))
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.queue = RequestQueue(max_queue_depth)
+        self.cache = ExecutorCache()
+        self.segment_iters = segment_iters
+        self.checkpoint_every = checkpoint_every
+        self.poll_s = poll_s
+        self.service_retry_attempts = service_retry_attempts
+        self.service_retry_base_s = service_retry_base_s
+        self.records: dict[str, RequestRecord] = {}
+        self.counters = {"submitted": 0, "done": 0, "cancelled": 0,
+                         "deadline": 0, "failed": 0, "preemptions": 0,
+                         "redispatches": 0}
+        self._lock = threading.RLock()
+        self._seq = itertools.count()
+        self._t0 = time.monotonic()
+        self._closing = threading.Event()
+        self._scheduler: threading.Thread | None = None
+        if autostart:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        with self._lock:
+            if self._scheduler is None and not self._closing.is_set():
+                self._scheduler = threading.Thread(
+                    target=self._scheduler_loop, daemon=True,
+                    name="tts-service-scheduler")
+                self._scheduler.start()
+
+    def close(self, wait: bool = True) -> None:
+        """Stop serving: running requests are stopped at their next
+        segment boundary and left PREEMPTED with a fresh checkpoint (a
+        new server with the same workdir + tags resumes them); queued
+        requests are CANCELLED. Unblocks every `result()` waiter."""
+        self._closing.set()
+        with self._lock:
+            for slot in self.slots:
+                rec = slot.record
+                if rec is not None and slot.stop_event is not None:
+                    if rec.stop_reason is None:
+                        rec.stop_reason = "shutdown"
+                    slot.stop_event.set()
+        if wait:
+            if self._scheduler is not None:
+                self._scheduler.join()
+            for slot in self.slots:
+                th = slot.thread
+                if th is not None:
+                    th.join()
+        with self._lock:
+            for rec in self.records.values():
+                if rec.state == QUEUED:
+                    self._finalize(rec, CANCELLED, error="server shutdown")
+                rec.done_event.set()
+
+    def __enter__(self) -> "SearchServer":
+        self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ client API
+
+    def submit(self, request: SearchRequest) -> str:
+        """Admit a request; returns its id. Raises AdmissionError (with
+        `.reason`) when the queue is full, the request is invalid, or
+        the server is closed — rejection is immediate and explicit, the
+        client never learns about overload from a timeout."""
+        if self._closing.is_set():
+            self.queue.rejected += 1
+            raise AdmissionError("server closed")
+        reason = request.validate()
+        if reason is not None:
+            self.queue.rejected += 1
+            raise AdmissionError(f"invalid request: {reason}")
+        with self._lock:
+            seq = next(self._seq)
+            rid = f"req-{seq:04d}"
+            tag = request.tag or rid
+            path = str(self.workdir / f"{tag}.ckpt.npz")
+            holder = next(
+                (r for r in self.records.values()
+                 if r.checkpoint_path == path
+                 and r.state not in TERMINAL_STATES), None)
+            if holder is not None:
+                # two live requests sharing one checkpoint family would
+                # interleave snapshot writes and retire each other's
+                # files; resubmit-to-extend is only meaningful once the
+                # prior request is terminal
+                self.queue.rejected += 1
+                raise AdmissionError(
+                    f"tag {tag!r} is already active on request "
+                    f"{holder.id} ({holder.state}); wait for it to "
+                    "finish or cancel it first")
+            rec = RequestRecord(
+                id=rid, request=request, submitted_t=time.monotonic(),
+                seq=seq, checkpoint_path=path,
+                # a pre-existing checkpoint under this tag carries its
+                # accumulated execution clock (the meta both this
+                # service and the legacy campaign worker write): the
+                # compute deadline is CUMULATIVE across resumes, so a
+                # resubmitted tag gets the remainder of a larger
+                # budget, not a fresh one
+                spent_prev_s=_prior_spent_s(path))
+            self.queue.admit(rec)          # raises AdmissionError if full
+            self.records[rid] = rec
+            self.counters["submitted"] += 1
+            return rid
+
+    def status(self, request_id: str) -> dict:
+        """JSON-safe lifecycle/progress snapshot of one request."""
+        return self._rec(request_id).snapshot()
+
+    def result(self, request_id: str,
+               timeout: float | None = None) -> RequestRecord:
+        """Block until the request is terminal (or the server closes);
+        returns its record. Raises TimeoutError if `timeout` expires
+        first — the record is NOT terminal in that case."""
+        rec = self._rec(request_id)
+        if not rec.done_event.wait(timeout):
+            raise TimeoutError(
+                f"request {request_id} still {rec.state} after "
+                f"{timeout}s")
+        return rec
+
+    def cancel(self, request_id: str) -> bool:
+        """Cancel a request. Queued: terminal immediately. Running:
+        stopped at the next segment boundary. Returns False if it was
+        already terminal."""
+        with self._lock:
+            rec = self._rec(request_id)
+            if rec.state in TERMINAL_STATES:
+                return False
+            if rec.state in (QUEUED, PREEMPTED):
+                self._finalize(rec, CANCELLED)
+                return True
+            rec.stop_reason = "cancel"
+            self._stop_slot_of(rec)
+            return True
+
+    def preempt(self, request_id: str, hold: bool = False) -> bool:
+        """Operator preemption: stop a RUNNING request at its next
+        segment boundary, checkpoint it, and requeue it — or park it
+        (``hold=True``) until `release()`, e.g. to drain a request
+        before maintenance. Returns False unless it was running."""
+        with self._lock:
+            rec = self._rec(request_id)
+            if rec.state != RUNNING:
+                return False
+            rec.hold = hold
+            if rec.stop_reason is None:
+                rec.stop_reason = "preempt"
+            self._stop_slot_of(rec)
+            return True
+
+    def release(self, request_id: str) -> bool:
+        """Requeue a held PREEMPTED request (see `preempt(hold=True)`)."""
+        with self._lock:
+            rec = self._rec(request_id)
+            if rec.state != PREEMPTED or not rec.hold:
+                return False
+            rec.hold = False
+            self.queue.requeue(rec)
+            return True
+
+    def status_snapshot(self) -> dict:
+        """One JSON-safe dict describing the whole server: queue depth
+        and order, per-submesh occupancy, executor-cache hit/miss
+        counters, lifecycle counters, and every request's snapshot."""
+        with self._lock:
+            return {
+                "t": time.time(),
+                "uptime_s": round(time.monotonic() - self._t0, 3),
+                "queue": {"depth": len(self.queue),
+                          "waiting": self.queue.waiting_ids(),
+                          "max_depth": self.queue.max_depth,
+                          "rejected": self.queue.rejected},
+                "submeshes": [
+                    {"index": s.index, "devices": s.device_ids,
+                     "running": s.record.id if s.record else None}
+                    for s in self.slots],
+                "executor_cache": self.cache.snapshot(),
+                "counters": dict(self.counters),
+                "requests": {rid: rec.snapshot()
+                             for rid, rec in self.records.items()},
+            }
+
+    # ------------------------------------------------------------ internals
+
+    def _rec(self, request_id: str) -> RequestRecord:
+        try:
+            return self.records[request_id]
+        except KeyError:
+            raise KeyError(f"unknown request id {request_id!r}") from None
+
+    def _stop_slot_of(self, rec: RequestRecord) -> None:
+        for slot in self.slots:
+            if slot.record is rec and slot.stop_event is not None:
+                slot.stop_event.set()
+
+    def _finalize(self, rec: RequestRecord, state: str,
+                  error: str | None = None) -> None:
+        """Move a record to a terminal state (caller holds the lock)."""
+        rec.state = state
+        rec.error = error if error is not None else rec.error
+        rec.finished_t = time.monotonic()
+        key = {DONE: "done", CANCELLED: "cancelled",
+               DEADLINE: "deadline", FAILED: "failed"}[state]
+        self.counters[key] += 1
+        if state == DONE:
+            # retire the checkpoint family: a DONE snapshot left behind
+            # would make a tag-reusing resubmission instantly "resume"
+            # these counters as a fresh result (the campaign driver's
+            # retire-on-done rule). Every other terminal state KEEPS
+            # the files: DEADLINE so a larger-deadline resubmission of
+            # the tag extends the work, and CANCELLED/FAILED because
+            # the tag may name PRE-EXISTING progress this request never
+            # touched (a cancelled queued request must not destroy a
+            # prior run's partial checkpoint).
+            self._unlink_checkpoints(rec)
+        rec.done_event.set()
+
+    def _unlink_checkpoints(self, rec: RequestRecord) -> None:
+        if not rec.checkpoint_path:
+            return
+        for suffix in ("", ".prev", ".corrupt"):
+            with contextlib.suppress(OSError):
+                os.unlink(rec.checkpoint_path + suffix)
+
+    # ---------------------------------------------------------- scheduler
+
+    def _scheduler_loop(self) -> None:
+        while not self._closing.is_set():
+            self._tick()
+            time.sleep(self.poll_s)
+
+    def _tick(self) -> None:
+        with self._lock:
+            if self._closing.is_set():
+                # close() may win the lock between our loop-condition
+                # check and here; dispatching now would start a search
+                # whose stop_event close() has already swept past —
+                # close(wait=True) would then block on the full solve
+                return
+            now = time.monotonic()
+            # 1. deadline enforcement on running requests
+            for slot in self.slots:
+                rec = slot.record
+                if (rec is not None and rec.state == RUNNING
+                        and rec.stop_reason is None
+                        and rec.over_deadline(now)):
+                    rec.stop_reason = "deadline"
+                    slot.stop_event.set()
+            # 2. dispatch to free submeshes
+            for slot in self.slots:
+                if slot.record is not None:
+                    continue
+                rec = self.queue.pop_best()
+                while (rec is not None and rec.over_deadline(now)
+                       and rec.dispatches > 0):
+                    # a preempted request can exhaust its compute budget
+                    # while waiting in line; its partial result stands.
+                    # A NEVER-dispatched request over budget (a resumed
+                    # tag whose checkpoint already spent more than the
+                    # new deadline) still gets ONE dispatch — it stops
+                    # at its first segment boundary with a fresh partial
+                    # result, like the legacy campaign worker, instead
+                    # of finalizing with no result at all
+                    self._finalize(rec, DEADLINE)
+                    rec = self.queue.pop_best()
+                if rec is None:
+                    break
+                self._dispatch(slot, rec)
+            # 3. preemption: highest waiting priority vs running requests
+            best = self.queue.best_priority()
+            if best is None:
+                return
+            running = [s.record for s in self.slots
+                       if s.record is not None
+                       and s.record.state == RUNNING]
+            if not running or any(s.record is None for s in self.slots):
+                return
+            candidates = [r for r in running if r.stop_reason is None]
+            if not candidates:
+                return
+            victim = min(candidates,
+                         key=lambda r: (r.request.priority,
+                                        -(r.started_t or 0.0)))
+            if best <= victim.request.priority:
+                return
+            # don't over-preempt: stops already in flight will free slots
+            pending = sum(1 for r in running
+                          if r.stop_reason in ("preempt", "deadline",
+                                               "cancel"))
+            waiting_higher = self.queue.count_priority_above(
+                victim.request.priority)
+            if waiting_higher <= pending:
+                return
+            victim.stop_reason = "preempt"
+            self._stop_slot_of(victim)
+
+    def _dispatch(self, slot: _Slot, rec: RequestRecord) -> None:
+        """Start one executor thread for `rec` on `slot` (lock held)."""
+        rec.state = RUNNING
+        rec.submesh = slot.index
+        rec.dispatches += 1
+        rec.stop_reason = None
+        rec.started_t = time.monotonic()
+        slot.record = rec
+        slot.stop_event = threading.Event()
+        slot.thread = threading.Thread(
+            target=self._execute, args=(slot, rec), daemon=True,
+            name=f"tts-service-exec-{slot.index}")
+        slot.thread.start()
+
+    # ----------------------------------------------------------- executor
+
+    def _execute(self, slot: _Slot, rec: RequestRecord) -> None:
+        from ..engine import checkpoint, device, distributed
+
+        req = rec.request
+        p = np.asarray(req.p_times)
+        jobs, machines = p.shape[1], p.shape[0]
+        capacity = req.capacity or device.default_capacity(jobs, machines)
+        evt = slot.stop_event
+
+        def hb(rep):
+            rec.progress = {
+                "segment": rep.segment, "iters": rep.iters,
+                "tree": rep.tree, "sol": rep.sol, "best": rep.best,
+                "pool": rep.pool_size,
+                "elapsed_s": round(rep.elapsed, 3)}
+
+        # per-request fault injection stays thread-scoped: it must not
+        # leak into requests concurrently served on other submeshes
+        scope = (faults.scoped(req.faults) if req.faults is not None
+                 else contextlib.nullcontext())
+        res = error = None
+        try:
+            with scope:
+                res = distributed.search(
+                    p, lb_kind=req.lb_kind, init_ub=req.init_ub,
+                    mesh=slot.mesh, chunk=req.chunk, capacity=capacity,
+                    balance_period=req.balance_period,
+                    min_seed=req.min_seed,
+                    segment_iters=req.segment_iters or self.segment_iters,
+                    checkpoint_path=rec.checkpoint_path,
+                    checkpoint_every=(req.checkpoint_every
+                                      or self.checkpoint_every),
+                    heartbeat=hb, stop_event=evt, loop_cache=self.cache,
+                    # cumulative execution clock rides every checkpoint
+                    # (the legacy campaign worker's spent_s key), so
+                    # budgets survive preemption, server restarts and
+                    # legacy<->serve handoffs
+                    checkpoint_meta_extra=lambda: {
+                        **(req.checkpoint_meta or {}),
+                        "spent_s": round(rec.spent_s(), 2)})
+        except checkpoint.TRANSIENT_ERRORS as e:
+            error = f"transient: {e!r}"
+        except Exception as e:  # noqa: BLE001 — FAILED terminal below
+            error = f"{type(e).__name__}: {e}"
+            rec.failures = self.service_retry_attempts + 1  # no retry
+        self._on_finished(slot, rec, res, error)
+
+    def _on_finished(self, slot: _Slot, rec: RequestRecord,
+                     res, error: str | None) -> None:
+        requeue = backoff = None
+        with self._lock:
+            rec.spent_prev_s = rec.spent_s()
+            rec.started_t = None
+            reason = rec.stop_reason
+            if error is not None:
+                rec.failures += 1
+                rec.error = error
+                if (rec.failures <= self.service_retry_attempts
+                        and not self._closing.is_set()):
+                    # submesh failure: cool this slot down for the
+                    # backoff, then put the request back in line — the
+                    # scheduler may re-dispatch it to a DIFFERENT
+                    # submesh (the checkpoint, when one was written,
+                    # reshards elastically)
+                    rec.state = QUEUED
+                    self.counters["redispatches"] += 1
+                    backoff = backoff_delay(rec.failures - 1,
+                                            self.service_retry_base_s)
+                    requeue = rec
+                else:
+                    self._finalize(rec, FAILED, error=error)
+            else:
+                rec.result = res
+                rec.error = None     # a recovered transient is not an error
+                if res.complete:
+                    self._finalize(rec, DONE)
+                elif reason == "deadline" or rec.over_deadline():
+                    self._finalize(rec, DEADLINE)
+                elif reason == "cancel":
+                    self._finalize(rec, CANCELLED)
+                elif reason in ("preempt", "shutdown") or evt_set(slot):
+                    rec.state = PREEMPTED
+                    rec.preemptions += 1
+                    self.counters["preemptions"] += 1
+                    if reason != "shutdown" and not rec.hold \
+                            and not self._closing.is_set():
+                        requeue = rec
+                else:
+                    self._finalize(
+                        rec, FAILED,
+                        error="search stopped incomplete without a stop "
+                              "request (engine bug?)")
+        if backoff:
+            time.sleep(backoff)
+        if requeue is not None:
+            self.queue.requeue(requeue)
+        with self._lock:
+            slot.record = None
+            slot.stop_event = None
+            slot.thread = None
+
+
+def evt_set(slot: _Slot) -> bool:
+    evt = slot.stop_event
+    return evt is not None and evt.is_set()

@@ -1,0 +1,171 @@
+"""File-spool front-end for the search service.
+
+The transport-free way to talk to a `SearchServer` from another process:
+clients drop ``<id>.req.json`` files into a spool directory, the serving
+process ingests them and writes ``<id>.res.json`` when the request turns
+terminal. No sockets, no wire protocol to version — the same pattern as
+the campaign driver's status files, and it composes with any batch
+system that can touch a shared filesystem. (A real HTTP front-end is a
+ROADMAP follow-on; it would sit exactly where this module sits.)
+
+Request JSON::
+
+    {"inst": 21,                 # Taillard id — OR "p_times": [[...]]
+     "lb": 1, "ub": "opt",       # ub: "opt" | integer | null
+     "priority": 0, "deadline_s": null,
+     "chunk": 64, "capacity": null, "tag": null}
+
+Result JSON: the request's final `RequestRecord.snapshot()` plus the
+spool id. Writes on both sides are atomic (tmp + rename) so a reader
+never sees a torn file.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import pathlib
+import time
+
+import numpy as np
+
+from .request import SearchRequest
+
+REQ_SUFFIX = ".req.json"
+RES_SUFFIX = ".res.json"
+
+# default spool ids: timestamp + pid + per-process counter — two
+# submissions in the same millisecond must not collide (the second
+# would overwrite the first's request file and be silently dropped)
+_spool_seq = itertools.count()
+
+
+def _atomic_write_json(path: pathlib.Path, payload: dict) -> None:
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    tmp.write_text(json.dumps(payload, indent=1))
+    os.replace(tmp, path)
+
+
+def request_from_payload(payload: dict) -> SearchRequest:
+    """Build a SearchRequest from a spool request dict."""
+    if "p_times" in payload:
+        p = np.asarray(payload["p_times"], np.int32)
+    elif "inst" in payload:
+        from ..problems import taillard
+        p = taillard.processing_times(int(payload["inst"]))
+    else:
+        raise ValueError("request needs 'inst' or 'p_times'")
+    ub = payload.get("ub")
+    if ub == "opt":
+        if "inst" not in payload:
+            raise ValueError("'ub': 'opt' needs a Taillard 'inst'")
+        from ..problems import taillard
+        ub = taillard.optimal_makespan(int(payload["inst"]))
+    kwargs = {}
+    for k in ("priority", "chunk", "balance_period", "min_seed",
+              "segment_iters", "checkpoint_every"):
+        if payload.get(k) is not None:
+            kwargs[k] = int(payload[k])
+    if payload.get("capacity") is not None:
+        kwargs["capacity"] = int(payload["capacity"])
+    if payload.get("deadline_s") is not None:
+        kwargs["deadline_s"] = float(payload["deadline_s"])
+    return SearchRequest(
+        p_times=p, lb_kind=int(payload.get("lb", 1)),
+        init_ub=None if ub is None else int(ub),
+        tag=payload.get("tag"), faults=payload.get("faults"), **kwargs)
+
+
+def submit_file(spool: str | pathlib.Path, payload: dict,
+                spool_id: str | None = None) -> str:
+    """Client side: atomically drop a request file; returns the spool id."""
+    spool = pathlib.Path(spool)
+    spool.mkdir(parents=True, exist_ok=True)
+    spool_id = spool_id or (f"{int(time.time() * 1000):x}-{os.getpid()}"
+                            f"-{next(_spool_seq)}")
+    _atomic_write_json(spool / f"{spool_id}{REQ_SUFFIX}", payload)
+    return spool_id
+
+
+def wait_result(spool: str | pathlib.Path, spool_id: str,
+                timeout: float | None = None,
+                poll_s: float = 0.2) -> dict:
+    """Client side: poll for the result file; returns its dict."""
+    path = pathlib.Path(spool) / f"{spool_id}{RES_SUFFIX}"
+    t0 = time.monotonic()
+    while True:
+        if path.exists():
+            return json.loads(path.read_text())
+        if timeout is not None and time.monotonic() - t0 > timeout:
+            raise TimeoutError(f"no result for {spool_id} after {timeout}s")
+        time.sleep(poll_s)
+
+
+def serve_spool(server, spool: str | pathlib.Path,
+                idle_exit_s: float | None = None,
+                status_every_s: float | None = None,
+                poll_s: float = 0.2, emit=print,
+                should_exit=None) -> int:
+    """Server side: ingest request files into `server`, write result
+    files as requests turn terminal. Returns the number of requests
+    served. Exits when `idle_exit_s` elapses with nothing queued,
+    running or pending (None = run until `should_exit()`), printing a
+    JSON status snapshot every `status_every_s` seconds.
+
+    A malformed or rejected request file still gets a result file (with
+    an ``"error"``) — a client polling for it must not hang forever on
+    a bad submission.
+    """
+    from .queueing import AdmissionError
+    from .request import TERMINAL_STATES
+
+    spool = pathlib.Path(spool)
+    spool.mkdir(parents=True, exist_ok=True)
+    pending: dict[str, str] = {}        # spool id -> request id
+    seen: set[str] = set()
+    served = 0
+    last_work = time.monotonic()
+    last_status = 0.0
+    while True:
+        for req_file in sorted(spool.glob(f"*{REQ_SUFFIX}")):
+            sid = req_file.name[:-len(REQ_SUFFIX)]
+            if sid in seen:
+                continue
+            seen.add(sid)
+            if (spool / f"{sid}{RES_SUFFIX}").exists():
+                # already served (by this process or a previous server
+                # lifetime): a restart must not re-execute history or
+                # clobber a result file a client may be reading
+                continue
+            try:
+                payload = json.loads(req_file.read_text())
+                rid = server.submit(request_from_payload(payload))
+            except (AdmissionError, ValueError, KeyError,
+                    json.JSONDecodeError) as e:
+                _atomic_write_json(
+                    spool / f"{sid}{RES_SUFFIX}",
+                    {"spool_id": sid, "state": "REJECTED",
+                     "error": str(e)})
+                continue
+            pending[sid] = rid
+        for sid, rid in list(pending.items()):
+            snap = server.status(rid)
+            if snap["state"] in TERMINAL_STATES:
+                _atomic_write_json(spool / f"{sid}{RES_SUFFIX}",
+                                   {"spool_id": sid, **snap})
+                del pending[sid]
+                served += 1
+        busy = bool(pending) or len(server.queue) > 0 or any(
+            s.record is not None for s in server.slots)
+        now = time.monotonic()
+        if busy:
+            last_work = now
+        if status_every_s and now - last_status > status_every_s:
+            emit(json.dumps(server.status_snapshot()))
+            last_status = now
+        if should_exit is not None and should_exit():
+            return served
+        if idle_exit_s is not None and now - last_work > idle_exit_s:
+            return served
+        time.sleep(poll_s)

@@ -21,6 +21,22 @@ RETRY_ATTEMPTS_DEFAULT = 3
 RETRY_BASE_S_DEFAULT = 0.5
 SEGMENT_TIMEOUT_S_DEFAULT = 0.0   # 0 = watchdog off
 
+# Search-service defaults (service/server.SearchServer). Module constants
+# for the same reason as the retry knobs above: the service and the CLI
+# `serve` entry both read them, and env overrides (TTS_SUBMESHES,
+# TTS_QUEUE_DEPTH) must survive a campaign-driver respawn.
+SERVICE_QUEUE_DEPTH_DEFAULT = 64      # admission control: reject beyond
+SERVICE_SEGMENT_ITERS_DEFAULT = 512   # preemption/deadline granularity —
+                                      # stop flags are honored at segment
+                                      # boundaries, so this bounds the
+                                      # service's reaction latency
+SERVICE_CHECKPOINT_EVERY_DEFAULT = 4  # segments between periodic saves
+                                      # (a stop/preempt always saves)
+SERVICE_POLL_S_DEFAULT = 0.02         # scheduler poll period
+SERVICE_RETRY_ATTEMPTS_DEFAULT = 2    # re-dispatches after a submesh
+                                      # failure before a request FAILs
+SERVICE_RETRY_BASE_S_DEFAULT = 0.2    # re-dispatch backoff base
+
 
 @dataclasses.dataclass
 class PFSPConfig:

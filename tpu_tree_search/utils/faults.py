@@ -25,20 +25,35 @@ programmatically via :func:`configure`:
     TTS_FAULTS="fail_host_fetch=1"           # first 1 host fetches raise
                                              # InjectedFault (transient
                                              # device/tunnel error)
+    TTS_FAULTS="delay_every=0.05"            # sleep 0.05 s before EVERY
+                                             # segment (uniform slowdown —
+                                             # makes short searches span
+                                             # many wall-clock segments so
+                                             # preemption/deadline tests
+                                             # have a window to act in)
 
 Specs compose: ``"delay_segment=2:0.1,kill_after_segment=4"``. Unknown
 names raise at parse time — a typo'd fault spec that silently injects
 nothing would green-light an untested recovery path.
 
-Counters ("once" semantics, e.g. fail_host_fetch) are per-process: a
-respawned worker re-arms them, which is exactly the transient-error
-model (the retried operation succeeds).
+Counters ("once" semantics, e.g. fail_host_fetch) live ON the plan
+object: a respawned worker re-parses TTS_FAULTS into a fresh plan and
+re-arms them — exactly the transient-error model (the retried operation
+succeeds) — and concurrently scoped plans each have their own budget.
+
+Plans can also be THREAD-SCOPED via :func:`scoped`: the search service
+runs one executor thread per submesh, and a per-request fault plan must
+hit only that request's segments — a process-global plan would delay or
+kill every concurrently served request. ``scoped(None)`` masks the
+global plan for the thread (a clean request beside a faulty one).
 """
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import threading
 import time
 
 
@@ -60,7 +75,12 @@ class FaultPlan:
     corrupt_checkpoint: int | None = None    # flip bytes in the file
                                              # written at this segment
     delay_segment: tuple[int, float] | None = None   # (segment, seconds)
+    delay_every: float = 0.0                 # sleep before EVERY segment
     fail_host_fetch: int = 0                 # fail the first N fetches
+    # fire count lives ON the plan (not module state): a thread-scoped
+    # plan must have its own injection budget — concurrent requests with
+    # scoped plans would otherwise spend each other's failures
+    fetch_failures_fired: int = dataclasses.field(default=0, repr=False)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -78,6 +98,8 @@ class FaultPlan:
             elif name == "delay_segment":
                 seg, _, secs = val.partition(":")
                 plan.delay_segment = (int(seg), float(secs or 0.1))
+            elif name == "delay_every":
+                plan.delay_every = float(val)
             elif name == "fail_host_fetch":
                 plan.fail_host_fetch = int(val)
             else:
@@ -86,31 +108,52 @@ class FaultPlan:
         return plan
 
 
-# module state: the active plan and the per-process fire counters
+# module state: the active global plan (fire counters live on the plan)
 _plan: FaultPlan | None = None
 _configured = False        # False: (re)read TTS_FAULTS lazily
-_fetch_failures = 0
+_tls = threading.local()   # per-thread plan overlay stack (scoped())
 
 
 def configure(plan: FaultPlan | str | None) -> None:
     """Install a plan programmatically (tests); None disarms entirely."""
-    global _plan, _configured, _fetch_failures
+    global _plan, _configured
     _plan = FaultPlan.parse(plan) if isinstance(plan, str) else plan
     _configured = True
-    _fetch_failures = 0
 
 
 def reset() -> None:
     """Back to env-driven lazy configuration (test teardown)."""
-    global _plan, _configured, _fetch_failures
+    global _plan, _configured
     _plan = None
     _configured = False
-    _fetch_failures = 0
+
+
+@contextlib.contextmanager
+def scoped(plan: FaultPlan | str | None):
+    """Overlay a plan for the CURRENT THREAD only (nestable). Inside the
+    context, :func:`active` returns this plan instead of the global one;
+    other threads keep seeing the global/env plan. ``scoped(None)``
+    masks any global plan (a deliberately clean thread). The search
+    service uses this so a per-request fault spec fires only in that
+    request's executor thread."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(FaultPlan.parse(plan) if isinstance(plan, str) else plan)
+    try:
+        yield
+    finally:
+        stack.pop()
 
 
 def active() -> FaultPlan | None:
-    """The current plan (lazily parsed from TTS_FAULTS), or None."""
+    """The current plan — the innermost thread-scoped overlay if one is
+    installed (see :func:`scoped`), else the global/env plan (lazily
+    parsed from TTS_FAULTS), or None."""
     global _plan, _configured
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
     if not _configured:
         spec = os.environ.get("TTS_FAULTS", "")
         _plan = FaultPlan.parse(spec) if spec else None
@@ -136,7 +179,8 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
     """Trigger the injection point `point` if the active plan arms it.
 
     Points (all no-ops without a matching plan entry):
-    - "segment_start"   (segment=k): sleep if delay_segment targets k.
+    - "segment_start"   (segment=k): sleep delay_every (every segment)
+      and/or the delay_segment sleep if it targets k.
     - "post_checkpoint" (segment=k, path=...): corrupt the just-written
       checkpoint file if corrupt_checkpoint targets k.
     - "post_segment"    (segment=k): os._exit(KILL_EXIT_CODE) if
@@ -151,6 +195,8 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
     if plan is None:
         return
     if point == "segment_start":
+        if plan.delay_every > 0:
+            time.sleep(plan.delay_every)
         if plan.delay_segment and segment == plan.delay_segment[0]:
             time.sleep(plan.delay_segment[1])
     elif point == "post_checkpoint":
@@ -165,9 +211,8 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
             # os._exit is the honest simulation
             os._exit(KILL_EXIT_CODE)
     elif point == "host_fetch":
-        global _fetch_failures
-        if _fetch_failures < plan.fail_host_fetch:
-            _fetch_failures += 1
+        if plan.fetch_failures_fired < plan.fail_host_fetch:
+            plan.fetch_failures_fired += 1
             raise InjectedFault(
                 f"injected host-fetch failure "
-                f"{_fetch_failures}/{plan.fail_host_fetch}")
+                f"{plan.fetch_failures_fired}/{plan.fail_host_fetch}")
