@@ -1,0 +1,469 @@
+"""Observability layer: flight recorder, metrics registry, Chrome
+export, HTTP front-end — and the end-to-end serve-session acceptance.
+
+The load-bearing assertions:
+
+- a CPU-backend serve session with 3 concurrent requests (one preempted
+  and resumed) leaves a JSONL event log whose per-request span SEQUENCE
+  is deterministic (admit -> dispatch -> checkpoint.save -> preempt ->
+  resume -> checkpoint.load -> done, matching request ids);
+- the retry counter increments EXACTLY once per injected transient
+  (fail_host_fetch=1 => tts_retries_total == 1);
+- /metrics exposes the request-state and executor-cache counters as
+  Prometheus text; /status and /trace serve JSON; /healthz flips to 503
+  on shutdown;
+- tools/trace_summary.py parses both artifact formats (JSONL + Chrome)
+  and reports the preemption;
+- instrumentation is OBSERVATION-ONLY: served node counts stay
+  bit-identical to standalone `distributed.search`.
+"""
+
+import json
+import os
+import pathlib
+import shutil
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import distributed
+from tpu_tree_search.obs import chrome_trace, metrics, tracelog
+from tpu_tree_search.obs.httpd import start_http_server
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+@pytest.fixture
+def fresh_obs(tmp_path):
+    """Isolated global recorder (with a JSONL sink) + default registry:
+    obs state is process-global by design, so tests swap it."""
+    log = tracelog.TraceLog(capacity=1 << 16,
+                            sink_path=tmp_path / "trace.jsonl")
+    prev_log = tracelog.install(log)
+    reg = metrics.Registry()
+    prev_reg = metrics.install(reg)
+    try:
+        yield log, reg
+    finally:
+        tracelog.install(prev_log)
+        metrics.install(prev_reg)
+
+
+# ------------------------------------------------------------ unit: tracelog
+
+def test_tracelog_span_event_context_and_ring():
+    log = tracelog.TraceLog(capacity=4)
+    with log.context(request_id="r1", submesh=2):
+        with log.span("work", phase="x") as sp:
+            log.event("tick", n=1)
+        assert sp.dur >= 0
+    recs = log.records()
+    assert [r["name"] for r in recs] == ["tick", "work"]  # span at exit
+    for r in recs:
+        assert r["request_id"] == "r1" and r["submesh"] == 2
+    assert recs[1]["kind"] == "span" and "dur" in recs[1]
+    assert recs[0]["kind"] == "event"
+    # ring bound: old records drop, recorder never grows unbounded
+    for i in range(10):
+        log.event("e", i=i)
+    assert len(log) == 4
+    assert log.dropped > 0
+
+
+def test_tracelog_span_records_error_and_reraises():
+    log = tracelog.TraceLog()
+    with pytest.raises(ValueError):
+        with log.span("boom"):
+            raise ValueError("nope")
+    (rec,) = log.records()
+    assert "ValueError" in rec["error"]
+
+
+def test_tracelog_sink_roundtrip(tmp_path):
+    path = tmp_path / "t.jsonl"
+    log = tracelog.TraceLog(sink_path=path)
+    log.event("a", x=1)
+    with log.span("b"):
+        pass
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    assert lines[0]["kind"] == "meta" and "t0_unix" in lines[0]
+    recs = chrome_trace.read_jsonl(path)   # meta line filtered
+    assert [r["name"] for r in recs] == ["a", "b"]
+    # exotic attr values serialize instead of poisoning the sink
+    log.event("c", arr=np.int64(3), obj=object())
+    assert json.loads(path.read_text().splitlines()[-1])["arr"] == 3
+
+
+# ------------------------------------------------------------- unit: metrics
+
+def test_metrics_counter_gauge_histogram_expositions():
+    reg = metrics.Registry()
+    c = reg.counter("tts_requests_total", "by state")
+    c.inc(state="done")
+    c.inc(2, state="failed")
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("tts_queue_depth", "live")
+    g.set_fn(lambda: 7)
+    h = reg.histogram("tts_lat_seconds", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(5.0)
+    text = reg.to_prometheus()
+    assert 'tts_requests_total{state="done"} 1' in text
+    assert 'tts_requests_total{state="failed"} 2' in text
+    assert "# TYPE tts_requests_total counter" in text
+    assert "tts_queue_depth 7" in text
+    assert 'tts_lat_seconds_bucket{le="0.1"} 1' in text
+    assert 'tts_lat_seconds_bucket{le="+Inf"} 2' in text
+    assert "tts_lat_seconds_count 2" in text
+    j = reg.to_json()
+    assert j["tts_queue_depth"] == 7.0
+    assert j["tts_lat_seconds"]["count"] == 2
+    json.dumps(j)                      # JSON-safe end to end
+    # one name, one type: a re-registration under another type is a bug
+    with pytest.raises(TypeError):
+        reg.gauge("tts_requests_total")
+
+
+# -------------------------------------------------------- unit: chrome trace
+
+def test_chrome_trace_tracks_and_event_kinds(tmp_path):
+    log = tracelog.TraceLog()
+    with log.context(request_id="r0", submesh=1):
+        with log.span("request.execute"):
+            pass
+    log.event("server.start")          # no submesh -> thread lane
+    doc = chrome_trace.to_chrome(log.records())
+    lanes = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert "submesh-1" in lanes
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    ins = [e for e in doc["traceEvents"] if e["ph"] == "i"]
+    assert len(xs) == 1 and xs[0]["name"] == "request.execute"
+    assert xs[0]["args"]["request_id"] == "r0"
+    assert len(ins) == 1
+    out = chrome_trace.write_chrome(tmp_path / "t.json", log.records())
+    assert json.loads(pathlib.Path(out).read_text())["traceEvents"]
+
+
+# ------------------------------------------------- retry counter exactness
+
+def test_retry_counter_counts_each_transient_exactly(fresh_obs):
+    log, reg = fresh_obs
+    from tpu_tree_search.utils.retry import retry_call
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("transient")
+        return "ok"
+
+    assert retry_call(flaky, what="unit-op", attempts=5, base_s=0.0,
+                      sleep=lambda _: None) == "ok"
+    assert reg.counter("tts_retries_total").value(what="unit-op") == 2
+    retries = [r for r in log.records() if r["name"] == "retry"]
+    assert len(retries) == 2
+    assert retries[0]["what"] == "unit-op"
+
+
+# --------------------------------------------------- phase attribution view
+
+def test_publish_attribution_gauges(fresh_obs):
+    _, reg = fresh_obs
+    from tpu_tree_search.utils import phase_timing
+
+    att = phase_timing.attribute(
+        {"bound": 2e-3, "step": 5e-3, "compact": 3e-3,
+         "per_eval": 2e-3 / 128},
+        elapsed=1.0, evals=[12800, 3200], iters=[100, 100])
+    phase_timing.publish_attribution(att, request="req-0000")
+    g = reg.gauge("tts_phase_seconds")
+    k0 = g.value(phase="kernel", worker=0, request="req-0000")
+    k1 = g.value(phase="kernel", worker=1, request="req-0000")
+    assert k0 == pytest.approx(att["kernel_time"][0])
+    assert k0 > k1 > 0
+    assert 'phase="idle"' in reg.to_prometheus()
+
+
+# --------------------------------------------------------- e2e serve session
+
+@pytest.fixture(scope="module")
+def baselines():
+    """Standalone distributed.search totals at 4 workers (the submesh
+    size the 2-submesh server serves at) — the bit-identical anchor."""
+    out = {}
+    for seed, jobs in [(5, 8), (6, 7), (2, 7)]:
+        inst = PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+        got = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                                 n_devices=4, **KW)
+        out[seed] = (got.explored_tree, got.explored_sol, got.best)
+    return out
+
+
+def _first_index(names, name):
+    assert name in names, f"{name} missing from {names}"
+    return names.index(name)
+
+
+def test_serve_session_flight_recorder_end_to_end(fresh_obs, baselines,
+                                                  tmp_path):
+    """The acceptance run: 3 concurrent requests on 2 submeshes, the
+    low-priority victim preempted by a high-priority arrival and
+    resumed; one request carries an injected transient. Asserts the
+    span sequence, the exact retry count, the HTTP surface, both trace
+    artifacts (via tools/trace_summary.py), and bit-identical counts."""
+    log, reg = fresh_obs
+    slow = PFSPInstance.synthetic(jobs=8, machines=3, seed=5)
+    fast = PFSPInstance.synthetic(jobs=7, machines=3, seed=6)
+    other = PFSPInstance.synthetic(jobs=7, machines=3, seed=2)
+    with SearchServer(n_submeshes=2, workdir=tmp_path / "wd") as srv:
+        httpd = start_http_server(srv)
+        try:
+            # two low-priority requests occupy both submeshes; the
+            # delay_every faults keep them running long enough for the
+            # high-priority arrival to need a preemption, and the
+            # fail_host_fetch on `ra` injects exactly one transient
+            ra = srv.submit(SearchRequest(
+                p_times=slow.p_times, lb_kind=1, priority=0,
+                segment_iters=32, checkpoint_every=1,
+                faults="delay_every=0.15,fail_host_fetch=1", **KW))
+            rb = srv.submit(SearchRequest(
+                p_times=slow.p_times, lb_kind=1, priority=0,
+                tag="victim-b", segment_iters=32, checkpoint_every=1,
+                faults="delay_every=0.15", **KW))
+            t0 = time.monotonic()
+            while not all(srv.status(r)["state"] == "RUNNING"
+                          for r in (ra, rb)):
+                assert time.monotonic() - t0 < 120
+                time.sleep(0.02)
+            hi = srv.submit(SearchRequest(
+                p_times=fast.p_times, lb_kind=1, priority=10,
+                segment_iters=256, **KW))
+            rec_hi = srv.result(hi, timeout=300)
+            assert rec_hi.state == "DONE", (rec_hi.state, rec_hi.error)
+            recs = {r: srv.result(r, timeout=600) for r in (ra, rb)}
+            assert all(r.state == "DONE" for r in recs.values())
+
+            # ---- observation-only: counts bit-identical to standalone
+            for r in recs.values():
+                res = r.result
+                assert (res.explored_tree, res.explored_sol,
+                        res.best) == baselines[5]
+            res = rec_hi.result
+            assert (res.explored_tree, res.explored_sol,
+                    res.best) == baselines[6]
+
+            # ---- the retry counter increments EXACTLY once per
+            # injected transient (>= 1 fires; a preempted `ra` re-arms
+            # its per-dispatch plan, so count injections, then demand
+            # counter == injections)
+            faults_fired = [r for r in log.records()
+                            if r["name"] == "fault.injected"
+                            and r.get("fault") == "fail_host_fetch"]
+            assert len(faults_fired) >= 1
+            assert all(f["request_id"] == ra for f in faults_fired)
+            assert reg.counter("tts_retries_total").value(
+                what="per-segment host fetch") == len(faults_fired)
+
+            # ---- the preempted request's span sequence, matching ids
+            victim = next(r for r in (ra, rb)
+                          if recs[r].preemptions >= 1)
+            seq = [r["name"] for r in log.records()
+                   if r.get("request_id") == victim]
+            order = [_first_index(seq, n) for n in (
+                "request.admit", "request.dispatch", "checkpoint.save",
+                "request.preempt", "request.resume", "checkpoint.load",
+                "request.done")]
+            assert order == sorted(order), (victim, seq)
+            # the resume really is a SECOND dispatch
+            assert seq.count("request.dispatch") >= 2
+            # every lifecycle record carries the submesh it happened on
+            assert all(r.get("submesh") is not None
+                       for r in log.records()
+                       if r["name"] == "request.dispatch")
+
+            # ---- HTTP surface
+            m = urllib.request.urlopen(httpd.url + "/metrics",
+                                       timeout=10).read().decode()
+            assert 'tts_requests_total{state="done"} 3' in m
+            assert "tts_executor_cache_hits_total" in m
+            assert "tts_executor_cache_misses_total" in m
+            assert "tts_preemptions_total 1" in m
+            assert "tts_checkpoint_saves_total" in m     # engine registry
+            s = json.loads(urllib.request.urlopen(
+                httpd.url + "/status", timeout=10).read())
+            assert s["counters"]["done"] == 3
+            assert s["metrics"]["tts_requests_submitted_total"] == 3
+            hz = urllib.request.urlopen(httpd.url + "/healthz",
+                                        timeout=10)
+            assert hz.status == 200
+            chrome = json.loads(urllib.request.urlopen(
+                httpd.url + "/trace", timeout=10).read())
+            assert any(e.get("name") == "request.preempt"
+                       for e in chrome["traceEvents"])
+
+            # the snapshot's counters are a view over the SAME registry
+            assert srv.counters["done"] == 3
+            assert srv.counters["preemptions"] == \
+                int(srv.metrics.counter("tts_preemptions_total").value())
+        finally:
+            httpd.close()
+
+    # ---- both artifacts parse through tools/trace_summary.py
+    import trace_summary
+    jsonl = tmp_path / "trace.jsonl"
+    chrome_path = chrome_trace.write_chrome(tmp_path / "trace.chrome.json",
+                                            log.records())
+    for artifact in (str(jsonl), chrome_path):
+        reqs = trace_summary.summarize(trace_summary.load_records(artifact))
+        assert reqs[victim]["preemptions"] >= 1
+        assert reqs[victim]["state"] == "DONE"
+        assert reqs[victim]["dispatches"] >= 2
+        assert trace_summary.main([artifact]) == 0
+    # CI artifact hand-off: the workflow uploads this directory
+    art = os.environ.get("TTS_OBS_ARTIFACT_DIR")
+    if art:
+        os.makedirs(art, exist_ok=True)
+        shutil.copy(jsonl, os.path.join(art, "serve_trace.jsonl"))
+        shutil.copy(chrome_path,
+                    os.path.join(art, "serve_trace.chrome.json"))
+
+
+def test_cli_serve_spool_http_smoke(fresh_obs, tmp_path):
+    """The ROADMAP follow-on, end to end through the real CLI: `serve
+    --http-port --trace-file` over a file spool on the CPU backend —
+    /healthz, /metrics and /status answer while a spooled request is
+    served, and the trace file holds the session's event log."""
+    import socket
+    import threading
+
+    from tpu_tree_search import cli
+    from tpu_tree_search.service import spool as spool_mod
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    spool_dir = tmp_path / "spool"
+    trace = tmp_path / "cli_trace.jsonl"
+    inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=1)
+    sid = spool_mod.submit_file(
+        spool_dir, {"p_times": inst.p_times.tolist(), "lb": 1,
+                    "chunk": 8, "capacity": 1 << 12, "min_seed": 4})
+    th = threading.Thread(
+        target=cli.main,
+        args=(["serve", "--spool", str(spool_dir), "--submeshes", "2",
+               "--idle-exit", "2", "--status-every", "0",
+               "--http-port", str(port), "--trace-file", str(trace)],),
+        daemon=True)
+    th.start()
+    base = f"http://127.0.0.1:{port}"
+    deadline = time.monotonic() + 120
+    while True:
+        try:
+            assert urllib.request.urlopen(base + "/healthz",
+                                          timeout=2).status == 200
+            break
+        except (urllib.error.URLError, ConnectionError, OSError):
+            assert time.monotonic() < deadline, "HTTP never came up"
+            time.sleep(0.1)
+    res = spool_mod.wait_result(spool_dir, sid, timeout=300)
+    assert res["state"] == "DONE"
+    m = urllib.request.urlopen(base + "/metrics",
+                               timeout=10).read().decode()
+    assert 'tts_requests_total{state="done"} 1' in m
+    snap = json.loads(urllib.request.urlopen(base + "/status",
+                                             timeout=10).read())
+    assert snap["counters"]["done"] == 1
+    th.join(timeout=120)
+    assert not th.is_alive(), "serve CLI did not idle-exit"
+    recs = chrome_trace.read_jsonl(trace)
+    assert any(r["name"] == "request.done" for r in recs)
+
+
+def test_healthz_flips_to_503_on_close(fresh_obs, tmp_path):
+    srv = SearchServer(n_submeshes=2, workdir=tmp_path, autostart=False)
+    httpd = start_http_server(srv)
+    try:
+        assert urllib.request.urlopen(httpd.url + "/healthz",
+                                      timeout=10).status == 200
+        srv.close()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(httpd.url + "/healthz", timeout=10)
+        assert ei.value.code == 503
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(httpd.url + "/nope", timeout=10)
+        assert ei.value.code == 404
+    finally:
+        httpd.close()
+
+
+def test_live_phase_attribution_via_phase_profile(fresh_obs, tmp_path):
+    """Satellite: with `phase_profile` unit costs the server publishes
+    per-worker kernel/genchild/balance/idle seconds at every heartbeat
+    — live in /metrics and the snapshot while the request RUNS, not
+    only in end-of-run CSVs — and retires the per-request series at the
+    terminal transition (the gauge-cardinality valve)."""
+    _, _ = fresh_obs
+    inst = PFSPInstance.synthetic(jobs=8, machines=3, seed=5)
+    prof = {"bound": 1e-4, "step": 3e-4, "compact": 2e-4,
+            "per_eval": 1e-4 / (8 * 8)}
+    with SearchServer(n_submeshes=2, workdir=tmp_path,
+                      phase_profile=prof) as srv:
+        rid = srv.submit(SearchRequest(
+            p_times=inst.p_times, lb_kind=1, segment_iters=32,
+            faults="delay_every=0.1", **KW))
+        # the LIVE view: per-request series appear while it runs
+        t0 = time.monotonic()
+        while True:
+            text = srv.metrics.to_prometheus()
+            if f'request="{rid}"' in text:
+                break
+            assert time.monotonic() - t0 < 120, "no live phase series"
+            time.sleep(0.02)
+        snap = srv.status_snapshot()
+        assert "tts_phase_seconds" in snap["metrics"]
+        # all four phases, one series per worker of the 4-device submesh
+        for phase in ("kernel", "gen_child", "balance", "idle"):
+            assert f'phase="{phase}"' in text
+        assert 'worker="3"' in text
+        assert srv.result(rid, timeout=300).state == "DONE"
+        # cardinality valve: the request's series retire with it
+        assert f'request="{rid}"' not in srv.metrics.to_prometheus()
+
+
+def test_checkpoint_metrics_and_quarantine_events(fresh_obs, tmp_path):
+    """Engine-level instrumentation: saves feed latency/bytes
+    histograms; a corrupt current snapshot leaves quarantine +
+    rollback events when the last-good sibling serves the resume."""
+    log, reg = fresh_obs
+    from tpu_tree_search.engine import checkpoint, device
+    from tpu_tree_search.utils import faults as faults_mod
+
+    inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=1)
+    state = device.init_state(7, 1 << 10, None, p_times=inst.p_times)
+    path = tmp_path / "ck.npz"
+    checkpoint.save(path, state, meta={"x": 1})
+    checkpoint.save(path, state, meta={"x": 2})    # rotates .prev
+    h = reg.histogram("tts_checkpoint_save_seconds")
+    assert h.snapshot()["count"] == 2
+    assert reg.histogram("tts_checkpoint_bytes").snapshot()["count"] == 2
+    faults_mod.corrupt_file(path)
+    st, meta, used = checkpoint.load_resilient(path,
+                                               p_times=inst.p_times)
+    assert str(used).endswith(".prev")
+    names = [r["name"] for r in log.records()]
+    assert "checkpoint.quarantine" in names
+    assert "checkpoint.rollback" in names
+    assert reg.counter("tts_checkpoint_rollbacks_total").value() == 1
+    spans = [r for r in log.records() if r["name"] == "checkpoint.save"]
+    assert len(spans) == 2 and all(s["bytes"] > 0 for s in spans)

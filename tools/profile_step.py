@@ -6,10 +6,15 @@ Usage:
 
 Warms the single-device engine past its ramp (underfilled chunks), traces
 a short window of the compiled loop, then aggregates per-op SELF times
-(exclusive of nested control-flow spans — see tools/trace_selftime.py,
-which owns the trace parsing) bucketed into the step's phases. This is
-the measurement VERDICT r2 items 8/9 ask for: what the two-phase LB2
-step (resp. the LB1 step) actually spends its time on.
+(exclusive of nested control-flow spans — tpu_tree_search/obs/
+chrome_trace.py owns the trace parsing, shared with
+tools/trace_selftime.py and tools/validate_attribution.py) bucketed into
+the step's phases. The tool's own wall-clock phases (warm-up, traced
+window) are flight-recorded as obs/tracelog spans instead of private
+perf_counter bookkeeping, so a `TTS_TRACE_FILE=...` run leaves a
+timeline of the measurement itself. This is the measurement VERDICT r2
+items 8/9 ask for: what the two-phase LB2 step (resp. the LB1 step)
+actually spends its time on.
 """
 
 import argparse
@@ -19,12 +24,12 @@ import os
 import sys
 import tempfile
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from trace_selftime import load, self_times  # noqa: E402
-
 from tpu_tree_search.engine import device  # noqa: E402
+from tpu_tree_search.obs import tracelog  # noqa: E402
+from tpu_tree_search.obs.chrome_trace import (load_xla_trace,  # noqa: E402
+                                              self_times)
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
 from tpu_tree_search.utils import device_info  # noqa: E402
@@ -66,23 +71,28 @@ def main():
     tables = batched.make_tables(p)
     jobs = p.shape[1]
     state = device.init_state(jobs, 1 << 22, ub, p_times=p)
-    state = device.run(tables, state, args.lb, args.chunk,
-                       max_iters=args.warm)
-    state.size.block_until_ready()
+    with tracelog.span("profile_step.warmup", inst=args.inst, lb=args.lb,
+                       chunk=args.chunk) as warm_sp:
+        state = device.run(tables, state, args.lb, args.chunk,
+                           max_iters=args.warm)
+        state.size.block_until_ready()
+        warm_sp.set(iters=int(state.iters), pool=int(state.size))
     print(f"# warmed: iters={int(state.iters)} pool={int(state.size)} "
-          f"evals={int(state.evals)}", file=sys.stderr)
+          f"evals={int(state.evals)} ({warm_sp.dur:.2f}s)",
+          file=sys.stderr)
 
     log_dir = args.logdir or tempfile.mkdtemp(prefix="tts_trace_")
-    with device_info.trace(log_dir):
-        out = device.run(tables, state, args.lb, args.chunk,
-                         max_iters=args.warm + args.iters)
-        out.size.block_until_ready()
+    with tracelog.span("profile_step.traced_window", logdir=log_dir):
+        with device_info.trace(log_dir):
+            out = device.run(tables, state, args.lb, args.chunk,
+                             max_iters=args.warm + args.iters)
+            out.size.block_until_ready()
     n_iters = int(out.iters) - int(state.iters)
     evals = int(out.evals) - int(state.evals)
     print(f"# traced {n_iters} iters, {evals} evals; trace in {log_dir}",
           file=sys.stderr)
 
-    self_us, counts = self_times(load(log_dir))
+    self_us, counts = self_times(load_xla_trace(log_dir))
     total = sum(self_us.values())
     if total == 0:
         raise SystemExit("no device op self-times found in trace "

@@ -542,12 +542,21 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
 
     import numpy as np  # noqa: F401 (platform init order)
 
+    from tpu_tree_search.obs import tracelog
     from tpu_tree_search.problems import taillard
     from tpu_tree_search.service import SearchRequest, SearchServer
 
     todo = select_instances(insts)
     if not todo:
         return
+    # the campaign's flight recorder: every row points at the JSONL
+    # event log that shows its requests' dispatches, preemptions,
+    # checkpoints and retries (tools/trace_summary.py renders it;
+    # obs/chrome_trace converts it for Perfetto)
+    trace_file = os.environ.get("TTS_TRACE_FILE") or \
+        os.path.join(WORKDIR, "campaign_trace.jsonl")
+    tracelog.get().set_sink(trace_file)
+    print(f"flight recorder: {trace_file}", flush=True)
     with SearchServer(n_submeshes=n_submeshes, workdir=WORKDIR,
                       max_queue_depth=max(64, len(todo) + 1),
                       segment_iters=SEG,
@@ -580,7 +589,7 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
                   f"(budget {BUDGET_S:.0f}s)", flush=True)
         for inst in todo:
             rec = srv.result(rids[inst])
-            row = _serve_row(inst, rec)
+            row = _serve_row(inst, rec, trace_file)
             if row is None:
                 continue
             if (row["done"] and UB_MODE == "opt"
@@ -597,7 +606,8 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
               f"{snap['executor_cache']['misses']} compiles", flush=True)
 
 
-def _serve_row(inst: int, rec) -> dict | None:
+def _serve_row(inst: int, rec, trace_file: str | None = None
+               ) -> dict | None:
     """A service RequestRecord -> the campaign's JSONL row schema."""
     from tpu_tree_search.problems import taillard
 
@@ -623,7 +633,11 @@ def _serve_row(inst: int, rec) -> dict | None:
             "grows": 0, "pool_at_stop": pool,
             "pushed_per_s": round(res.explored_tree / max(spent, 1e-9), 1),
             "evals_per_s": round(evals / max(spent, 1e-9), 1),
-            "restarts": rec.dispatches - 1}
+            "restarts": rec.dispatches - 1,
+            # where this row's lifecycle (dispatches, preemptions,
+            # checkpoints, retries) is flight-recorded
+            "trace_file": trace_file,
+            "request_id": rec.id}
 
 
 # ----------------------------------------------------------- entry point

@@ -1,59 +1,26 @@
 """Exclusive (self-time) op profile from a jax.profiler Chrome trace.
 
+Thin CLI over :mod:`tpu_tree_search.obs.chrome_trace`, which owns the
+trace parsing (it used to live here privately; tools/profile_step.py and
+tools/validate_attribution.py now share the same implementation).
 Chrome-trace 'X' events in the device 'XLA Ops' lane nest by timestamp
-containment (control-flow ops like while/conditional span their bodies).
-Summing raw durations double-counts; this computes each op's SELF time
-(duration minus directly-contained children) and aggregates by op name.
+containment; this prints each op's SELF time (duration minus
+directly-contained children) aggregated by op name.
 
     python tools/trace_selftime.py /tmp/tts_trace_lb2 [--top 40]
 """
 
 import argparse
-import collections
-import glob
-import gzip
-import json
 import os
+import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def load(log_dir):
-    paths = glob.glob(os.path.join(
-        log_dir, "plugins", "profile", "*", "*.trace.json.gz"))
-    ev = []
-    for p in paths:
-        with gzip.open(p, "rt") as f:
-            ev.extend(json.load(f).get("traceEvents", []))
-    return ev
+from tpu_tree_search.obs.chrome_trace import (load_xla_trace,  # noqa: E402
+                                              self_times)
 
-
-def self_times(events, lane="XLA Ops"):
-    tn = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            tn[(e["pid"], e["tid"])] = e["args"]["name"]
-    # nesting is only meaningful within one (pid, tid) lane — group
-    # first so multi-core traces don't cross-attribute children
-    lanes = collections.defaultdict(list)
-    for e in events:
-        if (e.get("ph") == "X" and "dur" in e
-                and tn.get((e.get("pid"), e.get("tid"))) == lane):
-            lanes[(e["pid"], e["tid"])].append(e)
-    self_us = collections.Counter()
-    counts = collections.Counter()
-    for xs in lanes.values():
-        # sort by start asc, duration desc so parents precede children
-        xs.sort(key=lambda e: (e["ts"], -e["dur"]))
-        stack = []  # (end_ts, name) of open enclosing events
-        for e in xs:
-            ts, dur, name = e["ts"], e["dur"], e["name"]
-            while stack and stack[-1][0] <= ts:
-                stack.pop()
-            self_us[name] += dur
-            counts[name] += 1
-            if stack:
-                self_us[stack[-1][1]] -= dur
-            stack.append((ts + dur, name))
-    return self_us, counts
+# backward-compatible aliases (this module WAS the implementation)
+load = load_xla_trace
 
 
 def main():
@@ -63,7 +30,7 @@ def main():
     ap.add_argument("--iters", type=int, default=None,
                     help="divide totals by this many loop iterations")
     args = ap.parse_args()
-    self_us, counts = self_times(load(args.logdir))
+    self_us, counts = self_times(load_xla_trace(args.logdir))
     total = sum(self_us.values())
     print(f"total device self-time: {total/1e3:.2f} ms"
           + (f"  ({total/1e3/args.iters:.3f} ms/iter)" if args.iters

@@ -1,0 +1,145 @@
+"""Per-request latency/preemption table from a flight-recorder trace.
+
+Reads either trace artifact the observability layer produces —
+
+- the JSONL event log (obs/tracelog's file sink, `serve --trace-file`,
+  TTS_TRACE_FILE, the campaign's `trace_file` row pointer), or
+- the Chrome trace-event JSON (obs/chrome_trace.write_chrome, the
+  `/trace` endpoint) — detected by the leading ``{"traceEvents": ...}``
+
+— and prints one row per request: terminal state, queue wait, total
+latency, execution seconds (summed `request.execute` spans), dispatch /
+preemption / checkpoint-save counts. Doubles as the CI artifact's
+well-formedness check (tests/test_obs.py runs it against both formats).
+
+    python tools/trace_summary.py /tmp/tts-trace.jsonl
+    python tools/trace_summary.py /tmp/tts-trace.chrome.json
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TERMINALS = ("done", "cancelled", "deadline", "failed")
+
+
+def load_records(path: str) -> list[dict]:
+    """Normalize either trace format to tracelog-shaped records
+    (name/ts[s]/dur[s] + flat attributes)."""
+    with open(path) as f:
+        head = f.read(4096).lstrip()
+    if head.startswith("{") and '"traceEvents"' in head:
+        # Chrome trace: events carry the original attributes in `args`,
+        # timestamps/durations in µs
+        with open(path) as f:
+            doc = json.load(f)
+        out = []
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") not in ("X", "i"):
+                continue
+            rec = {"name": e.get("name", "?"),
+                   "ts": float(e.get("ts", 0.0)) / 1e6,
+                   **(e.get("args") or {})}
+            if e["ph"] == "X":
+                rec["dur"] = float(e.get("dur", 0.0)) / 1e6
+            out.append(rec)
+        return out
+    from tpu_tree_search.obs.chrome_trace import read_jsonl
+    return read_jsonl(path)
+
+
+def summarize(records: list[dict]) -> dict[str, dict]:
+    """Fold records into one summary dict per request id."""
+    reqs: dict[str, dict] = {}
+
+    def req(rid):
+        return reqs.setdefault(rid, {
+            "state": "?", "admit_ts": None, "first_dispatch_ts": None,
+            "terminal_ts": None, "dispatches": 0, "preemptions": 0,
+            "checkpoints": 0, "retries": 0, "faults": 0, "exec_s": 0.0,
+            "submeshes": set()})
+
+    for r in sorted(records, key=lambda r: (r.get("ts", 0.0),
+                                            r.get("seq", 0))):
+        rid = r.get("request_id")
+        if rid is None:
+            continue
+        s = req(rid)
+        name = r.get("name", "")
+        if name == "request.admit":
+            s["admit_ts"] = r["ts"]
+        elif name == "request.dispatch":
+            s["dispatches"] += 1
+            if s["first_dispatch_ts"] is None:
+                s["first_dispatch_ts"] = r["ts"]
+            if r.get("submesh") is not None:
+                s["submeshes"].add(r["submesh"])
+        elif name == "request.preempt":
+            s["preemptions"] += 1
+        elif name == "request.execute":
+            s["exec_s"] += float(r.get("dur", 0.0))
+            if r.get("submesh") is not None:
+                s["submeshes"].add(r["submesh"])
+        elif name == "checkpoint.save":
+            s["checkpoints"] += 1
+        elif name == "retry":
+            s["retries"] += 1
+        elif name == "fault.injected":
+            s["faults"] += 1
+        elif name.startswith("request.") \
+                and name.split(".", 1)[1] in TERMINALS:
+            s["state"] = name.split(".", 1)[1].upper()
+            # a span-less event: its ts IS the terminal instant
+            s["terminal_ts"] = r["ts"]
+    return reqs
+
+
+def render(reqs: dict[str, dict]) -> str:
+    hdr = (f"{'request':<10} {'state':<9} {'wait_s':>8} {'latency_s':>10} "
+           f"{'exec_s':>8} {'disp':>4} {'pre':>4} {'ckpt':>4} "
+           f"{'retry':>5}  submeshes")
+    lines = [hdr, "-" * len(hdr)]
+
+    def f(a, b):
+        return f"{b - a:.3f}" if a is not None and b is not None else "-"
+
+    for rid in sorted(reqs):
+        s = reqs[rid]
+        lines.append(
+            f"{rid:<10} {s['state']:<9} "
+            f"{f(s['admit_ts'], s['first_dispatch_ts']):>8} "
+            f"{f(s['admit_ts'], s['terminal_ts']):>10} "
+            f"{s['exec_s']:>8.3f} {s['dispatches']:>4} "
+            f"{s['preemptions']:>4} {s['checkpoints']:>4} "
+            f"{s['retries']:>5}  "
+            f"{sorted(s['submeshes'])}")
+    n_pre = sum(s["preemptions"] for s in reqs.values())
+    lines.append(f"{len(reqs)} request(s), {n_pre} preemption(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-request latency/preemption table from a "
+                    "flight-recorder trace (JSONL or Chrome JSON)")
+    ap.add_argument("trace", help="trace file path")
+    args = ap.parse_args(argv)
+    records = load_records(args.trace)
+    if not records:
+        print(f"error: no trace records in {args.trace}",
+              file=sys.stderr)
+        return 1
+    reqs = summarize(records)
+    if not reqs:
+        print(f"error: {len(records)} records but no request ids in "
+              f"{args.trace} (not a service trace?)", file=sys.stderr)
+        return 1
+    print(render(reqs))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

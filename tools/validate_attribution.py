@@ -26,16 +26,15 @@ import argparse
 import os
 import sys
 import tempfile
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from trace_selftime import load, self_times  # noqa: E402
 
 import numpy as np  # noqa: E402
 
 from tpu_tree_search.engine import device  # noqa: E402
+from tpu_tree_search.obs import tracelog  # noqa: E402
+from tpu_tree_search.obs.chrome_trace import (load_xla_trace,  # noqa: E402
+                                              self_times)
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
 from tpu_tree_search.utils import device_info, phase_timing  # noqa: E402
@@ -44,7 +43,7 @@ KERNEL_OPS = ("expand_bounds", "lb2_bounds", "pallas")
 
 
 def trace_kernel_share(log_dir):
-    self_us, _ = self_times(load(log_dir))
+    self_us, _ = self_times(load_xla_trace(log_dir))
     total = sum(self_us.values())
     kern = sum(v for k, v in self_us.items()
                if any(s in k.lower() for s in KERNEL_OPS))
@@ -74,12 +73,13 @@ def main():
         prof = phase_timing.profile_phases(tables, state, lb, args.chunk)
 
         log_dir = tempfile.mkdtemp(prefix=f"tts_attr_lb{lb}_")
-        t0 = time.perf_counter()
-        with device_info.trace(log_dir):
-            out = device.run(tables, state, lb, args.chunk,
-                             max_iters=args.warm + args.iters)
-            out.size.block_until_ready()
-        elapsed = time.perf_counter() - t0
+        with tracelog.span("validate_attribution.traced_window",
+                           lb=lb, logdir=log_dir) as win_sp:
+            with device_info.trace(log_dir):
+                out = device.run(tables, state, lb, args.chunk,
+                                 max_iters=args.warm + args.iters)
+                out.size.block_until_ready()
+        elapsed = win_sp.dur
         evals = int(out.evals) - int(state.evals)
         iters = int(out.iters) - int(state.iters)
 
@@ -119,10 +119,11 @@ def main():
         loop1, loop2 = make_loop(K), make_loop(2 * K)
 
         def wall(fn):
-            fn(state).block_until_ready()
-            t0 = time.perf_counter()
-            fn(state).block_until_ready()
-            return time.perf_counter() - t0
+            fn(state).block_until_ready()        # compile outside
+            with tracelog.span("validate_attribution.bracket_wall",
+                               lb=lb) as sp:
+                fn(state).block_until_ready()
+            return sp.dur
 
         # two trip counts, differenced: one dispatch through the remote
         # runtime costs ~10-100 ms of wall that a single-K measurement

@@ -130,6 +130,25 @@ def _serve_parser(sub):
     p.add_argument("--status-every", type=float, default=30.0,
                    help="print a JSON status snapshot every N seconds "
                         "(0 disables)")
+    p.add_argument("--http-port", type=int, default=None,
+                   help="start the observability HTTP front-end "
+                        "(obs/httpd: /healthz /metrics /status /trace) "
+                        "on this port (0 = ephemeral, printed at "
+                        "startup; default: off)")
+    p.add_argument("--http-host", type=str, default="127.0.0.1",
+                   help="bind address for --http-port (default "
+                        "loopback; 0.0.0.0 exposes it)")
+    p.add_argument("--trace-file", type=str, default=None,
+                   help="append the flight recorder's span/event log "
+                        "to this JSONL file (also via TTS_TRACE_FILE; "
+                        "convert with tools/trace_summary.py or the "
+                        "/trace endpoint)")
+    p.add_argument("--phase-metrics", action="store_true",
+                   help="measure per-phase unit costs once per request "
+                        "shape and publish live per-worker "
+                        "kernel/genchild/balance/idle attribution as "
+                        "tts_phase_seconds gauges (adds seconds of "
+                        "profiling to each shape's first dispatch)")
 
 
 def _client_parser(sub):
@@ -157,18 +176,36 @@ def _client_parser(sub):
 
 
 def run_serve(args) -> int:
+    from .obs import tracelog
     from .service import SearchServer, spool
 
-    with SearchServer(n_submeshes=args.submeshes, workdir=args.workdir,
-                      max_queue_depth=args.queue_depth,
-                      segment_iters=args.segment_iters) as srv:
-        print(f"serving: {args.submeshes} submesh(es) x "
-              f"{srv.slots[0].mesh.devices.size} device(s), "
-              f"spool {args.spool}", flush=True)
-        served = spool.serve_spool(
-            srv, args.spool, idle_exit_s=args.idle_exit,
-            status_every_s=args.status_every or None,
-            emit=lambda s: print(s, flush=True))
+    if args.trace_file:
+        tracelog.get().set_sink(args.trace_file)
+        print(f"flight recorder: {args.trace_file}", flush=True)
+    httpd = None
+    try:
+        with SearchServer(n_submeshes=args.submeshes,
+                          workdir=args.workdir,
+                          max_queue_depth=args.queue_depth,
+                          segment_iters=args.segment_iters,
+                          phase_profile=(True if args.phase_metrics
+                                         else None)) as srv:
+            if args.http_port is not None:
+                from .obs.httpd import start_http_server
+                httpd = start_http_server(srv, host=args.http_host,
+                                          port=args.http_port)
+                print(f"observability: {httpd.url}/healthz /metrics "
+                      "/status /trace", flush=True)
+            print(f"serving: {args.submeshes} submesh(es) x "
+                  f"{srv.slots[0].mesh.devices.size} device(s), "
+                  f"spool {args.spool}", flush=True)
+            served = spool.serve_spool(
+                srv, args.spool, idle_exit_s=args.idle_exit,
+                status_every_s=args.status_every or None,
+                emit=lambda s: print(s, flush=True))
+    finally:
+        if httpd is not None:
+            httpd.close()
     print(f"served {served} request(s)", flush=True)
     return 0
 
@@ -439,6 +476,9 @@ def _write_csv_with_phases(args, p, init_ub, n_dev, elapsed, tree, sol,
         att = phase_timing.attribute(prof, elapsed, evals, iters,
                                      balance_rounds=rounds,
                                      t_balance=t_bal)
+        # the same numbers land in the global metrics registry, so a
+        # co-running /metrics endpoint and the CSV row cannot disagree
+        phase_timing.publish_attribution(att, inst=args.inst, lb=args.lb)
         per_device = dict(per_device)
         per_device.update({k: list(v) for k, v in att.items()})
     except Exception as e:  # profiling must never eat the results row

@@ -27,6 +27,8 @@ import zlib
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import tracelog
 from ..utils import faults
 from ..utils.retry import retry_call
 from .device import SearchState
@@ -189,7 +191,38 @@ def resume_path(path: str | pathlib.Path) -> pathlib.Path | None:
     return prev if prev.exists() else None
 
 
-def save(path: str | pathlib.Path, state: SearchState, meta: dict | None = None):
+# checkpoint size buckets (bytes): tests write ~kB snapshots, production
+# pools compress to tens-of-MB..GB
+_BYTES_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9)
+
+
+def save(path: str | pathlib.Path, state: SearchState,
+         meta: dict | None = None):
+    """Snapshot a search state — flight-recorded wrapper around
+    :func:`_save_impl` (one `checkpoint.save` span carrying the written
+    byte count, plus save-latency/bytes histograms in the metrics
+    registry). See `_save_impl` for the format and durability story."""
+    with tracelog.span("checkpoint.save", path=str(path)) as sp:
+        _save_impl(path, state, meta)
+        nbytes = 0
+        try:
+            nbytes = os.path.getsize(path)
+        except OSError:
+            pass          # non-writer multihost rank, or racing rotate
+        sp.set(bytes=nbytes)
+    reg = obs_metrics.default()
+    reg.counter("tts_checkpoint_saves_total",
+                "checkpoint snapshots written").inc()
+    reg.histogram("tts_checkpoint_save_seconds",
+                  "checkpoint save latency (fetch+compress+fsync)"
+                  ).observe(sp.dur)
+    if nbytes:
+        reg.histogram("tts_checkpoint_bytes", "checkpoint file size",
+                      buckets=_BYTES_BUCKETS).observe(nbytes)
+
+
+def _save_impl(path: str | pathlib.Path, state: SearchState,
+               meta: dict | None = None):
     """Snapshot a search state (single-device or stacked distributed).
 
     Only the live pool rows (below the cursor) are fetched and written —
@@ -271,6 +304,16 @@ def load(path: str | pathlib.Path,
     mismatch, missing members — every read error, so a caller never
     resumes wrong state) and CheckpointSchemaError on a file written by
     a newer schema than this build reads."""
+    with tracelog.span("checkpoint.load", path=str(path)):
+        obs_metrics.default().counter(
+            "tts_checkpoint_loads_total",
+            "checkpoint load attempts").inc()
+        return _load_impl(path, p_times=p_times)
+
+
+def _load_impl(path: str | pathlib.Path,
+               p_times: np.ndarray | None = None
+               ) -> tuple[SearchState, dict]:
     path = pathlib.Path(path)
     try:
         with np.load(path) as z:
@@ -388,6 +431,11 @@ def load_resilient(path: str | pathlib.Path,
                 f"skipping corrupt checkpoint {cand}: {e}",
                 RuntimeWarning, stacklevel=2)
             errors.append(f"{cand}: {e}")
+            tracelog.event("checkpoint.corrupt", path=str(cand),
+                           error=str(e)[:200])
+            obs_metrics.default().counter(
+                "tts_checkpoint_corrupt_total",
+                "torn/corrupt snapshots skipped on load").inc()
             if cand == path:
                 # Quarantine the torn CURRENT file: leaving it in place
                 # lets the next save() rotate it over the good
@@ -401,6 +449,11 @@ def load_resilient(path: str | pathlib.Path,
                     import jax
                     if jax.process_index() == 0:
                         os.replace(cand, str(cand) + ".corrupt")
+                        tracelog.event("checkpoint.quarantine",
+                                       path=str(cand) + ".corrupt")
+                        obs_metrics.default().counter(
+                            "tts_checkpoint_quarantines_total",
+                            "torn current snapshots renamed aside").inc()
                 except OSError:
                     pass
             continue
@@ -410,6 +463,11 @@ def load_resilient(path: str | pathlib.Path,
                 "checkpoint torn/missing); work since the previous "
                 "checkpoint interval will be redone",
                 RuntimeWarning, stacklevel=2)
+            tracelog.event("checkpoint.rollback", path=str(cand),
+                           wanted=str(path))
+            obs_metrics.default().counter(
+                "tts_checkpoint_rollbacks_total",
+                "resumes served by the rotating last-good sibling").inc()
         return state, meta, cand
     raise CheckpointCorrupt(
         "no loadable checkpoint: " + "; ".join(errors))
@@ -461,6 +519,12 @@ def reshard_state(state: SearchState, new_workers: int,
     D, jobs, capacity = arrs.prmu.shape
     A = arrs.aux.shape[1]
     M = new_workers
+    if M != D:
+        tracelog.event("elastic_reshard", old_workers=int(D),
+                       new_workers=int(M))
+        obs_metrics.default().counter(
+            "tts_elastic_reshards_total",
+            "checkpoints re-homed onto a different worker count").inc()
     sizes = np.atleast_1d(arrs.size).astype(np.int64)
 
     # concatenate live rows in worker order (bottom-to-top per pool)
@@ -553,6 +617,10 @@ def grow(state: SearchState, new_capacity: int) -> SearchState:
     capacity = np.asarray(state.prmu).shape[-1]
     if new_capacity < capacity:
         raise ValueError(f"new_capacity {new_capacity} < current {capacity}")
+    tracelog.event("pool.grow", capacity=int(capacity),
+                   new_capacity=int(new_capacity))
+    obs_metrics.default().counter(
+        "tts_pool_grows_total", "lossless overflow pool growths").inc()
     pad = new_capacity - capacity
 
     def pad_rows(x):
@@ -577,9 +645,12 @@ class SegmentReport:
     pool_size: int
     elapsed: float
     # distributed runs: per-worker live sizes / cumulative steal counts /
-    # incumbents (the heartbeat surface the reference's "Still Idle"
-    # print, dist:663-668, only hints at); None on single-device runs
+    # incumbents / explored+eval counters (the heartbeat surface the
+    # reference's "Still Idle" print, dist:663-668, only hints at, and
+    # the inputs the live phase attribution needs — see
+    # utils/phase_timing.publish_attribution); None on single-device runs
     per_worker: dict | None = None
+    evals: int = 0               # cumulative bound evaluations (total)
 
 
 def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
@@ -659,6 +730,9 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     seg = 0
     stalls = 0
     start_iters = int(_to_np(state.iters).max())
+    # resumed states carry cumulative totals; throughput metrics must
+    # count only THIS run's progress
+    prev_tree = int(np.atleast_1d(_to_np(state.tree)).sum())
     last = (start_iters, -1, -1)
 
     def meta_now(seg):
@@ -691,43 +765,63 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         # failure), so a retried segment redoes identical work; the
         # watchdog wraps each attempt separately
         prev_state = state
-        state = _retry(
-            lambda: _with_watchdog(
-                lambda: run_fn(prev_state, target),
-                segment_timeout_s, f"segment {seg + 1}"),
-            "segment execution", retry_attempts, retry_base_s)
-        if post_segment is not None:
-            state = post_segment(state)
-        seg += 1
-        # ONE batched host fetch for every per-segment scalar: through a
-        # remote-TPU runtime each separate fetch is a full roundtrip
-        # (~0.15 s on the tunnel; six of them cost ~0.9 s per segment —
-        # measured as the gap between segment wall time and the compiled
-        # loop's in-trace step cost, BENCHMARKS.md round 3)
-        # the watchdog must cover this fetch too: dispatch is ASYNC, so
-        # a hung device computation lets run_fn return its futures
-        # instantly and the block happens HERE, waiting on the results
-        fetched = _retry(
-            lambda: _with_watchdog(
-                lambda: _fetch_many((state.iters, state.tree, state.sol,
-                                     state.size, state.best, state.steals,
-                                     state.overflow)),
-                segment_timeout_s, f"segment {seg} result fetch"),
-            "per-segment host fetch", retry_attempts, retry_base_s)
-        f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf = fetched
-        iters = int(f_iters.max())
-        tree = int(f_tree.sum())
-        sol = int(f_sol.sum())
-        size = int(sizes.sum())
+        with tracelog.span("segment", segment=seg + 1) as seg_span:
+            state = _retry(
+                lambda: _with_watchdog(
+                    lambda: run_fn(prev_state, target),
+                    segment_timeout_s, f"segment {seg + 1}"),
+                "segment execution", retry_attempts, retry_base_s)
+            if post_segment is not None:
+                state = post_segment(state)
+            seg += 1
+            # ONE batched host fetch for every per-segment scalar:
+            # through a remote-TPU runtime each separate fetch is a full
+            # roundtrip (~0.15 s on the tunnel; six of them cost ~0.9 s
+            # per segment — measured as the gap between segment wall
+            # time and the compiled loop's in-trace step cost,
+            # BENCHMARKS.md round 3)
+            # the watchdog must cover this fetch too: dispatch is ASYNC,
+            # so a hung device computation lets run_fn return its
+            # futures instantly and the block happens HERE, waiting on
+            # the results
+            fetched = _retry(
+                lambda: _with_watchdog(
+                    lambda: _fetch_many(
+                        (state.iters, state.tree, state.sol,
+                         state.size, state.best, state.steals,
+                         state.overflow, state.evals)),
+                    segment_timeout_s, f"segment {seg} result fetch"),
+                "per-segment host fetch", retry_attempts, retry_base_s)
+            (f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf,
+             f_evals) = fetched
+            iters = int(f_iters.max())
+            tree = int(f_tree.sum())
+            sol = int(f_sol.sum())
+            size = int(sizes.sum())
+            seg_span.set(iters=iters, tree=tree, sol=sol, pool=size,
+                         best=int(f_best.min()))
         per_worker = None
         if sizes.ndim:                          # stacked distributed state
             per_worker = {"size": sizes.tolist(),
                           "steals": f_steals.tolist(),
-                          "best": f_best.tolist()}
+                          "best": f_best.tolist(),
+                          "iters": f_iters.tolist(),
+                          "evals": f_evals.tolist()}
         report = SegmentReport(
             segment=seg, iters=iters, tree=tree, sol=sol,
             best=int(f_best.min()), pool_size=size,
-            elapsed=time.perf_counter() - t0, per_worker=per_worker)
+            elapsed=time.perf_counter() - t0, per_worker=per_worker,
+            evals=int(f_evals.sum()))
+        reg = obs_metrics.default()
+        reg.histogram("tts_segment_seconds",
+                      "segment wall latency (execute+fetch)"
+                      ).observe(seg_span.dur)
+        # per-segment DELTA, so the counter is live throughput, not the
+        # cumulative totals a resumed checkpoint would double-report
+        reg.counter("tts_nodes_explored_total",
+                    "explored-node throughput (segment deltas)"
+                    ).inc(max(tree - prev_tree, 0))
+        prev_tree = tree
         if heartbeat is not None:
             heartbeat(report)
         if checkpoint_path and seg % checkpoint_every == 0:

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..obs import tracelog
 from ..ops import batched, reference as ref
 from ..ops.batched import BoundTables
 from ..parallel import balance as bal
@@ -681,7 +682,10 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                       best=int(np.asarray(host_state.best).min()))
         state = driver.commit(host_state)
     else:
-        fr = bfs_warmup(p_times, lb_kind, init_ub, target=min_seed * n_dev)
+        with tracelog.span("bfs_warmup", target=min_seed * n_dev) as ws:
+            fr = bfs_warmup(p_times, lb_kind, init_ub,
+                            target=min_seed * n_dev)
+            ws.set(frontier=len(fr.depth), tree=fr.tree)
         init_best = (fr.best if init_ub is None
                      else min(fr.best, int(init_ub)))
         dmask, h_prmu, h_depth = hybrid.split_host_share(
@@ -704,7 +708,10 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                     or (should_stop is not None and should_stop(rep)))
     if (segment_iters is None and checkpoint_path is None
             and session is None and stop_fn is None):
-        out = driver.run(state, max_iters)
+        # the segmented path below is spanned per segment inside
+        # run_segmented; this is the only otherwise-unobserved run shape
+        with tracelog.span("engine.run", workers=n_dev):
+            out = driver.run(state, max_iters)
     else:
         ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol,
                      # the host tier's seed rides every checkpoint so a
@@ -754,17 +761,26 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     tree_dev = _fetch(out.tree)
     sol_dev = _fetch(out.sol)
     sizes = _fetch(out.size)
+    iters_dev = _fetch(out.iters)
+    steals_dev = _fetch(out.steals)
+    tracelog.event(
+        "engine.complete", workers=n_dev,
+        tree=int(tree_dev.sum()) + fr.tree + h_tree, best=best,
+        iters=int(iters_dev.max()),
+        balance_rounds=int(iters_dev.max()) // max(balance_period, 1),
+        steals=int(steals_dev.sum()),
+        complete=int(sizes.sum()) == 0)
     return DistResult(
         explored_tree=int(tree_dev.sum()) + fr.tree + h_tree,
         explored_sol=int(sol_dev.sum()) + fr.sol + h_sol,
         best=best,
         per_device={
             "tree": tree_dev, "sol": sol_dev,
-            "iters": _fetch(out.iters),
+            "iters": iters_dev,
             "evals": _fetch(out.evals),
             "sent": _fetch(out.sent),
             "recv": _fetch(out.recv),
-            "steals": _fetch(out.steals),
+            "steals": steals_dev,
             "final_size": sizes,
             **host_stats,
         },

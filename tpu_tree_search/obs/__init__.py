@@ -1,0 +1,33 @@
+"""Unified observability layer: flight recorder for the search runtime.
+
+The reference engine's only observability is per-phase wall-clock
+counters dumped to CSV at exit (PFSP_statistic.c); until this layer the
+repo mirrored that shape — post-hoc attribution, an ad-hoc status dict,
+and no durable record of retries, faults or preemptions. A production
+scheduler that preempts, reshards, retries and rolls back checkpoints is
+undebuggable without a flight recorder that shows *what happened, when,
+on which submesh*. This package is that recorder:
+
+- :mod:`~tpu_tree_search.obs.tracelog` — structured span/event log
+  (thread-safe ring buffer + optional JSONL sink) threaded through the
+  service scheduler, the segmented engine driver, checkpoint I/O, the
+  retry tier and the fault injector;
+- :mod:`~tpu_tree_search.obs.metrics` — counters/gauges/histograms with
+  JSON and Prometheus-text exposition; the service's status snapshot is
+  built on top of it;
+- :mod:`~tpu_tree_search.obs.chrome_trace` — converts the span log to
+  Chrome ``trace_event`` JSON so a whole serve session opens in
+  Perfetto (and owns the XLA-profiler-trace parsing the profiling tools
+  share);
+- :mod:`~tpu_tree_search.obs.httpd` — ``/healthz`` ``/metrics``
+  ``/status`` ``/trace`` HTTP front-end over a running SearchServer
+  (stdlib ``http.server``; the ROADMAP service follow-on).
+
+Everything here is observation-only: instrumentation records
+timestamps and counters, it never changes what the engine explores —
+served node counts stay bit-identical with the recorder on or off.
+"""
+
+from . import chrome_trace, metrics, tracelog  # noqa: F401
+
+__all__ = ["tracelog", "metrics", "chrome_trace"]

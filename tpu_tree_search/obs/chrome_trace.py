@@ -1,0 +1,162 @@
+"""Chrome ``trace_event`` JSON: timeline export + XLA-trace parsing.
+
+Two halves, one file format:
+
+- **Export** (:func:`to_chrome`, :func:`write_chrome`): convert the
+  flight recorder's span/event records (obs/tracelog) to the Chrome
+  trace-event format, so a whole serve session — request dispatches,
+  preemptions, elastic reshards, checkpoint I/O — opens as a timeline
+  in Perfetto (https://ui.perfetto.dev) or ``chrome://tracing``. Tracks
+  (pid/tid lanes) are derived from the records' attributes: one lane
+  per submesh (every record the service's executor threads emit carries
+  a ``submesh`` attribute via the recorder's ambient context), one lane
+  per remaining thread; point events render as instants on their lane.
+
+- **Import** (:func:`load_xla_trace`, :func:`self_times`): parse the
+  traces ``jax.profiler`` writes (the same Chrome format, gzipped) and
+  compute per-op SELF times — duration minus directly-contained
+  children, because control-flow ops like ``while`` span their bodies
+  and summing raw durations double-counts. This parsing used to live
+  privately in ``tools/trace_selftime.py``; it moved here so every
+  profiling tool (tools/profile_step.py, tools/validate_attribution.py,
+  tools/trace_selftime.py) shares one implementation.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import pathlib
+
+__all__ = ["to_chrome", "write_chrome", "read_jsonl",
+           "load_xla_trace", "self_times"]
+
+
+# ------------------------------------------------------------------ export
+
+def _track_of(rec: dict) -> str:
+    """The timeline lane for a record: submesh-grouped when the record
+    carries one (the per-submesh view the ISSUE's flight-recorder story
+    needs — which request ran WHERE), else the emitting thread."""
+    if "submesh" in rec and rec["submesh"] is not None:
+        return f"submesh-{rec['submesh']}"
+    return str(rec.get("thread", "main"))
+
+
+def to_chrome(records: list[dict]) -> dict:
+    """Convert tracelog records (ring snapshot or JSONL lines) to a
+    Chrome trace dict: spans -> complete ``X`` events, point events ->
+    instant ``i`` events, plus thread-name metadata so the lanes are
+    labeled. Timestamps are the records' monotonic seconds as µs."""
+    tids: dict[str, int] = {}
+    events = []
+    for rec in records:
+        if rec.get("kind") == "meta":
+            continue
+        track = _track_of(rec)
+        tid = tids.setdefault(track, len(tids))
+        args = {k: v for k, v in rec.items()
+                if k not in ("kind", "name", "ts", "dur", "pid",
+                             "thread", "seq")}
+        base = {"name": rec.get("name", "?"), "pid": 0, "tid": tid,
+                "ts": round(float(rec.get("ts", 0.0)) * 1e6, 3),
+                "args": args}
+        if rec.get("kind") == "span":
+            events.append({**base, "ph": "X",
+                           "dur": round(float(rec.get("dur", 0.0)) * 1e6,
+                                        3)})
+        else:
+            events.append({**base, "ph": "i", "s": "t"})
+    meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": tid,
+             "args": {"name": track}} for track, tid in tids.items()]
+    # sorted lanes first, then events in timestamp order: Perfetto does
+    # not require it, but a human reading the raw JSON does
+    events.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def write_chrome(path: str | os.PathLike,
+                 records: list[dict] | None = None) -> str:
+    """Write a Chrome trace JSON of `records` (default: the global
+    recorder's ring buffer). Returns the path written."""
+    if records is None:
+        from . import tracelog
+        records = tracelog.get().records()
+    path = pathlib.Path(path)
+    if path.parent != pathlib.Path(""):
+        path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(to_chrome(records)))
+    return str(path)
+
+
+def read_jsonl(path: str | os.PathLike) -> list[dict]:
+    """Read a tracelog JSONL sink back into records (meta lines and the
+    occasional torn final line from a killed process are skipped)."""
+    out = []
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except json.JSONDecodeError:
+                continue                  # torn tail write
+            if rec.get("kind") != "meta":
+                out.append(rec)
+    return out
+
+
+# ------------------------------------------------------------------ import
+
+def load_xla_trace(log_dir: str | os.PathLike) -> list[dict]:
+    """Load every trace-event from a ``jax.profiler`` trace directory
+    (the gzipped Chrome JSON under plugins/profile/<run>/)."""
+    paths = glob.glob(os.path.join(
+        os.fspath(log_dir), "plugins", "profile", "*",
+        "*.trace.json.gz"))
+    ev = []
+    for p in paths:
+        with gzip.open(p, "rt") as f:
+            ev.extend(json.load(f).get("traceEvents", []))
+    return ev
+
+
+def self_times(events: list[dict], lane: str = "XLA Ops"):
+    """Per-op SELF time (µs) and counts from Chrome trace events.
+
+    Chrome-trace ``X`` events in the device lane nest by timestamp
+    containment (control-flow ops like while/conditional span their
+    bodies); summing raw durations double-counts, so each op's duration
+    is charged minus its directly-contained children. Nesting is only
+    meaningful within one (pid, tid) lane — events are grouped first so
+    multi-core traces don't cross-attribute children.
+    """
+    tn = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tn[(e["pid"], e["tid"])] = e["args"]["name"]
+    lanes = collections.defaultdict(list)
+    for e in events:
+        if (e.get("ph") == "X" and "dur" in e
+                and tn.get((e.get("pid"), e.get("tid"))) == lane):
+            lanes[(e["pid"], e["tid"])].append(e)
+    self_us = collections.Counter()
+    counts = collections.Counter()
+    for xs in lanes.values():
+        # sort by start asc, duration desc so parents precede children
+        xs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack = []  # (end_ts, name) of open enclosing events
+        for e in xs:
+            ts, dur, name = e["ts"], e["dur"], e["name"]
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            self_us[name] += dur
+            counts[name] += 1
+            if stack:
+                self_us[stack[-1][1]] -= dur
+            stack.append((ts + dur, name))
+    return self_us, counts

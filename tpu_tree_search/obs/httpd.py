@@ -1,0 +1,171 @@
+"""HTTP health/metrics front-end for the search service.
+
+The ROADMAP service follow-on ("expose the JSON status snapshot as a
+health/metrics endpoint"), on stdlib ``http.server`` — no new
+dependencies, threaded so a slow scrape never blocks another. Sits in
+FRONT of a running :class:`~tpu_tree_search.service.SearchServer` (the
+file spool stays the submit path; this is the read path):
+
+- ``GET /healthz``  — liveness: ``200 {"status": "ok"}`` while serving,
+  ``503`` once the server is closing (load balancers drain on it);
+- ``GET /metrics``  — Prometheus text exposition: the server's own
+  registry (requests, queue, submeshes, executor cache) followed by the
+  process-global engine registry (checkpoints, retries, faults,
+  segments);
+- ``GET /status``   — the full JSON status snapshot
+  (``SearchServer.status_snapshot()``);
+- ``GET /trace``    — the flight recorder's ring buffer as Chrome
+  trace-event JSON (save it, open in Perfetto).
+
+Usage::
+
+    httpd = start_http_server(server, port=9100)    # port=0: ephemeral
+    ...
+    httpd.close()
+
+Wired into the CLI as ``serve --http-port N`` (off by default).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import chrome_trace, metrics, tracelog
+
+__all__ = ["start_http_server", "ObsHttpd"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # the ObsHttpd instance is attached to the server object
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: D102 — silence stderr;
+        pass                            # requests are counted in metrics
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802 — http.server API
+        obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            handler = {"/healthz": obs.healthz, "/metrics": obs.metrics,
+                       "/status": obs.status, "/trace": obs.trace,
+                       "/": obs.index}.get(path)
+            if handler is None:
+                obs.http_requests.inc(path="<404>")
+                self._send(404, json.dumps(
+                    {"error": f"unknown path {path!r}",
+                     "endpoints": ["/healthz", "/metrics", "/status",
+                                   "/trace"]}) + "\n",
+                    "application/json")
+                return
+            obs.http_requests.inc(path=path)
+            code, body, ctype = handler()
+            self._send(code, body, ctype)
+        except BrokenPipeError:
+            pass        # client went away mid-response; nothing to do
+        except Exception as e:  # noqa: BLE001 — a scrape bug must not
+            # kill the serving thread; report it to the scraper instead
+            self._send(500, json.dumps({"error": repr(e)}) + "\n",
+                       "application/json")
+
+
+class ObsHttpd:
+    """A running observability HTTP server (see module docstring).
+    `server` is duck-typed: anything with ``status_snapshot()`` and a
+    ``_closing`` event works; None serves metrics/trace only."""
+
+    def __init__(self, server=None, host: str = "127.0.0.1",
+                 port: int = 0, registries=None,
+                 trace: tracelog.TraceLog | None = None):
+        self.server = server
+        self.trace_log = trace
+        regs = list(registries) if registries is not None else []
+        if not regs:
+            if server is not None and getattr(server, "metrics", None) \
+                    is not None:
+                regs.append(server.metrics)
+            regs.append(metrics.default())
+        self.registries = regs
+        self.http_requests = self.registries[0].counter(
+            "tts_http_requests_total",
+            "observability endpoint hits by path")
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.obs = self  # type: ignore[attr-defined]
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="tts-obs-httpd")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)
+
+    def __enter__(self) -> "ObsHttpd":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ routes
+
+    def _closing(self) -> bool:
+        evt = getattr(self.server, "_closing", None)
+        return bool(evt is not None and evt.is_set())
+
+    def index(self):
+        return 200, json.dumps(
+            {"service": "tpu_tree_search",
+             "endpoints": ["/healthz", "/metrics", "/status",
+                           "/trace"]}) + "\n", "application/json"
+
+    def healthz(self):
+        if self.server is None:
+            return 200, '{"status": "ok", "server": null}\n', \
+                "application/json"
+        if self._closing():
+            return 503, '{"status": "closing"}\n', "application/json"
+        return 200, '{"status": "ok"}\n', "application/json"
+
+    def metrics(self):
+        text = "".join(r.to_prometheus() for r in self.registries)
+        return 200, text, "text/plain; version=0.0.4; charset=utf-8"
+
+    def status(self):
+        if self.server is None:
+            body = {"server": None,
+                    "metrics": [r.to_json() for r in self.registries]}
+        else:
+            body = self.server.status_snapshot()
+        return 200, json.dumps(body) + "\n", "application/json"
+
+    def trace(self):
+        log = self.trace_log or tracelog.get()
+        body = json.dumps(chrome_trace.to_chrome(log.records()))
+        return 200, body, "application/json"
+
+
+def start_http_server(server=None, host: str = "127.0.0.1",
+                      port: int = 0, registries=None,
+                      trace: tracelog.TraceLog | None = None) -> ObsHttpd:
+    """Start the observability HTTP front-end on `host:port` (port 0
+    binds an ephemeral port — read ``.port``). Returns the running
+    :class:`ObsHttpd`; call ``.close()`` (or use as a context manager)
+    to stop it."""
+    return ObsHttpd(server=server, host=host, port=port,
+                    registries=registries, trace=trace)

@@ -1,0 +1,324 @@
+"""Unified metrics registry: counters, gauges, histograms.
+
+One registry replaces the repo's scattered counter dicts (the service's
+hand-rolled ``self.counters``, the executor cache's bare ints, the
+retry tier's warnings-only accounting). Metric types follow the
+Prometheus model — monotonic ``Counter``, settable ``Gauge`` (optionally
+callback-backed so live values like queue depth are read at scrape
+time), bucketed ``Histogram`` — all label-aware, all thread-safe, with
+two expositions:
+
+- :meth:`Registry.to_json` — nested JSON for ``status_snapshot()`` and
+  the ``/status`` endpoint;
+- :meth:`Registry.to_prometheus` — the Prometheus text format for
+  ``/metrics`` (``# HELP`` / ``# TYPE`` / ``name{label="v"} value``,
+  histograms as cumulative ``_bucket{le=...}`` + ``_sum`` + ``_count``).
+
+Scoping: engine-level instrumentation (checkpoint I/O, retries, faults,
+segments) writes to the process-global default registry
+(:func:`default`; swap with :func:`install` for test isolation). The
+search server builds its OWN registry for request/queue/cache metrics —
+two servers in one process (the test suite does this constantly) must
+not bleed counters into each other — and the HTTP front-end exposes
+both, server-scoped first.
+
+Metric names use the ``tts_`` prefix and Prometheus conventions
+(``_total`` for counters, base units in the name). The full name table
+lives in README.md's Observability section.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "default",
+           "install", "DEFAULT_BUCKETS"]
+
+# latency-shaped default buckets (seconds): checkpoint saves and segment
+# times span ~1 ms (tests, tiny instances) to minutes (production pools)
+DEFAULT_BUCKETS = (0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0, 60.0, 300.0)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class _Metric:
+    """Shared label-series bookkeeping for all three metric types."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: dict[tuple, object] = {}
+
+    def _labelnames(self) -> list[tuple]:
+        with self._lock:
+            return sorted(self._series)
+
+    def remove_matching(self, **labels) -> int:
+        """Drop every series whose labels include these pairs; returns
+        how many were dropped. The cardinality valve for per-request
+        label series (tts_phase_seconds{request=...}): the publisher
+        removes a request's series at its terminal transition so a
+        long-serving process cannot accumulate series without bound."""
+        want = {(str(k), str(v)) for k, v in labels.items()}
+        with self._lock:
+            keys = [k for k in self._series if want <= set(k)]
+            for k in keys:
+                del self._series[k]
+            return len(keys)
+
+
+class Counter(_Metric):
+    """Monotonic counter; `inc()` only goes up."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1, **labels) -> None:
+        if n < 0:
+            raise ValueError(f"counter {self.name} cannot decrease ({n})")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+    def samples(self) -> list[tuple[str, tuple, float]]:
+        # no synthetic zero sample when only labeled series exist (or
+        # none yet): an unlabeled `name 0` that vanishes once the first
+        # labeled increment lands reads as a stale/reset series to a
+        # scraper — Prometheus convention is series appear on first use
+        with self._lock:
+            items = sorted(self._series.items())
+        return [(self.name, k, v) for k, v in items]
+
+    def to_json(self):
+        with self._lock:
+            if set(self._series) <= {()}:
+                return self._series.get((), 0)
+            return {_fmt_labels(k) or "": v
+                    for k, v in sorted(self._series.items())}
+
+
+class Gauge(_Metric):
+    """Settable instantaneous value; `set_fn` registers a zero-label
+    callback evaluated at scrape time (live queue depth, occupancy)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._fn = None
+
+    def set(self, v: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(v)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+    def set_fn(self, fn) -> None:
+        self._fn = fn
+
+    def value(self, **labels) -> float:
+        if self._fn is not None and not labels:
+            return float(self._fn())
+        with self._lock:
+            return self._series.get(_label_key(labels), 0.0)
+
+    def samples(self) -> list[tuple[str, tuple, float]]:
+        if self._fn is not None:
+            try:
+                return [(self.name, (), float(self._fn()))]
+            except Exception:  # noqa: BLE001 — scrape must not die on
+                return []      # a callback racing server shutdown
+        with self._lock:
+            items = sorted(self._series.items())
+        return [(self.name, k, v) for k, v in items]
+
+    def to_json(self):
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001
+                return None
+        with self._lock:
+            if set(self._series) <= {()}:
+                return self._series.get((), 0.0)
+            return {_fmt_labels(k) or "": v
+                    for k, v in sorted(self._series.items())}
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics: bucket `le=x`
+    counts every observation <= x; `+Inf` == `_count`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: tuple = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+
+    def observe(self, v: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    s.counts[i] += 1
+            s.sum += v
+            s.count += 1
+
+    def snapshot(self, **labels) -> dict:
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None:
+                return {"count": 0, "sum": 0.0}
+            return {"count": s.count, "sum": s.sum,
+                    "buckets": dict(zip(map(str, self.buckets),
+                                        s.counts))}
+
+    def to_json(self):
+        with self._lock:
+            keys = sorted(self._series)
+        out = {_fmt_labels(k) or "": self.snapshot(**dict(k))
+               for k in keys}
+        if set(out) <= {""}:
+            return out.get("", {"count": 0, "sum": 0.0})
+        return out
+
+
+class Registry:
+    """A named collection of metrics with get-or-create accessors (the
+    instrumentation sites' idiom: `REG.counter("tts_x_total").inc()`
+    is safe to call from anywhere, any number of times)."""
+
+    def __init__(self, namespace: str = ""):
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+        self.created_unix = time.time()
+
+    def _get(self, cls, name: str, help: str, **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = DEFAULT_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def metrics(self) -> list[_Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    # -------------------------------------------------------- exposition
+
+    def to_json(self) -> dict:
+        """Nested JSON view: {metric_name: value | {labels: value}}."""
+        return {m.name: m.to_json() for m in self.metrics()}
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format (version 0.0.4)."""
+        lines = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            if isinstance(m, Histogram):
+                with m._lock:
+                    keys = sorted(m._series)
+                for k in (keys or [()]):
+                    snap = m.snapshot(**dict(k))
+                    acc_labels = dict(k)
+                    for b in m.buckets:
+                        bl = _fmt_labels(_label_key(
+                            {**acc_labels, "le": _fmt_value(b)}))
+                        n = snap.get("buckets", {}).get(str(b), 0)
+                        lines.append(f"{m.name}_bucket{bl} {n}")
+                    bl = _fmt_labels(_label_key(
+                        {**acc_labels, "le": "+Inf"}))
+                    lines.append(f"{m.name}_bucket{bl} {snap['count']}")
+                    sl = _fmt_labels(k)
+                    lines.append(
+                        f"{m.name}_sum{sl} {_fmt_value(snap['sum'])}")
+                    lines.append(f"{m.name}_count{sl} {snap['count']}")
+            else:
+                for name, k, v in m.samples():
+                    lines.append(f"{name}{_fmt_labels(k)} {_fmt_value(v)}")
+        return "\n".join(lines) + "\n"
+
+
+# -------------------------------------------------------- default registry
+
+_default: Registry | None = None
+_default_lock = threading.Lock()
+
+
+def default() -> Registry:
+    """The process-global registry engine-level instrumentation writes
+    to (checkpoint/retry/fault/segment metrics)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Registry("tts")
+        return _default
+
+
+def install(reg: Registry | None) -> Registry | None:
+    """Swap the process-global registry (tests; None re-arms the lazy
+    build). Returns the previous one."""
+    global _default
+    with _default_lock:
+        prev = _default
+        _default = reg
+        return prev

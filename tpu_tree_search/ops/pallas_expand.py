@@ -48,6 +48,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .batched import BoundTables
 
+
+def _x64_off():
+    """Scope a trace to x32 (see the load-bearing comment at the LB2
+    pallas call). `jax.enable_x64(False)` only exists on newer jax; the
+    pinned 0.4.x line spells it `jax.experimental.disable_x64()` — the
+    seed suite's three big-J interpret tests failed on exactly this
+    AttributeError."""
+    if hasattr(jax, "enable_x64"):
+        return jax.enable_x64(False)
+    from jax.experimental import disable_x64
+    return disable_x64()
+
 I32_MAX = jnp.int32(2**31 - 1)
 
 
@@ -571,7 +583,7 @@ def lb2_bounds_tpu(tables: BoundTables, child_front_cols, unsched_cols,
     # index-map function ("failed to legalize operation 'func.return'").
     # Nothing in this call touches 64-bit data, so scoping the trace to
     # x32 is semantics-preserving.
-    with jax.enable_x64(False):
+    with _x64_off():
         call = pl.pallas_call(
             kernel,
             grid=(N // NT,),
@@ -682,7 +694,7 @@ def lb2_bounds_bigj_tpu(tables: BoundTables, child_front_cols,
         return jnp.concatenate(
             [x, jnp.full((pad,) + x.shape[1:], fill, x.dtype)], axis=0)
 
-    with jax.enable_x64(False):
+    with _x64_off():
         sel0 = pad_rows((tables.ma0[:, None]
                          == jnp.arange(M)).astype(jnp.float32), PP)
         sel1 = pad_rows((tables.ma1[:, None]

@@ -38,19 +38,38 @@ class ExecutorCache:
     lazy; XLA compilation happens at first call, outside the lock).
     """
 
-    def __init__(self):
+    def __init__(self, registry=None):
         self._lock = threading.Lock()
         self._fns: dict[tuple, object] = {}
         self.hits = 0
         self.misses = 0
+        # optional metrics mirror (obs/metrics.Registry): the server
+        # passes its per-server registry so /metrics exposes the same
+        # hit/miss counts the JSON snapshot reports
+        self._hits_c = self._misses_c = self._entries_g = None
+        if registry is not None:
+            self._hits_c = registry.counter(
+                "tts_executor_cache_hits_total",
+                "requests served from an already-compiled loop")
+            self._misses_c = registry.counter(
+                "tts_executor_cache_misses_total",
+                "compiled-loop builds (traces/compiles paid)")
+            self._entries_g = registry.gauge(
+                "tts_executor_cache_entries",
+                "distinct compiled loops held")
+            self._entries_g.set_fn(lambda: len(self))
 
     def get_or_build(self, key: tuple, build):
         with self._lock:
             fn = self._fns.get(key)
             if fn is not None:
                 self.hits += 1
+                if self._hits_c is not None:
+                    self._hits_c.inc()
                 return fn
             self.misses += 1
+            if self._misses_c is not None:
+                self._misses_c.inc()
             fn = build()
             self._fns[key] = fn
             return fn

@@ -45,6 +45,9 @@ class RequestQueue:
         self._lock = threading.Lock()
         self._heap: list[tuple[int, int, RequestRecord]] = []
         self.rejected = 0          # admission-control rejections (stats)
+        self.peak_depth = 0        # high-water mark since construction —
+                                   # the capacity-planning number a
+                                   # point-in-time depth gauge misses
 
     def _prune(self) -> None:
         # drop stale heads (cancelled/expired while queued)
@@ -52,18 +55,23 @@ class RequestQueue:
                                                             PREEMPTED):
             heapq.heappop(self._heap)
 
+    def _depth(self) -> int:
+        """Waiting entries (caller holds the lock) — THE definition of
+        queue depth, shared by __len__/admit/requeue so the admission
+        bound and the peak-depth stat cannot diverge."""
+        return sum(1 for _, _, r in self._heap
+                   if r.state in (QUEUED, PREEMPTED))
+
     def __len__(self) -> int:
         with self._lock:
             self._prune()
-            return sum(1 for _, _, r in self._heap
-                       if r.state in (QUEUED, PREEMPTED))
+            return self._depth()
 
     def admit(self, rec: RequestRecord) -> None:
         """Admit a NEW request; raises AdmissionError when full."""
         with self._lock:
             self._prune()
-            depth = sum(1 for _, _, r in self._heap
-                        if r.state in (QUEUED, PREEMPTED))
+            depth = self._depth()
             if depth >= self.max_depth:
                 self.rejected += 1
                 raise AdmissionError(
@@ -71,6 +79,7 @@ class RequestQueue:
                     f"{self.max_depth}; retry later or raise the bound")
             heapq.heappush(self._heap,
                            (-rec.request.priority, rec.seq, rec))
+            self.peak_depth = max(self.peak_depth, depth + 1)
 
     def requeue(self, rec: RequestRecord) -> None:
         """Put a preempted/re-dispatched request back in line.
@@ -78,6 +87,7 @@ class RequestQueue:
         with self._lock:
             heapq.heappush(self._heap,
                            (-rec.request.priority, rec.seq, rec))
+            self.peak_depth = max(self.peak_depth, self._depth())
 
     def pop_best(self) -> RequestRecord | None:
         """Highest-priority waiting request, or None if empty."""

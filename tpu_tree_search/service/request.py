@@ -149,6 +149,11 @@ class RequestRecord:
             "failures": self.failures,
             "spent_s": round(self.spent_s(), 3),
             "error": self.error,
+            # flight-recorder cross-reference: filter the JSONL event
+            # log / Chrome trace by these to see this request's story
+            "tag": self.request.tag or self.id,
+            "stop_reason": self.stop_reason,
+            "hold": self.hold,
             "progress": dict(self.progress),
         }
         res = self.result

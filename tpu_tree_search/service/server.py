@@ -37,7 +37,16 @@ Architecture::
 
 Everything is observable through `status_snapshot()` — a JSON-safe dict
 with queue depth, per-submesh occupancy, executor-cache hit rates and
-per-request counters — and per-request `status()` / `result()`.
+per-request counters — and per-request `status()` / `result()`. Since
+the obs layer landed, the snapshot's counters are a VIEW over the
+server's metrics registry (`self.metrics`, an obs/metrics.Registry —
+the same numbers `/metrics` exposes as Prometheus text), every
+lifecycle transition is flight-recorded (obs/tracelog: admit /
+dispatch / resume / preempt / terminal events, one `request.execute`
+span per dispatch), and each executor thread runs inside an ambient
+`tracelog.context(request_id=..., submesh=...)` so the engine-level
+spans it drives (segments, checkpoint saves, retries, faults) are
+attributable to the request without threading ids through engine APIs.
 """
 
 from __future__ import annotations
@@ -52,6 +61,8 @@ import time
 
 import numpy as np
 
+from ..obs import metrics as obs_metrics
+from ..obs import tracelog
 from ..utils import config as cfg
 from ..utils import faults
 from ..utils.retry import backoff_delay
@@ -112,7 +123,8 @@ class SearchServer:
                  cfg.SERVICE_RETRY_ATTEMPTS_DEFAULT,
                  service_retry_base_s: float =
                  cfg.SERVICE_RETRY_BASE_S_DEFAULT,
-                 autostart: bool = True):
+                 autostart: bool = True,
+                 phase_profile=None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -122,24 +134,85 @@ class SearchServer:
             workdir if workdir is not None
             else tempfile.mkdtemp(prefix="tts_service_"))
         self.workdir.mkdir(parents=True, exist_ok=True)
+        # Per-SERVER metrics registry (obs/metrics): request/queue/cache
+        # metrics must not bleed between servers in one process (the
+        # test suite runs many); engine-level metrics (checkpoints,
+        # retries, faults) stay in the process-global default registry
+        # and the HTTP front-end exposes both.
+        self.metrics = obs_metrics.Registry("tts_service")
+        self._m_submitted = self.metrics.counter(
+            "tts_requests_submitted_total", "requests admitted")
+        self._m_terminal = self.metrics.counter(
+            "tts_requests_total", "requests by terminal state")
+        self._m_preempt = self.metrics.counter(
+            "tts_preemptions_total",
+            "running requests stopped and checkpointed for requeue")
+        self._m_redispatch = self.metrics.counter(
+            "tts_redispatches_total",
+            "submesh-failure re-dispatches (retry tier)")
+        self._m_spent = self.metrics.histogram(
+            "tts_request_spent_seconds",
+            "accumulated execution time of terminal requests")
+        self.metrics.gauge(
+            "tts_queue_depth", "requests waiting for a submesh"
+            ).set_fn(lambda: len(self.queue))
+        # a gauge (callback over queue.rejected), so no `_total` suffix:
+        # the counter convention would promise rate()-safe reset
+        # detection this scrape-time mirror cannot give
+        self.metrics.gauge(
+            "tts_queue_rejected",
+            "admission-control rejections (validation/overflow/closed)"
+            ).set_fn(lambda: self.queue.rejected)
+        self.metrics.gauge(
+            "tts_queue_peak_depth",
+            "high-water queue depth since server start"
+            ).set_fn(lambda: self.queue.peak_depth)
+        self.metrics.gauge(
+            "tts_submeshes", "submesh slots partitioned at startup"
+            ).set_fn(lambda: len(self.slots))
+        self.metrics.gauge(
+            "tts_submeshes_busy", "submeshes currently running a request"
+            ).set_fn(lambda: sum(1 for s in self.slots
+                                 if s.record is not None))
         self.queue = RequestQueue(max_queue_depth)
-        self.cache = ExecutorCache()
+        self.cache = ExecutorCache(registry=self.metrics)
         self.segment_iters = segment_iters
         self.checkpoint_every = checkpoint_every
         self.poll_s = poll_s
         self.service_retry_attempts = service_retry_attempts
         self.service_retry_base_s = service_retry_base_s
+        # live per-worker phase attribution (utils/phase_timing): None
+        # = off; a {"bound","step","compact","per_eval"} unit-cost dict
+        # = attribute every heartbeat with it; True = MEASURE unit costs
+        # once per (shape, lb, chunk) on first dispatch (adds seconds of
+        # profiling to that dispatch — an opt-in production knob)
+        self.phase_profile = phase_profile
+        self._prof_cache: dict[tuple, dict] = {}
         self.records: dict[str, RequestRecord] = {}
-        self.counters = {"submitted": 0, "done": 0, "cancelled": 0,
-                         "deadline": 0, "failed": 0, "preemptions": 0,
-                         "redispatches": 0}
         self._lock = threading.RLock()
         self._seq = itertools.count()
         self._t0 = time.monotonic()
         self._closing = threading.Event()
         self._scheduler: threading.Thread | None = None
+        tracelog.event("server.start", submeshes=len(self.slots),
+                       devices_per_submesh=self.slots[0].mesh.devices.size,
+                       workdir=str(self.workdir))
         if autostart:
             self.start()
+
+    @property
+    def counters(self) -> dict:
+        """Lifecycle counters, now a VIEW over the metrics registry (the
+        pre-obs hand-rolled dict, kept as the JSON snapshot schema and
+        for callers that read e.g. ``srv.counters["preemptions"]``)."""
+        t = self._m_terminal
+        return {"submitted": int(self._m_submitted.value()),
+                "done": int(t.value(state="done")),
+                "cancelled": int(t.value(state="cancelled")),
+                "deadline": int(t.value(state="deadline")),
+                "failed": int(t.value(state="failed")),
+                "preemptions": int(self._m_preempt.value()),
+                "redispatches": int(self._m_redispatch.value())}
 
     # ------------------------------------------------------------ lifecycle
 
@@ -156,6 +229,8 @@ class SearchServer:
         segment boundary and left PREEMPTED with a fresh checkpoint (a
         new server with the same workdir + tags resumes them); queued
         requests are CANCELLED. Unblocks every `result()` waiter."""
+        if not self._closing.is_set():
+            tracelog.event("server.close")
         self._closing.set()
         with self._lock:
             for slot in self.slots:
@@ -193,10 +268,13 @@ class SearchServer:
         client never learns about overload from a timeout."""
         if self._closing.is_set():
             self.queue.rejected += 1
+            tracelog.event("request.reject", reason="server closed")
             raise AdmissionError("server closed")
         reason = request.validate()
         if reason is not None:
             self.queue.rejected += 1
+            tracelog.event("request.reject",
+                           reason=f"invalid request: {reason}")
             raise AdmissionError(f"invalid request: {reason}")
         with self._lock:
             seq = next(self._seq)
@@ -213,6 +291,8 @@ class SearchServer:
                 # files; resubmit-to-extend is only meaningful once the
                 # prior request is terminal
                 self.queue.rejected += 1
+                tracelog.event("request.reject", tag=tag,
+                               reason=f"tag active on {holder.id}")
                 raise AdmissionError(
                     f"tag {tag!r} is already active on request "
                     f"{holder.id} ({holder.state}); wait for it to "
@@ -227,9 +307,17 @@ class SearchServer:
                 # resubmitted tag gets the remainder of a larger
                 # budget, not a fresh one
                 spent_prev_s=_prior_spent_s(path))
-            self.queue.admit(rec)          # raises AdmissionError if full
+            try:
+                self.queue.admit(rec)      # raises AdmissionError if full
+            except AdmissionError as e:
+                tracelog.event("request.reject", reason=str(e))
+                raise
             self.records[rid] = rec
-            self.counters["submitted"] += 1
+            self._m_submitted.inc()
+            tracelog.event("request.admit", request_id=rid, tag=tag,
+                           priority=request.priority,
+                           deadline_s=request.deadline_s,
+                           resumable=rec.spent_prev_s > 0)
             return rid
 
     def status(self, request_id: str) -> dict:
@@ -291,7 +379,11 @@ class SearchServer:
     def status_snapshot(self) -> dict:
         """One JSON-safe dict describing the whole server: queue depth
         and order, per-submesh occupancy, executor-cache hit/miss
-        counters, lifecycle counters, and every request's snapshot."""
+        counters, lifecycle counters, and every request's snapshot.
+        The counters and the `metrics` view are both read from the
+        server's metrics registry (the same numbers `/metrics` exposes
+        as Prometheus text) — the snapshot is a rendering of the
+        registry, not a parallel bookkeeping path."""
         with self._lock:
             return {
                 "t": time.time(),
@@ -299,13 +391,15 @@ class SearchServer:
                 "queue": {"depth": len(self.queue),
                           "waiting": self.queue.waiting_ids(),
                           "max_depth": self.queue.max_depth,
+                          "peak_depth": self.queue.peak_depth,
                           "rejected": self.queue.rejected},
                 "submeshes": [
                     {"index": s.index, "devices": s.device_ids,
                      "running": s.record.id if s.record else None}
                     for s in self.slots],
                 "executor_cache": self.cache.snapshot(),
-                "counters": dict(self.counters),
+                "counters": self.counters,
+                "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()
                              for rid, rec in self.records.items()},
             }
@@ -331,7 +425,18 @@ class SearchServer:
         rec.finished_t = time.monotonic()
         key = {DONE: "done", CANCELLED: "cancelled",
                DEADLINE: "deadline", FAILED: "failed"}[state]
-        self.counters[key] += 1
+        self._m_terminal.inc(state=key)
+        self._m_spent.observe(rec.spent_s())
+        if self.phase_profile is not None:
+            # live-attribution series are per-request labeled; retire
+            # them with the request or a long-serving process grows
+            # gauge cardinality without bound
+            self.metrics.gauge("tts_phase_seconds").remove_matching(
+                request=rec.id)
+        tracelog.event(f"request.{key}", request_id=rec.id,
+                       spent_s=round(rec.spent_s(), 3),
+                       dispatches=rec.dispatches,
+                       preemptions=rec.preemptions, error=rec.error)
         if state == DONE:
             # retire the checkpoint family: a DONE snapshot left behind
             # would make a tag-reusing resubmission instantly "resume"
@@ -431,6 +536,16 @@ class SearchServer:
         rec.dispatches += 1
         rec.stop_reason = None
         rec.started_t = time.monotonic()
+        tracelog.event("request.dispatch", request_id=rec.id,
+                       submesh=slot.index, dispatch=rec.dispatches,
+                       queue_depth=len(self.queue))
+        if rec.dispatches > 1:
+            # re-dispatch of preempted/failed work — the flight
+            # recorder's "resume" marker the span-sequence tests assert
+            tracelog.event("request.resume", request_id=rec.id,
+                           submesh=slot.index, dispatch=rec.dispatches,
+                           preemptions=rec.preemptions,
+                           failures=rec.failures)
         slot.record = rec
         slot.stop_event = threading.Event()
         slot.thread = threading.Thread(
@@ -448,6 +563,8 @@ class SearchServer:
         jobs, machines = p.shape[1], p.shape[0]
         capacity = req.capacity or device.default_capacity(jobs, machines)
         evt = slot.stop_event
+        unit_costs = (self._unit_costs(req)
+                      if self.phase_profile is not None else None)
 
         def hb(rep):
             rec.progress = {
@@ -455,37 +572,96 @@ class SearchServer:
                 "tree": rep.tree, "sol": rep.sol, "best": rep.best,
                 "pool": rep.pool_size,
                 "elapsed_s": round(rep.elapsed, 3)}
+            if unit_costs is not None and rep.per_worker is not None:
+                self._publish_phases(rec, rep, unit_costs)
 
         # per-request fault injection stays thread-scoped: it must not
         # leak into requests concurrently served on other submeshes
         scope = (faults.scoped(req.faults) if req.faults is not None
                  else contextlib.nullcontext())
         res = error = None
+        # every record the engine emits from this thread (segment spans,
+        # checkpoint saves, retries, injected faults) carries the
+        # request/submesh identity via the recorder's ambient context
+        with tracelog.context(request_id=rec.id, submesh=slot.index):
+            try:
+                with scope, tracelog.span(
+                        "request.execute", dispatch=rec.dispatches,
+                        jobs=jobs, machines=machines,
+                        lb_kind=req.lb_kind) as ex_span:
+                    res = distributed.search(
+                        p, lb_kind=req.lb_kind, init_ub=req.init_ub,
+                        mesh=slot.mesh, chunk=req.chunk,
+                        capacity=capacity,
+                        balance_period=req.balance_period,
+                        min_seed=req.min_seed,
+                        segment_iters=(req.segment_iters
+                                       or self.segment_iters),
+                        checkpoint_path=rec.checkpoint_path,
+                        checkpoint_every=(req.checkpoint_every
+                                          or self.checkpoint_every),
+                        heartbeat=hb, stop_event=evt,
+                        loop_cache=self.cache,
+                        # cumulative execution clock rides every
+                        # checkpoint (the legacy campaign worker's
+                        # spent_s key), so budgets survive preemption,
+                        # server restarts and legacy<->serve handoffs
+                        checkpoint_meta_extra=lambda: {
+                            **(req.checkpoint_meta or {}),
+                            "spent_s": round(rec.spent_s(), 2)})
+                    ex_span.set(tree=res.explored_tree, best=res.best,
+                                complete=res.complete)
+            except checkpoint.TRANSIENT_ERRORS as e:
+                error = f"transient: {e!r}"
+            except Exception as e:  # noqa: BLE001 — FAILED terminal below
+                error = f"{type(e).__name__}: {e}"
+                rec.failures = self.service_retry_attempts + 1  # no retry
+            self._on_finished(slot, rec, res, error)
+
+    def _unit_costs(self, req) -> dict | None:
+        """Resolve the phase-attribution unit costs for `req` (see the
+        `phase_profile` constructor knob): a shared dict is used as-is;
+        True measures utils/phase_timing.profile_phases once per
+        (shape, lb, chunk) and caches it for every later request."""
+        if isinstance(self.phase_profile, dict):
+            return self.phase_profile
+        p = np.asarray(req.p_times)
+        key = (p.shape, req.lb_kind, req.chunk)
+        with self._lock:
+            prof = self._prof_cache.get(key)
+        if prof is not None:
+            return prof
+        from ..engine import device
+        from ..ops import batched
+        from ..utils import phase_timing
         try:
-            with scope:
-                res = distributed.search(
-                    p, lb_kind=req.lb_kind, init_ub=req.init_ub,
-                    mesh=slot.mesh, chunk=req.chunk, capacity=capacity,
-                    balance_period=req.balance_period,
-                    min_seed=req.min_seed,
-                    segment_iters=req.segment_iters or self.segment_iters,
-                    checkpoint_path=rec.checkpoint_path,
-                    checkpoint_every=(req.checkpoint_every
-                                      or self.checkpoint_every),
-                    heartbeat=hb, stop_event=evt, loop_cache=self.cache,
-                    # cumulative execution clock rides every checkpoint
-                    # (the legacy campaign worker's spent_s key), so
-                    # budgets survive preemption, server restarts and
-                    # legacy<->serve handoffs
-                    checkpoint_meta_extra=lambda: {
-                        **(req.checkpoint_meta or {}),
-                        "spent_s": round(rec.spent_s(), 2)})
-        except checkpoint.TRANSIENT_ERRORS as e:
-            error = f"transient: {e!r}"
-        except Exception as e:  # noqa: BLE001 — FAILED terminal below
-            error = f"{type(e).__name__}: {e}"
-            rec.failures = self.service_retry_attempts + 1  # no retry
-        self._on_finished(slot, rec, res, error)
+            with tracelog.span("phase_profile", jobs=p.shape[1],
+                               lb_kind=req.lb_kind, chunk=req.chunk):
+                tables = batched.make_tables(p)
+                state = device.init_state(
+                    p.shape[1], max(1 << 12, 4 * req.chunk * p.shape[1]),
+                    req.init_ub, p_times=p)
+                prof = phase_timing.profile_phases(
+                    tables, state, req.lb_kind, req.chunk, warm_iters=4)
+        except Exception as e:  # noqa: BLE001 — attribution is an
+            # observability extra; its failure must never fail a request
+            tracelog.event("phase_profile.failed", error=repr(e))
+            prof = None
+        with self._lock:
+            self._prof_cache[key] = prof
+        return prof
+
+    def _publish_phases(self, rec: RequestRecord, rep, prof: dict) -> None:
+        """Heartbeat hook: attribute the request's CUMULATIVE execution
+        clock across kernel/genchild/balance/idle from its per-worker
+        counters and publish tts_phase_seconds gauges — the live view of
+        the attribution that used to exist only in end-of-run CSVs."""
+        from ..utils import phase_timing
+        att = phase_timing.attribute(
+            prof, elapsed=rec.spent_s(),
+            evals=rep.per_worker["evals"], iters=rep.per_worker["iters"])
+        phase_timing.publish_attribution(att, registry=self.metrics,
+                                         request=rec.id)
 
     def _on_finished(self, slot: _Slot, rec: RequestRecord,
                      res, error: str | None) -> None:
@@ -505,7 +681,10 @@ class SearchServer:
                     # submesh (the checkpoint, when one was written,
                     # reshards elastically)
                     rec.state = QUEUED
-                    self.counters["redispatches"] += 1
+                    self._m_redispatch.inc()
+                    tracelog.event("request.redispatch",
+                                   request_id=rec.id,
+                                   failures=rec.failures, error=error)
                     backoff = backoff_delay(rec.failures - 1,
                                             self.service_retry_base_s)
                     requeue = rec
@@ -523,7 +702,11 @@ class SearchServer:
                 elif reason in ("preempt", "shutdown") or evt_set(slot):
                     rec.state = PREEMPTED
                     rec.preemptions += 1
-                    self.counters["preemptions"] += 1
+                    self._m_preempt.inc()
+                    tracelog.event("request.preempt", request_id=rec.id,
+                                   reason=reason or "stop",
+                                   preemptions=rec.preemptions,
+                                   hold=rec.hold)
                     if reason != "shutdown" and not rec.hold \
                             and not self._closing.is_set():
                         requeue = rec

@@ -37,6 +37,14 @@ SERVICE_RETRY_ATTEMPTS_DEFAULT = 2    # re-dispatches after a submesh
                                       # failure before a request FAILs
 SERVICE_RETRY_BASE_S_DEFAULT = 0.2    # re-dispatch backoff base
 
+# Observability defaults (tpu_tree_search/obs). Env-driven like the
+# resilience knobs (they must survive campaign-worker respawns):
+# TTS_TRACE_FILE appends the flight recorder's JSONL event log to a
+# file, TTS_TRACE_RING bounds the in-memory ring buffer. The HTTP
+# front-end is wired per entry point (`serve --http-port`), never
+# ambiently — an open port must be an explicit operator choice.
+OBS_TRACE_RING_DEFAULT = 16384        # ring-buffer records kept in RAM
+
 
 @dataclasses.dataclass
 class PFSPConfig:

@@ -196,23 +196,48 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
         return
     if point == "segment_start":
         if plan.delay_every > 0:
+            _record(point, "delay_every", segment=segment,
+                    seconds=plan.delay_every)
             time.sleep(plan.delay_every)
         if plan.delay_segment and segment == plan.delay_segment[0]:
+            _record(point, "delay_segment", segment=segment,
+                    seconds=plan.delay_segment[1])
             time.sleep(plan.delay_segment[1])
     elif point == "post_checkpoint":
         if (plan.corrupt_checkpoint is not None
                 and segment == plan.corrupt_checkpoint
                 and path is not None and os.path.exists(path)):
+            _record(point, "corrupt_checkpoint", segment=segment,
+                    path=str(path))
             corrupt_file(path)
     elif point == "post_segment":
         if (plan.kill_after_segment is not None
                 and segment == plan.kill_after_segment):
+            # the flight-recorder sink is line-buffered, so the record
+            # reaches the OS before the exit below skips every flush
+            _record(point, "kill_after_segment", segment=segment)
             # a preemption does not run exit handlers or flush buffers;
             # os._exit is the honest simulation
             os._exit(KILL_EXIT_CODE)
     elif point == "host_fetch":
         if plan.fetch_failures_fired < plan.fail_host_fetch:
             plan.fetch_failures_fired += 1
+            _record(point, "fail_host_fetch",
+                    fired=plan.fetch_failures_fired,
+                    budget=plan.fail_host_fetch)
             raise InjectedFault(
                 f"injected host-fetch failure "
                 f"{plan.fetch_failures_fired}/{plan.fail_host_fetch}")
+
+
+def _record(point: str, fault: str, **attrs) -> None:
+    """Flight-record an injection that actually FIRED (armed-but-idle
+    points stay silent): a `fault.injected` event plus the
+    `tts_faults_injected_total{point,fault}` counter, so a resilience
+    drill's timeline shows the cause next to the recovery it tests."""
+    from ..obs import metrics, tracelog
+    tracelog.event("fault.injected", point=point, fault=fault, **attrs)
+    metrics.default().counter(
+        "tts_faults_injected_total",
+        "deterministic fault injections that fired").inc(point=point,
+                                                         fault=fault)

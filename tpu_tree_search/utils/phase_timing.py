@@ -240,3 +240,23 @@ def attribute(prof: dict, elapsed: float, evals, iters,
     idle = np.clip(elapsed - kernel - compact - balance, 0.0, None)
     return {"kernel_time": kernel, "gen_child_time": compact,
             "balance_time": balance, "idle_time": idle}
+
+
+def publish_attribution(att: dict, registry=None, **labels) -> None:
+    """Publish an :func:`attribute` result into a metrics registry
+    (obs/metrics) as ``tts_phase_seconds{phase=, worker=, ...labels}``
+    gauges — the live exposition of the per-worker breakdown that used
+    to exist only in end-of-run CSV rows (the reference's
+    PFSP_statistic.c columns). The search service calls this per
+    heartbeat with ``request=<id>`` labels (server.py `phase_profile`);
+    the CLI's CSV writer publishes its end-of-run attribution the same
+    way, so `/metrics` and the CSV can never disagree."""
+    from ..obs import metrics as obs_metrics
+
+    reg = registry if registry is not None else obs_metrics.default()
+    g = reg.gauge("tts_phase_seconds",
+                  "measured per-worker wall-clock phase attribution")
+    for phase, arr in att.items():
+        name = phase[:-5] if phase.endswith("_time") else phase
+        for w, v in enumerate(np.atleast_1d(np.asarray(arr, float))):
+            g.set(float(v), phase=name, worker=w, **labels)

@@ -13,6 +13,12 @@ policy is deliberately minimal and uniform:
   the engine's retries guard a single-process resource (device runtime,
   local filesystem), not a contended fleet endpoint, and deterministic
   delays keep the fault-injection tests exact.
+
+Every scheduled retry is recorded in the flight recorder (a ``retry``
+event with the operation name, attempt number and error) and counted in
+the metrics registry (``tts_retries_total{what=...}``) — one increment
+per transient failure that was retried, so the fault-injection tests
+can assert the counter exactly (`fail_host_fetch=1` => exactly 1).
 """
 
 from __future__ import annotations
@@ -58,6 +64,12 @@ def retry_call(fn: Callable, *, what: str = "operation",
             if attempt >= attempts - 1:
                 raise
             delay = backoff_delay(attempt, base_s)
+            from ..obs import metrics, tracelog
+            tracelog.event("retry", what=what, attempt=attempt,
+                           delay_s=delay, error=repr(e))
+            metrics.default().counter(
+                "tts_retries_total",
+                "transient-failure retries by operation").inc(what=what)
             if on_retry is not None:
                 on_retry(attempt, delay, e)
             else:
