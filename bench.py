@@ -52,6 +52,9 @@ def bench_one(tables, p, ub, lb_kind: int, chunk: int, iters: int,
     state = device.run(tables, state, lb_kind, chunk, max_iters=warm)
     state.size.block_until_ready()
     evals0 = int(state.evals)
+    # telemetry baseline at the same cut as evals0, so the reported
+    # search-efficiency counts cover exactly the timed window
+    tele0 = np.asarray(state.telemetry, dtype=np.int64).copy()
 
     t0 = time.perf_counter()
     state = device.run(tables, state, lb_kind, chunk,
@@ -59,7 +62,7 @@ def bench_one(tables, p, ub, lb_kind: int, chunk: int, iters: int,
     state.size.block_until_ready()
     dt = time.perf_counter() - t0
     evals = int(state.evals) - evals0
-    return evals, dt, state
+    return evals, dt, state, tele0
 
 
 def main():
@@ -95,8 +98,9 @@ def main():
         it = iters if lb_kind != 2 else max(200, iters // 2)
         warm = 50 if lb_kind != 2 else min(1000, max(50, iters // 2))
         warm = int(os.environ.get("TTS_BENCH_WARM", warm))
-        evals, dt, state = bench_one(tables, p, ub, lb_kind, chunk, it,
-                                     capacity, warm=warm)
+        evals, dt, state, tele0 = bench_one(tables, p, ub, lb_kind,
+                                            chunk, it, capacity,
+                                            warm=warm)
         if evals == 0 or bool(state.overflow):
             # the warm-up drained or overflowed the pool: there is no
             # sustained rate to report — say so instead of printing a
@@ -108,14 +112,33 @@ def main():
                   file=sys.stderr)
             continue
         rate = evals / dt
-        print(json.dumps({
+        row = {
             "metric": (f"pfsp_ta{inst:03d}_lb{lb_kind}"
                        "_node_evals_per_sec_per_chip"),
             "value": round(rate, 1),
             "unit": "node_evals_per_sec",
             "vs_baseline": round(rate / PER_CHIP_TARGET, 4),
             "baseline": BASELINE_LABEL,
-        }))
+        }
+        # with TTS_SEARCH_TELEMETRY=1 the row also captures SEARCH
+        # efficiency (pruning quality, frontier position, pool
+        # pressure), not just throughput — future BENCH_*.json rounds
+        # can tell a faster-but-worse-pruning regression from a win.
+        # Counts are TIMED-WINDOW deltas (the warm-up baseline is
+        # subtracted, same cut as evals0); pool_highwater alone is
+        # cumulative — a high-water mark has no window-scoped reading.
+        tnow = np.asarray(state.telemetry, dtype=np.int64)
+        if tnow.size:
+            from tpu_tree_search.engine import telemetry as tele
+            d = tele.delta_counts(tnow, tele0)
+            row["telemetry"] = {
+                "pruning_rate": d["pruning_rate"],
+                "frontier_depth": d["frontier_depth"],
+                "pool_highwater": int(tnow[tele.O_POOL_HW]),
+                "branched": d["branched"],
+                "pruned": d["pruned"],
+            }
+        print(json.dumps(row))
         print(f"# lb={lb_kind} evals={evals} dt={dt:.3f}s iters={it} "
               f"chunk={chunk} pool={int(state.size)} "
               f"best={int(state.best)}", file=sys.stderr)

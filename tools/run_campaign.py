@@ -64,7 +64,12 @@ covers a cold 50x20 compile), TTS_MAX_RESTARTS (default 50).
 Resilience knobs ride through to the worker's run_segmented:
 TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S (transient-error backoff) and
 TTS_SEG_TIMEOUT_S (per-segment wall watchdog — the in-process
-complement of this supervisor's heartbeat-age kill). Checkpoints are
+complement of this supervisor's heartbeat-age kill).
+TTS_SEARCH_TELEMETRY=1 compiles the on-device search-telemetry block
+into every solve (engine/telemetry.py): rows gain a `telemetry` column
+(pruning rate, frontier depth, pool high-water, steal flow) and the
+serve-mode trace carries per-segment search.telemetry events
+(tools/search_report.py renders them). Checkpoints are
 atomic + checksummed with a rotating `.prev` last-good; a worker that
 finds its current snapshot torn rolls back to the last-good one
 (engine/checkpoint.load_resilient). A budget-exhausted PARTIAL row
@@ -117,6 +122,32 @@ DEAD_LIMIT = int(os.environ.get("TTS_DEAD_LIMIT", "5"))
 def paths(inst: int, lb: int):
     base = os.path.join(WORKDIR, f"tts_ta{inst:03d}_lb{lb}")
     return base + ".status.jsonl", base + ".ckpt.npz"
+
+
+def _telemetry_columns(block_or_summary) -> dict:
+    """Search-efficiency columns for a result row, from either a raw
+    state.telemetry block (legacy worker) or a DistResult.telemetry
+    summary dict (serve mode); {} when telemetry is off — rows from
+    telemetry-off campaigns keep their exact historical schema."""
+    s = block_or_summary
+    if s is None:
+        return {}
+    if not isinstance(s, dict):
+        import numpy as np
+        if not np.asarray(s).size:
+            return {}
+        from tpu_tree_search.engine import telemetry as tele
+        s = tele.summarize(np.asarray(s))
+    return {"telemetry": {
+        "pruning_rate": s["pruning_rate"],
+        "frontier_depth": s["frontier_depth"],
+        "pool_highwater": s["pool_highwater"],
+        "branched": sum(s["branched"]),
+        "pruned": sum(s["pruned"]),
+        "steal_sent": s["steal_sent"],
+        "steal_recv": s["steal_recv"],
+        "improvements": s["improvements"],
+    }}
 
 
 # the rotating last-good sibling every atomic save leaves beside the
@@ -306,6 +337,7 @@ def worker_main(inst: int) -> None:
            "capacity": capacity, "grows": grows, "pool_at_stop": size,
            "pushed_per_s": round(tree / max(spent, 1e-9), 1),
            "evals_per_s": round(evals / max(spent, 1e-9), 1)}
+    row.update(_telemetry_columns(state.telemetry))
     if done and UB_MODE == "opt" and best != ub:
         # a WRONG ANSWER is never a transient — the supervisor must
         # abort the campaign loudly, not retry/skip
@@ -624,7 +656,8 @@ def _serve_row(inst: int, rec, trace_file: str | None = None
     iters = int(max(per.get("iters", [0])))
     pool = int(sum(per.get("final_size", [0])))
     done = rec.state == "DONE" and res.complete
-    return {"inst": inst, "jobs": jobs, "machines": m, "lb": LB,
+    return {**_telemetry_columns(getattr(res, "telemetry", None)),
+            "inst": inst, "jobs": jobs, "machines": m, "lb": LB,
             "chunk": CHUNK, "budget_s": BUDGET_S, "ub_mode": UB_MODE,
             "done": done, "elapsed_s": round(spent, 2),
             "tree": int(res.explored_tree), "sol": int(res.explored_sol),

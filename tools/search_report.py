@@ -1,0 +1,120 @@
+"""Per-segment search-telemetry table from a flight-recorder trace.
+
+Reads either trace artifact (the JSONL event log or the Chrome
+trace-event JSON — same detection as tools/trace_summary.py) and folds
+the ``search.telemetry`` events the segmented engine driver emits when
+TTS_SEARCH_TELEMETRY / --search-telemetry is on
+(engine/checkpoint.run_segmented; the on-device block itself is
+engine/telemetry.py) into two tables:
+
+- **pruning efficiency**: one row per (request, segment) — nodes
+  popped/branched/pruned that segment, the pruning rate, the mean
+  relative frontier depth (0 = root, 1 = leaves), live pool size,
+  steal flow and the incumbent;
+- **load imbalance**: for distributed segments (the event carries
+  per-worker eval deltas), min/max/mean evals per worker and the
+  max/mean imbalance factor — the starved-worker view the reference's
+  boxplot stats print per pool.
+
+    python tools/search_report.py /tmp/tts-trace.jsonl
+    python tools/search_report.py /tmp/tts-trace.chrome.json
+
+Doubles as the CI artifact renderer: the telemetry CI leg uploads this
+table next to the serve-session traces (tests/test_telemetry.py writes
+the trace, the workflow runs this on it).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from trace_summary import load_records  # noqa: E402
+
+TELEMETRY_EVENT = "search.telemetry"
+
+
+def fold(records: list[dict]) -> dict[str, list[dict]]:
+    """search.telemetry events grouped by request id ('-' when the run
+    was not served), in (ts, segment) order."""
+    out: dict[str, list[dict]] = {}
+    for r in sorted(records, key=lambda r: (r.get("ts", 0.0),
+                                            r.get("seq", 0))):
+        if r.get("name") != TELEMETRY_EVENT:
+            continue
+        out.setdefault(str(r.get("request_id") or "-"), []).append(r)
+    return out
+
+
+def _imbalance(evals_pw: list) -> tuple[float, float, float, float]:
+    n = max(len(evals_pw), 1)
+    mean = sum(evals_pw) / n
+    return (min(evals_pw, default=0), max(evals_pw, default=0), mean,
+            (max(evals_pw, default=0) / mean) if mean > 0 else 0.0)
+
+
+def render(groups: dict[str, list[dict]]) -> str:
+    hdr = (f"{'request':<10} {'seg':>4} {'popped':>9} {'branched':>9} "
+           f"{'pruned':>9} {'prune%':>7} {'frontier':>8} {'pool':>9} "
+           f"{'steal s/r':>11} {'best':>7}")
+    lines = ["pruning efficiency (per segment)", hdr, "-" * len(hdr)]
+    imb_rows = []
+    for rid in sorted(groups):
+        for r in groups[rid]:
+            lines.append(
+                f"{rid:<10} {r.get('segment', 0):>4} "
+                f"{r.get('popped', 0):>9} {r.get('branched', 0):>9} "
+                f"{r.get('pruned', 0):>9} "
+                f"{100.0 * float(r.get('pruning_rate', 0.0)):>6.1f}% "
+                f"{float(r.get('frontier_depth', 0.0)):>8.3f} "
+                f"{r.get('pool', 0):>9} "
+                f"{str(r.get('steal_sent', 0)) + '/' + str(r.get('steal_recv', 0)):>11} "
+                f"{r.get('best', 0):>7}")
+            if r.get("evals_pw"):
+                imb_rows.append((rid, r))
+    if imb_rows:
+        hdr2 = (f"{'request':<10} {'seg':>4} {'workers':>7} "
+                f"{'min_evals':>10} {'max_evals':>10} {'mean':>10} "
+                f"{'max/mean':>8}")
+        lines += ["", "load imbalance (per-worker evals per segment)",
+                  hdr2, "-" * len(hdr2)]
+        for rid, r in imb_rows:
+            lo, hi, mean, factor = _imbalance(r["evals_pw"])
+            lines.append(
+                f"{rid:<10} {r.get('segment', 0):>4} "
+                f"{len(r['evals_pw']):>7} {int(lo):>10} {int(hi):>10} "
+                f"{mean:>10.1f} {factor:>8.2f}")
+    n_seg = sum(len(v) for v in groups.values())
+    lines.append("")
+    lines.append(f"{len(groups)} run(s), {n_seg} telemetry segment(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-segment pruning-efficiency / load-imbalance "
+                    "table from a flight-recorder trace (JSONL or "
+                    "Chrome JSON) with search telemetry enabled")
+    ap.add_argument("trace", help="trace file path")
+    args = ap.parse_args(argv)
+    records = load_records(args.trace)
+    if not records:
+        print(f"error: no trace records in {args.trace}",
+              file=sys.stderr)
+        return 1
+    groups = fold(records)
+    if not groups:
+        print(f"error: {len(records)} records but no "
+              f"'{TELEMETRY_EVENT}' events in {args.trace} — was the "
+              "run started with TTS_SEARCH_TELEMETRY=1 / "
+              "--search-telemetry?", file=sys.stderr)
+        return 1
+    print(render(groups))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,15 @@ def _pfsp_parser(sub):
                         "resilience drills, e.g. "
                         "'kill_after_segment=3,fail_host_fetch=1' "
                         "(utils/faults.py; also via TTS_FAULTS)")
+    p.add_argument("--search-telemetry", action="store_true",
+                   help="compile the on-device search-telemetry block "
+                        "into the loop (engine/telemetry.py: depth-"
+                        "bucketed pruning counts, bound histograms, "
+                        "pool high-water, steal flow, incumbent ring; "
+                        "also via TTS_SEARCH_TELEMETRY=1). Node counts "
+                        "stay bit-identical; segmented runs emit per-"
+                        "segment search.telemetry trace events "
+                        "(tools/search_report.py renders them)")
 
 
 def _serve_parser(sub):
@@ -149,6 +158,19 @@ def _serve_parser(sub):
                         "kernel/genchild/balance/idle attribution as "
                         "tts_phase_seconds gauges (adds seconds of "
                         "profiling to each shape's first dispatch)")
+    p.add_argument("--search-telemetry", action="store_true",
+                   help="compile the on-device search-telemetry block "
+                        "into every served loop (also via "
+                        "TTS_SEARCH_TELEMETRY=1): per-request pruning "
+                        "efficiency on /metrics (tts_search_* gauges), "
+                        "search.telemetry trace events, Perfetto "
+                        "counter tracks on /trace")
+    p.add_argument("--otel-endpoint", type=str, default=None,
+                   help="export the session's flight-recorder ring as "
+                        "OTLP spans to this OTLP/HTTP traces URL at "
+                        "shutdown (obs/otel.py; requires the "
+                        "opentelemetry SDK — a clean no-op warning "
+                        "when it is not installed)")
 
 
 def _client_parser(sub):
@@ -179,6 +201,9 @@ def run_serve(args) -> int:
     from .obs import tracelog
     from .service import SearchServer, spool
 
+    if args.search_telemetry:
+        # static compile-in flag, read at each request's state init
+        os.environ["TTS_SEARCH_TELEMETRY"] = "1"
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -195,7 +220,8 @@ def run_serve(args) -> int:
                 httpd = start_http_server(srv, host=args.http_host,
                                           port=args.http_port)
                 print(f"observability: {httpd.url}/healthz /metrics "
-                      "/status /trace", flush=True)
+                      "/status /trace; POST /submit /cancel",
+                      flush=True)
             print(f"serving: {args.submeshes} submesh(es) x "
                   f"{srv.slots[0].mesh.devices.size} device(s), "
                   f"spool {args.spool}", flush=True)
@@ -206,6 +232,12 @@ def run_serve(args) -> int:
     finally:
         if httpd is not None:
             httpd.close()
+        if args.otel_endpoint:
+            from .obs import otel
+            n = otel.export(tracelog.get().records(),
+                            endpoint=args.otel_endpoint)
+            print(f"otel: exported {n} span(s) to "
+                  f"{args.otel_endpoint}", flush=True)
     print(f"served {served} request(s)", flush=True)
     return 0
 
@@ -282,6 +314,10 @@ def run_pfsp(args) -> int:
         os.environ["TTS_RETRY_ATTEMPTS"] = str(args.retry_attempts)
     if getattr(args, "segment_timeout", None) is not None:
         os.environ["TTS_SEG_TIMEOUT_S"] = str(args.segment_timeout)
+    if getattr(args, "search_telemetry", False):
+        # env, not a Python knob: init_state reads it at state
+        # creation, and respawned campaign workers must inherit it
+        os.environ["TTS_SEARCH_TELEMETRY"] = "1"
     if getattr(args, "faults", None):
         from .utils import faults
         faults.configure(args.faults)

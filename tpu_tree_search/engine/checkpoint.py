@@ -31,6 +31,7 @@ from ..obs import metrics as obs_metrics
 from ..obs import tracelog
 from ..utils import faults
 from ..utils.retry import retry_call
+from . import telemetry as tele
 from .device import SearchState
 
 
@@ -345,7 +346,7 @@ def _load_impl(path: str | pathlib.Path,
                 f"checkpoint {path} failed its embedded CRC32 "
                 f"(stored {want:#010x}, recomputed {got:#010x})")
     missing = [f for f in SearchState._fields
-               if f != "aux" and f not in raw]
+               if f not in ("aux", "telemetry") and f not in raw]
     if missing:
         raise CheckpointCorrupt(
             f"checkpoint {path} is missing state fields {missing} "
@@ -398,6 +399,14 @@ def _load_impl(path: str | pathlib.Path,
                 aux[:, :n] = ref.prefix_front_remain(
                     p_times, prmu[:, :n].T, depth[:n])[:, :m].T
         arrays["aux"] = aux
+    if "telemetry" not in arrays:
+        # pre-telemetry snapshot: reconstruct a zeroed block at the
+        # CURRENT flag's width (counters restart from the resume; the
+        # saved pool/counter state is untouched either way)
+        lead = (arrays["prmu"].shape[0],) if arrays["prmu"].ndim == 3 \
+            else ()
+        arrays["telemetry"] = np.zeros(lead + (tele.enabled_width(),),
+                                       np.int64)
     state = SearchState(*(jnp.asarray(arrays[f])
                           for f in SearchState._fields))
     return state, meta
@@ -555,7 +564,16 @@ def reshard_state(state: SearchState, new_workers: int,
         v[0] = total_val
         return v
 
+    # telemetry follows the tree/sol rule: global totals preserved,
+    # merged onto worker 0 (counts summed, pool high-water maxed, the
+    # incumbent ring replayed in iteration order — telemetry.merge)
+    tw = arrs.telemetry.shape[-1]
+    telem = np.zeros((M, tw), np.int64)
+    if tw:
+        telem[0] = tele.merge(arrs.telemetry)
+
     out = SearchState(
+        telemetry=telem,
         prmu=prmu, depth=depth, aux=aux,
         size=counts.astype(np.int32),
         best=np.full(M, int(np.min(arrs.best)), np.int32),
@@ -651,6 +669,11 @@ class SegmentReport:
     # utils/phase_timing.publish_attribution); None on single-device runs
     per_worker: dict | None = None
     evals: int = 0               # cumulative bound evaluations (total)
+    # cumulative on-device search telemetry (telemetry.summarize dict:
+    # depth-bucketed popped/branched/pruned, bound histograms, pool
+    # high-water, steal flow, incumbent ring, pruning rate); None when
+    # the state carries no telemetry block (TTS_SEARCH_TELEMETRY off)
+    telemetry: dict | None = None
 
 
 def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
@@ -733,6 +756,15 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     # resumed states carry cumulative totals; throughput metrics must
     # count only THIS run's progress
     prev_tree = int(np.atleast_1d(_to_np(state.tree)).sum())
+    # search-telemetry deltas start from the INCOMING block (a resumed
+    # checkpoint's counts must not re-report as segment-1 activity).
+    # Width via .shape, never np.asarray: materializing a state leaf
+    # here raises on multihost runs (non-addressable shards — the
+    # hazard _to_np exists for)
+    tele_w = int(state.telemetry.shape[-1])
+    prev_tele = (tele.merge(np.atleast_2d(_to_np(state.telemetry)))
+                 if tele_w else None)
+    prev_evals = np.atleast_1d(_to_np(state.evals)).copy()
     last = (start_iters, -1, -1)
 
     def meta_now(seg):
@@ -789,11 +821,12 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                     lambda: _fetch_many(
                         (state.iters, state.tree, state.sol,
                          state.size, state.best, state.steals,
-                         state.overflow, state.evals)),
+                         state.overflow, state.evals)
+                        + ((state.telemetry,) if tele_w else ())),
                     segment_timeout_s, f"segment {seg} result fetch"),
                 "per-segment host fetch", retry_attempts, retry_base_s)
             (f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf,
-             f_evals) = fetched
+             f_evals) = fetched[:8]
             iters = int(f_iters.max())
             tree = int(f_tree.sum())
             sol = int(f_sol.sum())
@@ -807,11 +840,32 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                           "best": f_best.tolist(),
                           "iters": f_iters.tolist(),
                           "evals": f_evals.tolist()}
+        tele_summary = None
+        if tele_w:
+            # cumulative summary for the report + a per-segment DELTA
+            # event for the trace — the time series Perfetto counter
+            # tracks and tools/search_report.py render
+            merged = tele.merge(np.atleast_2d(fetched[8]))
+            tele_summary = tele.summarize(merged)
+            deltas = tele.delta_counts(merged, prev_tele)
+            evals_d = np.atleast_1d(f_evals) - prev_evals
+            ev = {}
+            if sizes.ndim:
+                ev = {"workers": int(sizes.shape[0]),
+                      "evals_pw": evals_d.tolist()}
+            tracelog.event(
+                "search.telemetry", segment=seg, **deltas,
+                pool=size,
+                pool_hw=tele_summary["pool_highwater"],
+                best=int(f_best.min()),
+                improvements=tele_summary["improvements"], **ev)
+            prev_tele = merged
+            prev_evals = np.atleast_1d(f_evals).copy()
         report = SegmentReport(
             segment=seg, iters=iters, tree=tree, sol=sol,
             best=int(f_best.min()), pool_size=size,
             elapsed=time.perf_counter() - t0, per_worker=per_worker,
-            evals=int(f_evals.sum()))
+            evals=int(f_evals.sum()), telemetry=tele_summary)
         reg = obs_metrics.default()
         reg.histogram("tts_segment_seconds",
                       "segment wall latency (execute+fetch)"

@@ -39,8 +39,14 @@ import numpy as np
 
 from ..ops import batched, pallas_expand, reference as ref
 from ..ops.batched import BoundTables
+from . import telemetry as tele
 
 I32_MAX = jnp.int32(2**31 - 1)
+
+# default telemetry leaf for keyword-constructed states (numpy, not jnp:
+# a module-import-time jnp array would force backend selection before
+# the CLI's --platform override can run)
+_NO_TELEMETRY = np.zeros(0, np.int64)
 
 
 def aux_dtype(p_times: np.ndarray | None) -> np.dtype:
@@ -100,6 +106,11 @@ class SearchState(NamedTuple):
     recv: jax.Array      # int64 nodes received via balance exchanges
     steals: jax.Array    # int64 balance rounds that received > 0 nodes
     overflow: jax.Array  # bool: capacity would have been exceeded
+    telemetry: jax.Array = _NO_TELEMETRY
+                         # int64 (telemetry.WIDTH,) on-device search
+                         # telemetry block (engine/telemetry.py layout);
+                         # width 0 when TTS_SEARCH_TELEMETRY is off —
+                         # the step then traces ZERO telemetry ops
 
 
 @functools.partial(jax.jit, donate_argnums=0)
@@ -113,11 +124,14 @@ def _seed_update(buf, rows):
 def init_state(jobs: int, capacity: int, init_ub: int | None,
                prmu0: np.ndarray | None = None,
                depth0: np.ndarray | None = None,
-               p_times: np.ndarray | None = None) -> SearchState:
+               p_times: np.ndarray | None = None,
+               telemetry: bool | None = None) -> SearchState:
     """Pool with the given seed nodes (default: the root at depth 0).
 
     `p_times` (PFSP) sizes and fills the per-node aux tables; without it the
     aux width is 0 (problems like N-Queens that carry no per-node tables).
+    `telemetry` compiles the on-device search-telemetry block into the
+    state (None: the TTS_SEARCH_TELEMETRY env flag, engine/telemetry.py).
     """
     if prmu0 is None:
         prmu0 = np.arange(jobs, dtype=np.int16)[None, :]
@@ -163,6 +177,9 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
         recv=jnp.int64(0),
         steals=jnp.int64(0),
         overflow=jnp.asarray(False),
+        telemetry=jnp.zeros(
+            (tele.WIDTH if (tele.enabled() if telemetry is None
+                            else telemetry) else 0,), jnp.int64),
     )
 
 
@@ -466,7 +483,7 @@ def _write_block(state: SearchState, children, child_depth, child_aux,
 
 
 def _commit(state: SearchState, prmu, depth, aux, n_push, best, sol, mask,
-            limit, start) -> SearchState:
+            limit, start, tele_delta=None) -> SearchState:
     """THE no-commit overflow contract, shared by every route: an
     overflowing step must NOT commit — advancing the cursor past the
     limit would lose subtrees (and make the overflow checkpoint
@@ -476,10 +493,19 @@ def _commit(state: SearchState, prmu, depth, aux, n_push, best, sol, mask,
     size <= limit invariant — `write_at` at the call sites uses this
     same `start + n_push > limit` condition) and the scalars here are
     guarded with selects, so grow-capacity + resume continues the
-    search losslessly."""
+    search losslessly.
+
+    `tele_delta` (telemetry.step_delta, or None when telemetry is off)
+    folds the step's masked telemetry counts in under the SAME guard,
+    plus the non-additive slots owned here: pool high-water max and the
+    incumbent-improvement ring (telemetry.commit)."""
     new_size = start + n_push
     overflow = new_size > limit
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
+    telem = state.telemetry
+    if tele_delta is not None:
+        telem = keep(tele.commit(telem, tele_delta, new_size, best,
+                                 state.best, state.iters), telem)
     return state._replace(
         prmu=prmu,
         depth=depth,
@@ -490,7 +516,8 @@ def _commit(state: SearchState, prmu, depth, aux, n_push, best, sol, mask,
         sol=keep(sol, state.sol),
         iters=state.iters + 1,
         evals=keep(state.evals + mask.sum(dtype=jnp.int64), state.evals),
-        overflow=state.overflow | overflow)
+        overflow=state.overflow | overflow,
+        telemetry=telem)
 
 
 def step(tables: BoundTables, lb_kind: int, chunk: int,
@@ -543,6 +570,21 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     ).reshape(1, N)
     mask = (slot_c >= depth_c) & valid_c
 
+    # --- search telemetry (STATIC Python branch: with the block off the
+    # traced program contains zero telemetry ops). Common inputs shared
+    # by every route: popped parents and evaluated non-leaf children by
+    # relative-depth bucket; each route supplies its branched buckets
+    # and bound histograms, pruned = evaluated - branched by exactness
+    # of the per-route accounting (tests pin the bucket sums).
+    TELE = state.telemetry.shape[-1] > 0
+    if TELE:
+        is_leaf_c = ((depth_c + 1) == J) & mask
+        child_b = tele.depth_bucket(depth_c.reshape(-1), J)
+        popped_b = tele.bucket_counts(
+            tele.depth_bucket(p_depth.reshape(-1), J), valid)
+        evalnl_b = tele.bucket_counts(
+            child_b, (mask & ~is_leaf_c).reshape(-1))
+
     P = int(tables.ma0.shape[0]) if lb_kind == 2 else 0
     KH = batched.PAIR_PREFILTER
     if route == "dense":
@@ -569,6 +611,11 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
         push = (mask & ~is_leaf & (lb2b.reshape(1, -1) < best)).reshape(-1)
         n_push = push.sum(dtype=jnp.int32)
+        if TELE:
+            branched_b = tele.bucket_counts(child_b, push)
+            hist_surv = tele.bound_hist(lb2b, push, best)
+            hist_pruned = tele.bound_hist(
+                lb2b, (mask & ~is_leaf).reshape(-1) & ~push, best)
 
         # Compaction rebuilds survivors from the CHUNK-WIDE parents
         # (_compact_from_parents) rather than gathering the dense
@@ -606,6 +653,12 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
         cand = (mask & ~is_leaf & (lb1b < best)).reshape(-1)
         ncand = cand.sum(dtype=jnp.int32)
+        if TELE:
+            # children the LB1 prefilter pruned, binned at the bound
+            # that pruned them (the tail sweep's prunes bin at their
+            # exact LB2 inside the pipeline)
+            hist_lb1_pruned = tele.bound_hist(
+                lb1b, (mask & ~is_leaf).reshape(-1) & ~cand, best)
 
         perm1 = _partition(cand)
         SW = pallas_expand.sched_words(J)
@@ -716,6 +769,8 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                     # whole LB2.
                     lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
                     live = ncand
+                    if TELE:
+                        head_hp = jnp.zeros(tele.BOUND_BINS, jnp.int64)
                 else:
                     # Strong-pair prefilter (the reference's
                     # unimplemented LB2_LEARN, c_bound_johnson.h:29):
@@ -731,6 +786,13 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                     lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
                     keep = ((jnp.arange(W_) < ncand)
                             & (lb2h.reshape(-1) < best))
+                    if TELE:
+                        # pruned by the strong-pair head sweep: binned
+                        # at the partial bound that pruned them (a
+                        # sound lower bound — partial max <= LB2)
+                        head_hp = tele.bound_hist(
+                            lb2h, (jnp.arange(W_) < ncand) & ~keep,
+                            best)
                     nkeep = keep.sum(dtype=jnp.int32)
                     permh = _partition_prefix(keep, ncand, N,
                                               two_phase=True, cap=W_)
@@ -770,6 +832,18 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                 push = ((jnp.arange(W_) < live)
                         & (lb2b.reshape(-1) < best))
                 n_push = push.sum(dtype=jnp.int32)
+                if TELE:
+                    # branched buckets + bound histograms, computed
+                    # while caux still aligns column-for-column with
+                    # push/lb2b (the final compaction reorders)
+                    pb = tele.depth_bucket(
+                        caux[M].astype(jnp.int32).reshape(-1) - 1, J)
+                    live_m = jnp.arange(W_) < live
+                    tele_tail = jnp.concatenate([
+                        tele.bucket_counts(pb, push),
+                        head_hp + tele.bound_hist(
+                            lb2b, live_m & ~push, best),
+                        tele.bound_hist(lb2b, push, best)])
                 if debug_tap:
                     # smuggle intermediates out via the balance counters
                     lv = jnp.arange(W_) < live
@@ -797,7 +871,10 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                 prmu, depth, aux = _write_block(
                     state, children, child_depth, child_aux, start,
                     n_push, limit)
-                return prmu, depth, aux, n_push, hsum, tsum
+                out = (prmu, depth, aux, n_push, hsum, tsum)
+                if TELE:
+                    out += (tele_tail,)
+                return out
             return f
 
         # N/4 cap: ncand hovers just under it on the 20x20 class
@@ -812,22 +889,31 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         W = max(N // 4, 128)
         W2 = 3 * N // 8
         if W >= N:  # toy shapes: no narrow branch exists
-            prmu, depth, aux, n_push, hsum, tsum = tail_pipeline(N)(0)
+            outs = tail_pipeline(N)(0)
         elif W2 <= W or W2 >= N or W2 % 128 != 0:
-            prmu, depth, aux, n_push, hsum, tsum = jax.lax.cond(
+            outs = jax.lax.cond(
                 ncand <= W, tail_pipeline(W), tail_pipeline(N), 0)
         else:
             sel = ((ncand > W).astype(jnp.int32)
                    + (ncand > W2).astype(jnp.int32))
-            prmu, depth, aux, n_push, hsum, tsum = jax.lax.switch(
+            outs = jax.lax.switch(
                 sel, [tail_pipeline(W), tail_pipeline(W2),
                       tail_pipeline(N)], 0)
+        prmu, depth, aux, n_push, hsum, tsum = outs[:6]
 
         if debug_tap:
             state = state._replace(sent=hsum, recv=tsum,
                                    steals=n_push.astype(jnp.int64))
+        delta = None
+        if TELE:
+            DB, BB = tele.DEPTH_BUCKETS, tele.BOUND_BINS
+            branched_b = outs[6][:DB]
+            delta = tele.step_delta(
+                popped_b, branched_b, evalnl_b - branched_b,
+                hist_lb1_pruned + outs[6][DB:DB + BB],
+                outs[6][DB + BB:])
         return _commit(state, prmu, depth, aux, n_push, best, sol, mask,
-                       limit, start)
+                       limit, start, tele_delta=delta)
     else:
         # --- bounds of the dense child grid (Pallas on TPU; the children
         # themselves are never materialized — survivors are rebuilt from
@@ -845,6 +931,11 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         # --- prune + push surviving internal children
         push = (mask & ~is_leaf & (bounds < best)).reshape(-1)
         n_push = push.sum(dtype=jnp.int32)
+        if TELE:
+            branched_b = tele.bucket_counts(child_b, push)
+            hist_surv = tele.bound_hist(bounds, push, best)
+            hist_pruned = tele.bound_hist(
+                bounds, (mask & ~is_leaf).reshape(-1) & ~push, best)
 
         # Compaction: stable-partition the surviving column indices to
         # the front (_partition), rebuild those children from their
@@ -864,8 +955,12 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
         limit = row_limit(capacity, B, J)
     prmu, depth, aux = _write_block(state, children, child_depth,
                                     child_aux, start, n_push, limit)
+    delta = (tele.step_delta(popped_b, branched_b,
+                             evalnl_b - branched_b,
+                             hist_pruned, hist_surv)
+             if TELE else None)
     return _commit(state, prmu, depth, aux, n_push, best, sol, mask,
-                   limit, start)
+                   limit, start, tele_delta=delta)
 
 
 @functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "tile"))

@@ -41,6 +41,7 @@ from ..ops.batched import BoundTables
 from ..parallel import balance as bal
 from ..parallel.mesh import WORKER_AXIS, shard_map, worker_mesh
 from . import sequential as seq
+from . import telemetry as tele
 from .device import SearchState, row_limit as device_row_limit, step
 
 AX = WORKER_AXIS
@@ -261,7 +262,15 @@ def _balance_round(s: SearchState, transfer_cap: int,
     zero = jnp.zeros((), base.dtype)
     write_at = jnp.where(do_flow, base, jnp.asarray(limit, base.dtype))
     keep = lambda new, old: jnp.where(do_flow, new, old)  # noqa: E731
+    telem = s.telemetry
+    if telem.shape[-1] > 0:
+        # steal-flow telemetry mirrors the sent/recv counters below,
+        # under the same committed-round guard
+        t = telem.at[tele.O_STEAL_SENT].add(total_out.astype(jnp.int64))
+        t = t.at[tele.O_STEAL_RECV].add(n_push.astype(jnp.int64))
+        telem = keep(t, telem)
     return s._replace(
+        telemetry=telem,
         prmu=jax.lax.dynamic_update_slice(s.prmu, recv_prmu,
                                           (zero, write_at)),
         depth=jax.lax.dynamic_update_slice(s.depth, recv_depth,
@@ -333,7 +342,7 @@ def build_dist_loop(mesh, tables, make_local_step,
 
 class DistResult:
     def __init__(self, explored_tree, explored_sol, best, per_device,
-                 warmup_tree, warmup_sol, complete=True):
+                 warmup_tree, warmup_sol, complete=True, telemetry=None):
         self.explored_tree = explored_tree
         self.explored_sol = explored_sol
         self.best = best
@@ -341,6 +350,8 @@ class DistResult:
         self.warmup_tree = warmup_tree
         self.warmup_sol = warmup_sol
         self.complete = complete            # all pools drained
+        self.telemetry = telemetry          # telemetry.summarize dict
+                                            # (None when the block is off)
 
 
 def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
@@ -376,6 +387,7 @@ def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
         jnp.zeros(n_dev, jnp.int64), jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, jnp.int64),
         jnp.zeros(n_dev, bool),
+        jnp.zeros((n_dev, tele.enabled_width()), jnp.int64),
     )
 
 
@@ -770,10 +782,14 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         balance_rounds=int(iters_dev.max()) // max(balance_period, 1),
         steals=int(steals_dev.sum()),
         complete=int(sizes.sum()) == 0)
+    telemetry = None
+    if out.telemetry.shape[-1] > 0:
+        telemetry = tele.summarize(_fetch(out.telemetry))
     return DistResult(
         explored_tree=int(tree_dev.sum()) + fr.tree + h_tree,
         explored_sol=int(sol_dev.sum()) + fr.sol + h_sol,
         best=best,
+        telemetry=telemetry,
         per_device={
             "tree": tree_dev, "sol": sol_dev,
             "iters": iters_dev,

@@ -26,6 +26,7 @@ import numpy as np
 from ..ops import nqueens_ops
 from ..parallel.mesh import worker_mesh
 from . import distributed as dist
+from . import telemetry as tele
 from .device import SearchState, init_state, make_children, row_limit
 
 I32_MAX = jnp.int32(2**31 - 1)
@@ -77,8 +78,24 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState,
     overflow = new_size > limit
     write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
     keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
-    evals = state.evals + ((jnp.arange(N)[None, :] >= depth[:, None])
-                           & valid[:, None]).sum(dtype=jnp.int64)
+    evaluated = ((jnp.arange(N)[None, :] >= depth[:, None])
+                 & valid[:, None])                          # (B, N)
+    evals = state.evals + evaluated.sum(dtype=jnp.int64)
+    telem = state.telemetry
+    if telem.shape[-1] > 0:
+        # search telemetry, mirroring device.step: popped/branched/
+        # pruned by relative-depth bucket ("pruned" = unsafe children —
+        # N-Queens has no bound, so the histograms and the incumbent
+        # ring stay zero; state.best never improves, telemetry.commit's
+        # ring write is a no-op select)
+        pb = tele.depth_bucket(depth, N)                    # (B,)
+        pbc = jnp.broadcast_to(pb[:, None], (B, N)).reshape(-1)
+        delta = tele.step_delta(
+            tele.bucket_counts(pb, valid),
+            tele.bucket_counts(pbc, flat_push),
+            tele.bucket_counts(pbc, evaluated.reshape(-1) & ~flat_push))
+        telem = keep(tele.commit(telem, delta, new_size, state.best,
+                                 state.best, state.iters), telem)
     return state._replace(
         prmu=jax.lax.dynamic_update_slice(state.prmu, children,
                                           (zero, write_at)),
@@ -90,6 +107,7 @@ def nq_step(n: int, g: int, chunk: int, state: SearchState,
         iters=state.iters + 1,
         evals=keep(evals, state.evals),
         overflow=state.overflow | overflow,
+        telemetry=telem,
     )
 
 

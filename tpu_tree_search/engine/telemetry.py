@@ -1,0 +1,324 @@
+"""On-device search telemetry for the compiled loop.
+
+The reference prints per-pool search statistics (nodes explored, pruned,
+stolen — the boxplot bundle of common/util.h) because B&B performance is
+dominated by pruning quality and load balance, not raw FLOPs. The flight
+recorder (obs/) covers the host-side lifecycle, but the `lax.while_loop`
+inside `jit` — where 99% of the wall time goes — was a black box between
+segment boundaries. This module defines the fixed-shape telemetry block
+the compiled pop->bound->prune->branch cycle updates with masked adds:
+
+- per-worker popped / branched / pruned counts bucketed by RELATIVE
+  depth (bucket k covers depths [k*J/DB, (k+1)*J/DB) — buckets are
+  depth fractions, so the block's width is problem-independent);
+- a bound-value histogram of pruned vs. surviving children, binned by
+  the relative gap |bound - incumbent| / incumbent (bin BB-1 collects
+  gaps >= 100%);
+- pool-occupancy high-water mark (max live rows ever committed);
+- work-steal sent/recv node flow (the balance exchange's view);
+- an incumbent-improvement ring of the last RING (iteration, value)
+  pairs, plus the total improvement count.
+
+The block is ONE flat int64 vector (`WIDTH` slots, layout below) so it
+rides `SearchState` exactly like the existing counters: through the
+while_loop carry, the shard_map specs, checkpoint save/load and the
+elastic reshard, with zero bespoke plumbing.
+
+Compiled in behind a STATIC flag: `TTS_SEARCH_TELEMETRY=1` (or CLI
+`--search-telemetry`) makes `init_state` allocate the `WIDTH`-slot
+block; off (the default) allocates a zero-width vector and every update
+site is a Python-level `if state.telemetry.shape[-1]` branch, so the
+traced program contains NO telemetry ops — the off-mode HLO is the
+pre-telemetry program with one empty tuple element. Telemetry is
+OBSERVATION-ONLY either way: node/sol/evals/best are bit-identical with
+the flag on or off (tests/test_telemetry.py pins this on the golden
+instances).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+# ---------------------------------------------------------------- layout
+
+DEPTH_BUCKETS = 8      # relative-depth buckets for popped/branched/pruned
+BOUND_BINS = 8         # relative-gap bins for the bound-value histogram
+RING = 8               # incumbent-improvement (iteration, value) pairs
+
+O_POPPED = 0
+O_BRANCHED = O_POPPED + DEPTH_BUCKETS
+O_PRUNED = O_BRANCHED + DEPTH_BUCKETS
+O_HIST_PRUNED = O_PRUNED + DEPTH_BUCKETS
+O_HIST_SURV = O_HIST_PRUNED + BOUND_BINS
+O_POOL_HW = O_HIST_SURV + BOUND_BINS     # max, not add
+O_STEAL_SENT = O_POOL_HW + 1
+O_STEAL_RECV = O_STEAL_SENT + 1
+O_IMPROVED = O_STEAL_RECV + 1            # ring write cursor / total count
+O_RING = O_IMPROVED + 1                  # RING x (iteration, value)
+WIDTH = O_RING + 2 * RING
+
+# every slot below O_POOL_HW is a pure count: element-wise summable
+# across workers/reshards; the tail needs merge()'s special handling
+_COUNT_SLOTS = O_POOL_HW
+
+ENV_FLAG = "TTS_SEARCH_TELEMETRY"
+
+
+def enabled() -> bool:
+    """The static compile-in flag (TTS_SEARCH_TELEMETRY / CLI
+    --search-telemetry). Read at state-INIT time: a state keeps the
+    width it was born (or checkpointed) with."""
+    return os.environ.get(ENV_FLAG, "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def enabled_width() -> int:
+    return WIDTH if enabled() else 0
+
+
+# ----------------------------------------------------- traced update ops
+# (imported lazily by the engine's step functions; kept here so the
+# layout and the ops that write it cannot drift apart)
+
+def depth_bucket(depth, jobs: int):
+    """Relative-depth bucket index for int32 depth values in [0, jobs]
+    (a popped complete board at depth == jobs clips into the last
+    bucket)."""
+    import jax.numpy as jnp
+    b = depth * DEPTH_BUCKETS // max(jobs, 1)
+    return jnp.clip(b, 0, DEPTH_BUCKETS - 1)
+
+
+def bucket_counts(bucket_idx, mask):
+    """(DEPTH_BUCKETS,) int64 masked counts — DEPTH_BUCKETS masked
+    reductions, not a scatter (row scatters serialize on TPU; 8 vector
+    reductions are noise next to the bound kernels)."""
+    import jax.numpy as jnp
+    return jnp.stack([
+        jnp.sum(mask & (bucket_idx == k), dtype=jnp.int64)
+        for k in range(DEPTH_BUCKETS)])
+
+
+def bound_hist(bounds, mask, best):
+    """(BOUND_BINS,) int64 histogram of the relative gap
+    |bound - best| / best; the last bin collects gaps >= 100%. With no
+    incumbent yet (best = INT_MAX) the gap saturates, so every
+    pre-incumbent child lands in that last far-gap bin — the inner bins
+    only become informative once a real incumbent exists, which is when
+    pruning starts mattering (ub=inf runs: read the last bin as
+    "far from the incumbent OR before one existed")."""
+    import jax.numpy as jnp
+    b = bounds.reshape(-1).astype(jnp.int64)
+    ref = jnp.maximum(best.astype(jnp.int64), 1)
+    gap = jnp.abs(b - ref)
+    bins = jnp.minimum(gap * BOUND_BINS // ref, BOUND_BINS - 1)
+    m = mask.reshape(-1)
+    return jnp.stack([jnp.sum(m & (bins == k), dtype=jnp.int64)
+                      for k in range(BOUND_BINS)])
+
+
+def step_delta(popped_b, branched_b, pruned_b,
+               hist_pruned=None, hist_surv=None):
+    """Assemble one step's (WIDTH,) additive delta from the bucketed
+    counts; the non-additive tail (high-water, steal flow, ring) stays
+    zero — device._commit / the balance round own those slots."""
+    import jax.numpy as jnp
+    z = jnp.zeros(BOUND_BINS, jnp.int64)
+    return jnp.concatenate([
+        popped_b, branched_b, pruned_b,
+        hist_pruned if hist_pruned is not None else z,
+        hist_surv if hist_surv is not None else z,
+        jnp.zeros(WIDTH - O_POOL_HW, jnp.int64)])
+
+
+def commit(tele, delta, new_size, best, prev_best, iters):
+    """Fold one step's delta into the telemetry vector: add the counts,
+    max the pool high-water mark, and record an incumbent improvement
+    (iteration, value) in the ring when `best` beat `prev_best`. The
+    caller guards the result with its overflow no-commit select."""
+    import jax
+    import jax.numpy as jnp
+    t = tele + delta
+    t = t.at[O_POOL_HW].max(new_size.astype(jnp.int64))
+    improved = (best < prev_best).astype(jnp.int64)
+    slot = (t[O_IMPROVED] % RING).astype(jnp.int32)
+    pair = jnp.stack([(iters + 1).astype(jnp.int64),
+                      best.astype(jnp.int64)])
+    cur = jax.lax.dynamic_slice(t, (O_RING + 2 * slot,), (2,))
+    t = jax.lax.dynamic_update_slice(
+        t, jnp.where(improved > 0, pair, cur), (O_RING + 2 * slot,))
+    return t.at[O_IMPROVED].add(improved)
+
+
+# -------------------------------------------------------- host-side views
+
+def _ring_pairs(vec: np.ndarray) -> list[list[int]]:
+    """Decode the improvement ring: written (iteration, value) pairs in
+    iteration order (value 0 marks an unwritten slot — makespans and
+    bound values are strictly positive)."""
+    pairs = [(int(vec[O_RING + 2 * k]), int(vec[O_RING + 2 * k + 1]))
+             for k in range(RING)]
+    pairs = [p for p in pairs if p[1] > 0]
+    pairs.sort(key=lambda p: p[0])
+    return [list(p) for p in pairs]
+
+
+def merge(stacked: np.ndarray) -> np.ndarray:
+    """Fold a (D, WIDTH) per-worker block into one (WIDTH,) vector —
+    the checkpoint/elastic-reshard summation rule: counts sum, the pool
+    high-water is the max, and the incumbent ring is rebuilt by
+    replaying every worker's recorded improvements in iteration order
+    and keeping the strictly-improving tail (per-worker attribution
+    does not survive a topology change by definition — the totals do).
+    """
+    stacked = np.atleast_2d(np.asarray(stacked, np.int64))
+    if stacked.shape[-1] == 0:
+        return np.zeros(0, np.int64)
+    out = stacked.sum(axis=0)
+    out[O_POOL_HW] = stacked[:, O_POOL_HW].max()
+    pairs: list[tuple[int, int]] = []
+    for d in range(stacked.shape[0]):
+        pairs.extend((p[0], p[1]) for p in _ring_pairs(stacked[d]))
+    pairs.sort(key=lambda p: p[0])
+    replay: list[tuple[int, int]] = []
+    for it, val in pairs:
+        if not replay or val < replay[-1][1]:
+            replay.append((it, val))
+    replay = replay[-RING:]
+    out[O_RING:] = 0
+    # Slot placement must keep commit()'s write cursor consistent: the
+    # cursor is O_IMPROVED % RING (O_IMPROVED stays the summed total),
+    # so the replayed pairs are laid out ENDING at slot (total-1) %
+    # RING — the next on-device improvement then lands right after the
+    # newest kept pair instead of clobbering it while empty slots
+    # remain. Decoding is slot-order-independent (_ring_pairs sorts by
+    # iteration), so only the overwrite order depends on this.
+    start = (int(out[O_IMPROVED]) - len(replay)) % RING
+    for k, (it, val) in enumerate(replay):
+        slot = (start + k) % RING
+        out[O_RING + 2 * slot] = it
+        out[O_RING + 2 * slot + 1] = val
+    return out
+
+
+def summarize(arr) -> dict | None:
+    """JSON-safe summary of a telemetry block ((WIDTH,) or (D, WIDTH));
+    None for a zero-width (telemetry-off) block. The schema the
+    SegmentReport, the service's labeled gauges, bench.py and the
+    campaign rows all share."""
+    arr = np.asarray(arr, np.int64)
+    if arr.shape[-1] == 0:
+        return None
+    m = merge(np.atleast_2d(arr))
+    popped = m[O_POPPED:O_POPPED + DEPTH_BUCKETS]
+    branched = m[O_BRANCHED:O_BRANCHED + DEPTH_BUCKETS]
+    pruned = m[O_PRUNED:O_PRUNED + DEPTH_BUCKETS]
+    evaluated = int(branched.sum() + pruned.sum())
+    return {
+        "popped": popped.tolist(),
+        "branched": branched.tolist(),
+        "pruned": pruned.tolist(),
+        "bound_hist_pruned":
+            m[O_HIST_PRUNED:O_HIST_PRUNED + BOUND_BINS].tolist(),
+        "bound_hist_surviving":
+            m[O_HIST_SURV:O_HIST_SURV + BOUND_BINS].tolist(),
+        "pool_highwater": int(m[O_POOL_HW]),
+        "steal_sent": int(m[O_STEAL_SENT]),
+        "steal_recv": int(m[O_STEAL_RECV]),
+        "improvements": int(m[O_IMPROVED]),
+        "incumbent_ring": _ring_pairs(m),
+        "pruning_rate": round(float(pruned.sum()) / max(evaluated, 1), 6),
+        "frontier_depth": frontier_depth(popped),
+    }
+
+
+def delta_counts(now_vec, prev_vec) -> dict:
+    """Window-scoped counts between two merged (WIDTH,) snapshots —
+    THE delta reading, shared by run_segmented's per-segment trace
+    events and bench.py's timed-window row so neither re-derives the
+    layout offsets by hand. Only the additive slots are read; the
+    high-water mark and the ring have no window-scoped meaning."""
+    d = (np.asarray(now_vec, np.int64)
+         - np.asarray(prev_vec, np.int64))
+    popped = d[O_POPPED:O_POPPED + DEPTH_BUCKETS]
+    branched = int(d[O_BRANCHED:O_BRANCHED + DEPTH_BUCKETS].sum())
+    pruned = int(d[O_PRUNED:O_PRUNED + DEPTH_BUCKETS].sum())
+    return {
+        "popped": int(popped.sum()),
+        "branched": branched,
+        "pruned": pruned,
+        "pruning_rate": round(pruned / max(branched + pruned, 1), 6),
+        "frontier_depth": frontier_depth(popped),
+        "steal_sent": int(d[O_STEAL_SENT]),
+        "steal_recv": int(d[O_STEAL_RECV]),
+    }
+
+
+def frontier_depth(popped_buckets) -> float:
+    """Mean relative depth of the popped frontier in [0, 1] (0 = root,
+    1 = leaves): the weighted mean bucket midpoint of the popped-node
+    depth distribution."""
+    popped = np.asarray(popped_buckets, np.float64)
+    n = popped.sum()
+    if n <= 0:
+        return 0.0
+    mids = (np.arange(DEPTH_BUCKETS) + 0.5) / DEPTH_BUCKETS
+    return round(float((popped * mids).sum() / n), 6)
+
+
+# --------------------------------------------------- metrics registry view
+
+# every labeled series publish() writes — the service retires these by
+# request label at the terminal transition (the cardinality valve, same
+# rule as tts_phase_seconds)
+SERIES = (
+    "tts_search_popped", "tts_search_branched", "tts_search_pruned",
+    "tts_search_bound_gap", "tts_search_pruning_rate",
+    "tts_search_frontier_depth", "tts_search_pool_highwater",
+    "tts_search_steal_sent", "tts_search_steal_recv",
+    "tts_search_improvements",
+)
+
+
+def publish(summary: dict, registry, **labels) -> None:
+    """Write a summarize() dict into an obs/metrics Registry as labeled
+    gauges (gauges, not counters: values are SET from cumulative
+    snapshots, and a resumed checkpoint must not double-count). The
+    caller supplies identity labels (request=..., tag=...) — the
+    per-request scrape surface the ISSUE's pruning-efficiency story
+    needs without opening the trace."""
+    if not summary:
+        return
+    g = registry.gauge
+    for name, key in (("tts_search_popped", "popped"),
+                      ("tts_search_branched", "branched"),
+                      ("tts_search_pruned", "pruned")):
+        m = g(name, f"{key} nodes by relative-depth bucket (cumulative)")
+        for k, v in enumerate(summary[key]):
+            m.set(v, bucket=k, **labels)
+    m = g("tts_search_bound_gap",
+          "child bound-value histogram by relative gap to the incumbent")
+    for k, v in enumerate(summary["bound_hist_pruned"]):
+        m.set(v, outcome="pruned", bin=k, **labels)
+    for k, v in enumerate(summary["bound_hist_surviving"]):
+        m.set(v, outcome="surviving", bin=k, **labels)
+    g("tts_search_pruning_rate",
+      "pruned / evaluated non-leaf children (cumulative)").set(
+        summary["pruning_rate"], **labels)
+    g("tts_search_frontier_depth",
+      "mean relative depth of popped nodes (0=root, 1=leaves)").set(
+        summary["frontier_depth"], **labels)
+    g("tts_search_pool_highwater",
+      "pool-occupancy high-water mark (live rows)").set(
+        summary["pool_highwater"], **labels)
+    g("tts_search_steal_sent",
+      "nodes donated via balance exchanges").set(
+        summary["steal_sent"], **labels)
+    g("tts_search_steal_recv",
+      "nodes received via balance exchanges").set(
+        summary["steal_recv"], **labels)
+    g("tts_search_improvements",
+      "incumbent improvements recorded on-device").set(
+        summary["improvements"], **labels)

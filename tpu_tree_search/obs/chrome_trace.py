@@ -46,11 +46,24 @@ def _track_of(rec: dict) -> str:
     return str(rec.get("thread", "main"))
 
 
+# per-segment search-telemetry events (engine/checkpoint.run_segmented)
+# additionally render as Perfetto COUNTER tracks — one lane per counter
+# per submesh, next to the span lanes: the pruning-rate / frontier-depth
+# / pool-fill time series the compiled loop was a black box for
+COUNTER_EVENT = "search.telemetry"
+COUNTER_KEYS = ("pruning_rate", "frontier_depth", "pool",
+                "steal_sent", "steal_recv")
+
+
 def to_chrome(records: list[dict]) -> dict:
     """Convert tracelog records (ring snapshot or JSONL lines) to a
     Chrome trace dict: spans -> complete ``X`` events, point events ->
     instant ``i`` events, plus thread-name metadata so the lanes are
-    labeled. Timestamps are the records' monotonic seconds as µs."""
+    labeled. Timestamps are the records' monotonic seconds as µs.
+    ``search.telemetry`` events additionally emit ``C`` counter samples
+    (COUNTER_KEYS), so Perfetto draws per-submesh counter tracks; the
+    instant event is kept too — its args carry the full per-segment
+    record for tools/search_report.py's Chrome-format path."""
     tids: dict[str, int] = {}
     events = []
     for rec in records:
@@ -70,6 +83,14 @@ def to_chrome(records: list[dict]) -> dict:
                                         3)})
         else:
             events.append({**base, "ph": "i", "s": "t"})
+            if rec.get("name") == COUNTER_EVENT:
+                for key in COUNTER_KEYS:
+                    if key in rec:
+                        events.append({
+                            "ph": "C", "pid": 0, "tid": tid,
+                            "name": f"{key} ({track})",
+                            "ts": base["ts"],
+                            "args": {key: rec[key]}})
     meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": tid,
              "args": {"name": track}} for track, tid in tids.items()]
     # sorted lanes first, then events in timestamp order: Perfetto does

@@ -1,10 +1,9 @@
-"""HTTP health/metrics front-end for the search service.
+"""HTTP front-end for the search service: health/metrics reads AND the
+submit/cancel write path.
 
-The ROADMAP service follow-on ("expose the JSON status snapshot as a
-health/metrics endpoint"), on stdlib ``http.server`` — no new
+The ROADMAP service follow-on, on stdlib ``http.server`` — no new
 dependencies, threaded so a slow scrape never blocks another. Sits in
-FRONT of a running :class:`~tpu_tree_search.service.SearchServer` (the
-file spool stays the submit path; this is the read path):
+FRONT of a running :class:`~tpu_tree_search.service.SearchServer`:
 
 - ``GET /healthz``  — liveness: ``200 {"status": "ok"}`` while serving,
   ``503`` once the server is closing (load balancers drain on it);
@@ -15,7 +14,16 @@ file spool stays the submit path; this is the read path):
 - ``GET /status``   — the full JSON status snapshot
   (``SearchServer.status_snapshot()``);
 - ``GET /trace``    — the flight recorder's ring buffer as Chrome
-  trace-event JSON (save it, open in Perfetto).
+  trace-event JSON (save it, open in Perfetto);
+- ``POST /submit``  — admit a request; the JSON body uses the SAME
+  payload schema as the file spool (service/spool.py: ``inst`` or
+  ``p_times``, ``lb``, ``ub``, ``priority``, ``deadline_s``, ``tag``,
+  ...). Returns ``200 {"request_id": ...}``; a full queue or closing
+  server answers ``429``/``503`` with the reason, a malformed payload
+  ``400`` — the spool is no longer the only way in;
+- ``POST /cancel``  — body ``{"request_id": ...}``; returns
+  ``200 {"cancelled": bool}`` (false = already terminal), ``404`` for
+  an unknown id.
 
 Usage::
 
@@ -52,20 +60,48 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/")
+    POST_PATHS = ("/submit", "/cancel")
+
     def do_GET(self) -> None:  # noqa: N802 — http.server API
+        obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
+        self._route({"/healthz": obs.healthz, "/metrics": obs.metrics,
+                     "/status": obs.status, "/trace": obs.trace,
+                     "/": obs.index}, other_method=self.POST_PATHS)
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
+        try:
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n) if n else b""
+        except (OSError, ValueError):
+            body = b""
+        self._route({"/submit": lambda: obs.submit(body),
+                     "/cancel": lambda: obs.cancel(body)},
+                    other_method=self.GET_PATHS)
+
+    def _route(self, handlers: dict, other_method: tuple = ()) -> None:
         obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
         path = self.path.split("?", 1)[0].rstrip("/") or "/"
         try:
-            handler = {"/healthz": obs.healthz, "/metrics": obs.metrics,
-                       "/status": obs.status, "/trace": obs.trace,
-                       "/": obs.index}.get(path)
+            handler = handlers.get(path)
             if handler is None:
+                if path in other_method:
+                    # known endpoint, wrong verb: 405, not a
+                    # self-contradictory 404 that lists the path it
+                    # just claimed not to know
+                    obs.http_requests.inc(path="<405>")
+                    want = ("GET" if path in self.GET_PATHS else "POST")
+                    self._send(405, json.dumps(
+                        {"error": f"{path} requires {want}"}) + "\n",
+                        "application/json")
+                    return
                 obs.http_requests.inc(path="<404>")
                 self._send(404, json.dumps(
                     {"error": f"unknown path {path!r}",
                      "endpoints": ["/healthz", "/metrics", "/status",
-                                   "/trace"]}) + "\n",
-                    "application/json")
+                                   "/trace", "/submit", "/cancel"]})
+                    + "\n", "application/json")
                 return
             obs.http_requests.inc(path=path)
             code, body, ctype = handler()
@@ -131,8 +167,9 @@ class ObsHttpd:
     def index(self):
         return 200, json.dumps(
             {"service": "tpu_tree_search",
-             "endpoints": ["/healthz", "/metrics", "/status",
-                           "/trace"]}) + "\n", "application/json"
+             "endpoints": ["/healthz", "/metrics", "/status", "/trace",
+                           "/submit", "/cancel"]}) + "\n", \
+            "application/json"
 
     def healthz(self):
         if self.server is None:
@@ -158,6 +195,65 @@ class ObsHttpd:
         log = self.trace_log or tracelog.get()
         body = json.dumps(chrome_trace.to_chrome(log.records()))
         return 200, body, "application/json"
+
+    # ------------------------------------------------------- write path
+
+    @staticmethod
+    def _json_body(body: bytes) -> dict:
+        payload = json.loads(body.decode() if body else "")
+        if not isinstance(payload, dict):
+            raise ValueError("payload must be a JSON object")
+        return payload
+
+    def submit(self, body: bytes):
+        """POST /submit: admit one request (spool payload schema)."""
+        if self.server is None:
+            return 503, json.dumps(
+                {"error": "no search server attached"}) + "\n", \
+                "application/json"
+        # spool's payload parser is THE request schema — one wire format
+        # whether a request arrives as a file or an HTTP body
+        from ..service.queueing import AdmissionError
+        from ..service.spool import request_from_payload
+        try:
+            payload = self._json_body(body)
+            request = request_from_payload(payload)
+        except (ValueError, KeyError, TypeError,
+                json.JSONDecodeError) as e:
+            return 400, json.dumps({"error": str(e)}) + "\n", \
+                "application/json"
+        try:
+            rid = self.server.submit(request)
+        except AdmissionError as e:
+            code = 503 if self._closing() else 429
+            return code, json.dumps({"error": str(e)}) + "\n", \
+                "application/json"
+        return 200, json.dumps(
+            {"request_id": rid, "state": "QUEUED"}) + "\n", \
+            "application/json"
+
+    def cancel(self, body: bytes):
+        """POST /cancel: cancel a queued/running request by id."""
+        if self.server is None:
+            return 503, json.dumps(
+                {"error": "no search server attached"}) + "\n", \
+                "application/json"
+        try:
+            rid = self._json_body(body)["request_id"]
+        except (ValueError, KeyError, json.JSONDecodeError) as e:
+            return 400, json.dumps(
+                {"error": f"body must be "
+                          f'{{"request_id": ...}}: {e}'}) + "\n", \
+                "application/json"
+        try:
+            cancelled = self.server.cancel(rid)
+        except KeyError:
+            return 404, json.dumps(
+                {"error": f"unknown request id {rid!r}"}) + "\n", \
+                "application/json"
+        return 200, json.dumps(
+            {"request_id": rid, "cancelled": bool(cancelled)}) + "\n", \
+            "application/json"
 
 
 def start_http_server(server=None, host: str = "127.0.0.1",

@@ -261,6 +261,15 @@ class Registry:
         with self._lock:
             return [self._metrics[k] for k in sorted(self._metrics)]
 
+    def remove_matching(self, name: str, **labels) -> int:
+        """Drop `name`'s series whose labels include these pairs;
+        returns how many were dropped (0 when the metric was never
+        created — unlike `reg.gauge(name).remove_matching(...)`, this
+        does not materialize an empty metric just to clean it)."""
+        with self._lock:
+            m = self._metrics.get(name)
+        return m.remove_matching(**labels) if m is not None else 0
+
     # -------------------------------------------------------- exposition
 
     def to_json(self) -> dict:

@@ -431,8 +431,13 @@ class SearchServer:
             # live-attribution series are per-request labeled; retire
             # them with the request or a long-serving process grows
             # gauge cardinality without bound
-            self.metrics.gauge("tts_phase_seconds").remove_matching(
-                request=rec.id)
+            self.metrics.remove_matching("tts_phase_seconds",
+                                         request=rec.id)
+        # same cardinality valve for the search-telemetry series
+        # (engine/telemetry.publish, fed by the heartbeat below)
+        from ..engine import telemetry as tele_mod
+        for name in tele_mod.SERIES:
+            self.metrics.remove_matching(name, request=rec.id)
         tracelog.event(f"request.{key}", request_id=rec.id,
                        spent_s=round(rec.spent_s(), 3),
                        dispatches=rec.dispatches,
@@ -572,6 +577,21 @@ class SearchServer:
                 "tree": rep.tree, "sol": rep.sol, "best": rep.best,
                 "pool": rep.pool_size,
                 "elapsed_s": round(rep.elapsed, 3)}
+            if rep.telemetry is not None:
+                # on-device search telemetry (TTS_SEARCH_TELEMETRY):
+                # per-request labeled gauges in the server registry —
+                # pruning efficiency scrapeable from /metrics without
+                # opening the trace (series retire with the request,
+                # see _finalize) — and the compact rates in the
+                # progress snapshot
+                from ..engine import telemetry as tele_mod
+                tele_mod.publish(rep.telemetry, self.metrics,
+                                 request=rec.id, tag=req.tag or rec.id)
+                rec.progress["telemetry"] = {
+                    k: rep.telemetry[k] for k in
+                    ("pruning_rate", "frontier_depth",
+                     "pool_highwater", "steal_sent", "steal_recv",
+                     "improvements")}
             if unit_costs is not None and rep.per_worker is not None:
                 self._publish_phases(rec, rep, unit_costs)
 

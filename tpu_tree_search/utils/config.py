@@ -40,7 +40,10 @@ SERVICE_RETRY_BASE_S_DEFAULT = 0.2    # re-dispatch backoff base
 # Observability defaults (tpu_tree_search/obs). Env-driven like the
 # resilience knobs (they must survive campaign-worker respawns):
 # TTS_TRACE_FILE appends the flight recorder's JSONL event log to a
-# file, TTS_TRACE_RING bounds the in-memory ring buffer. The HTTP
+# file, TTS_TRACE_RING bounds the in-memory ring buffer,
+# TTS_SEARCH_TELEMETRY=1 (or --search-telemetry) compiles the
+# on-device search-telemetry block into the loop
+# (engine/telemetry.py — static flag, read at state init). The HTTP
 # front-end is wired per entry point (`serve --http-port`), never
 # ambiently — an open port must be an explicit operator choice.
 OBS_TRACE_RING_DEFAULT = 16384        # ring-buffer records kept in RAM
