@@ -28,6 +28,20 @@ if os.environ.get("TTS_BENCH_PLATFORM"):
     import jax
     jax.config.update("jax_platforms", os.environ["TTS_BENCH_PLATFORM"])
 
+from tpu_tree_search.utils import device_info  # noqa: E402
+
+# Backend bootstrap: on a TPU-less host the pinned default backend
+# fails to initialize (the `RuntimeError: Unable to initialize backend`
+# every BENCH_r0*.json tail used to end in, rc=1). Degrade instead of
+# die: fall back to automatic selection, then to cpu, and STAMP the
+# resolved platform + a degraded flag on every emitted row so a CPU
+# rate can never masquerade as a TPU rate (tools/perf_sentry.py skips
+# rate comparison on degraded rows).
+PLATFORM, DEGRADED = device_info.resolve_backend()
+if DEGRADED:
+    print(f"# backend degraded: default platform unavailable, running "
+          f"on {PLATFORM!r}", file=sys.stderr)
+
 import numpy as np  # noqa: E402
 
 from tpu_tree_search.utils import compile_cache  # noqa: E402
@@ -119,7 +133,10 @@ def main():
             "unit": "node_evals_per_sec",
             "vs_baseline": round(rate / PER_CHIP_TARGET, 4),
             "baseline": BASELINE_LABEL,
+            "platform": PLATFORM,
         }
+        if DEGRADED:
+            row["degraded"] = True
         # with TTS_SEARCH_TELEMETRY=1 the row also captures SEARCH
         # efficiency (pruning quality, frontier position, pool
         # pressure), not just throughput — future BENCH_*.json rounds

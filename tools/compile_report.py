@@ -1,0 +1,94 @@
+"""Compile-cost ledger table from a server status snapshot.
+
+The executor cache (tpu_tree_search/service/executors.ExecutorCache)
+records, per cached loop, its trace and compile wall seconds and —
+where the backend supports ``compiled.cost_analysis()`` — the
+executable's FLOPs / bytes accessed. This tool renders that ledger as
+a table from either
+
+- a running server's ``/status`` endpoint (pass the URL), or
+- a saved status-snapshot JSON file (``status_snapshot()`` dumped to
+  disk; the ledger rides its ``compile_ledger`` key).
+
+    python tools/compile_report.py http://127.0.0.1:9100/status
+    python tools/compile_report.py /tmp/status.json
+
+The same numbers feed the ``tts_compile_seconds`` histogram on
+``/metrics``; this is the per-entry view (WHICH shapes paid WHAT),
+the histogram is the aggregate.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_snapshot(source: str) -> dict:
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+        with urllib.request.urlopen(source, timeout=10) as r:
+            return json.load(r)
+    with open(source) as f:
+        return json.load(f)
+
+
+def _fmt_num(v, scale: float = 1.0, suffix: str = "") -> str:
+    if v is None:
+        return "-"
+    return f"{float(v) / scale:.2f}{suffix}"
+
+
+def render(ledger: list[dict], cache: dict | None = None) -> str:
+    hdr = (f"{'#':>2} {'build_s':>8} {'trace_s':>8} {'compile_s':>9} "
+           f"{'gflops':>9} {'MB_acc':>8} {'method':>10}  key")
+    lines = ["compile-cost ledger (one row per cached executable)",
+             hdr, "-" * len(hdr)]
+    total = 0.0
+    for i, e in enumerate(ledger):
+        tc = (e.get("trace_s") or 0.0) + (e.get("compile_s") or 0.0)
+        total += tc
+        lines.append(
+            f"{i:>2} {_fmt_num(e.get('build_s')):>8} "
+            f"{_fmt_num(e.get('trace_s')):>8} "
+            f"{_fmt_num(e.get('compile_s')):>9} "
+            f"{_fmt_num(e.get('flops'), 1e9):>9} "
+            f"{_fmt_num(e.get('bytes_accessed'), 2**20):>8} "
+            f"{e.get('method') or 'pending':>10}  "
+            f"{str(e.get('key', ''))[:60]}")
+    lines.append("")
+    summary = (f"{len(ledger)} executable(s), "
+               f"{total:.2f} s total trace+compile")
+    if cache:
+        hits, misses = cache.get("hits", 0), cache.get("misses", 0)
+        served = hits + misses
+        summary += (f"; cache {hits} hit(s) / {misses} miss(es)"
+                    + (f" — {hits / served:.0%} of lookups reused a "
+                       "paid compile" if served else ""))
+    lines.append(summary)
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render the executor cache's compile-cost ledger "
+                    "from a /status URL or a saved snapshot JSON")
+    ap.add_argument("source", help="http(s)://.../status URL or a "
+                                   "status-snapshot JSON file")
+    args = ap.parse_args(argv)
+    try:
+        snap = load_snapshot(args.source)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"error: cannot read {args.source}: {e}", file=sys.stderr)
+        return 1
+    ledger = snap.get("compile_ledger")
+    if not ledger:
+        print(f"error: no compile_ledger in {args.source} — is this a "
+              "status_snapshot() from a server that has served at "
+              "least one request?", file=sys.stderr)
+        return 1
+    print(render(ledger, snap.get("executor_cache")))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

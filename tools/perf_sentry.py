@@ -1,0 +1,321 @@
+"""Perf regression sentry over the bench-row trajectory.
+
+Every round the driver runs ``bench.py`` and a multichip dry-run and
+archives the result as ``BENCH_r0N.json`` / ``MULTICHIP_r0N.json``.
+Until this tool, NOBODY read them: every BENCH row to date was a
+silently-ignored ``rc=1`` backend failure. The sentry makes the
+trajectory a gate:
+
+- **rc failures are loud**: any row with ``rc != 0`` (or a multichip
+  row with ``ok: false``) is a FAIL verdict — a benchmark that did not
+  run is a regression of the *measurement*, the worst kind to ignore;
+- **rate regressions are caught**: each metric in the latest round is
+  compared against the best prior value of the SAME metric across
+  earlier rounds (plus any ``published`` number in BASELINE.json),
+  with a per-metric relative threshold (default 10%; LB2's window is
+  shorter and noisier, so it gets 15%);
+- **degraded rows don't lie**: a row stamped ``degraded: true`` (the
+  bench ran on a fallback platform, see bench.py's backend bootstrap)
+  is never rate-compared against non-degraded history — a CPU rate
+  "regressing" from a TPU rate is not a finding — but its rc still
+  gates, platform recorded in the report.
+
+Inputs it understands: the driver's wrapper objects
+(``{"rc": ..., "tail": ..., "parsed": ...}`` — metric rows are
+re-extracted from the tail, the wrapper's single ``parsed`` row drops
+the LB2 line), multichip wrappers (``{"n_devices", "rc", "ok",
+"skipped", "tail"}``), and raw ``bench.py`` stdout (one JSON row per
+line — what the CI leg pipes in).
+
+    python tools/perf_sentry.py                       # latest round in .
+    python tools/perf_sentry.py --report-only bench_row.jsonl
+    python tools/perf_sentry.py --threshold 0.2 --out sentry.md
+
+Exit status: nonzero when any verdict is FAIL (rc failure, not-ok
+multichip, or regression beyond threshold) — unless ``--report-only``,
+which always exits 0 and is how CI runs it while the trajectory is
+still all-CPU (the markdown lands as a build artifact either way).
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+# per-metric relative regression thresholds; _default backstops the rest
+THRESHOLDS = {
+    "_default": 0.10,
+    # LB2 benches on a half-length window (bench.py) — noisier
+    "lb2": 0.15,
+}
+
+PASS, FAIL, NEW, SKIP = "PASS", "FAIL", "NEW", "SKIP"
+
+
+def threshold_for(metric: str, overrides: dict) -> float:
+    for pat, th in {**THRESHOLDS, **overrides}.items():
+        if pat != "_default" and pat in metric:
+            return th
+    return overrides.get("_default", THRESHOLDS["_default"])
+
+
+def _round_of(path: str) -> int:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def _json_lines(text: str) -> list[dict]:
+    """Metric rows embedded in free text (bench.py stdout / wrapper
+    tails): any line that parses as a JSON object with a 'metric'."""
+    rows = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            obj = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            rows.append(obj)
+    return rows
+
+
+def load_source(path: str) -> dict:
+    """Normalize one input file to
+    {source, rc, ok, skipped, rows: [metric rows]}."""
+    with open(path) as f:
+        text = f.read()
+    out = {"source": os.path.basename(path), "rc": 0, "ok": True,
+           "skipped": False, "rows": []}
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        obj = None
+    if isinstance(obj, dict) and ("rc" in obj or "tail" in obj):
+        # driver wrapper (BENCH_rNN / MULTICHIP_rNN)
+        out["rc"] = int(obj.get("rc", 0))
+        out["ok"] = bool(obj.get("ok", True))
+        out["skipped"] = bool(obj.get("skipped", False))
+        rows = _json_lines(obj.get("tail") or "")
+        if not rows and isinstance(obj.get("parsed"), dict):
+            rows = [obj["parsed"]]
+        out["rows"] = rows
+    elif isinstance(obj, dict) and "metric" in obj:
+        out["rows"] = [obj]
+    else:
+        # raw bench stdout: JSON rows one per line
+        out["rows"] = _json_lines(text)
+    return out
+
+
+def load_history(directory: str, before_round: int,
+                 baseline_path: str | None) -> dict:
+    """Best prior value per metric: earlier BENCH_r*.json rounds in
+    `directory` plus BASELINE.json's published numbers."""
+    best: dict = {}
+
+    def offer(metric, value, src, platform=None):
+        if value is None:
+            return
+        if metric not in best or value > best[metric][0]:
+            best[metric] = (float(value), src, platform)
+
+    for path in sorted(glob.glob(os.path.join(directory,
+                                              "BENCH_*.json"))):
+        rnd = _round_of(path)
+        if before_round >= 0 and rnd >= before_round:
+            continue
+        src = load_source(path)
+        if src["rc"] != 0:
+            continue
+        for row in src["rows"]:
+            if row.get("degraded"):
+                continue            # fallback-platform rate: not a bar
+            offer(row.get("metric"), row.get("value"), src["source"],
+                  row.get("platform"))
+    if baseline_path and os.path.exists(baseline_path):
+        try:
+            with open(baseline_path) as f:
+                published = json.load(f).get("published") or {}
+            for metric, value in published.items():
+                if isinstance(value, (int, float)):
+                    offer(metric, value,
+                          os.path.basename(baseline_path))
+        except (OSError, json.JSONDecodeError, AttributeError):
+            pass
+    return best
+
+
+def judge(sources: list[dict], history: dict,
+          overrides: dict) -> list[dict]:
+    """One verdict dict per finding, FAILs first."""
+    verdicts = []
+    for src in sources:
+        name = src["source"]
+        if src["skipped"]:
+            verdicts.append({"verdict": SKIP, "source": name,
+                             "detail": "round marked skipped"})
+            continue
+        if src["rc"] != 0:
+            verdicts.append({
+                "verdict": FAIL, "source": name,
+                "detail": f"rc={src['rc']} — the benchmark itself "
+                          "failed to run (previously ignored "
+                          "silently)"})
+            continue
+        if not src["ok"]:
+            verdicts.append({"verdict": FAIL, "source": name,
+                             "detail": "ok=false"})
+            continue
+        if not src["rows"]:
+            verdicts.append({"verdict": PASS, "source": name,
+                             "detail": "rc=0, no metric rows "
+                                       "(smoke-only round)"})
+            continue
+        for row in src["rows"]:
+            metric = row.get("metric", "?")
+            value = row.get("value")
+            v = {"source": name, "metric": metric, "value": value,
+                 "platform": row.get("platform"),
+                 "degraded": bool(row.get("degraded"))}
+            ref = history.get(metric)
+            refplat = ref[2] if ref is not None else None
+            plat_mismatch = (ref is not None and refplat
+                             and row.get("platform")
+                             and refplat != row["platform"])
+            if ref is not None and (v["degraded"] or plat_mismatch):
+                # a fallback-platform (or different-platform) rate
+                # compared against the reference best would always
+                # "regress" — a CPU rate is not a TPU finding
+                v.update(verdict=SKIP,
+                         detail=f"platform {row.get('platform')!r}"
+                                + (" (degraded)" if v["degraded"]
+                                   else "")
+                                + f" vs reference platform "
+                                  f"{refplat!r}; rate not compared "
+                                  f"(reference {ref[0]:.4g})")
+            elif ref is None:
+                v.update(verdict=NEW,
+                         detail="no prior value for this metric")
+            else:
+                refv, refsrc = ref[0], ref[1]
+                th = threshold_for(metric, overrides)
+                delta = (value - refv) / refv if refv else 0.0
+                v.update(reference=refv, reference_source=refsrc,
+                         delta=delta, threshold=th)
+                if delta < -th:
+                    v.update(verdict=FAIL,
+                             detail=f"{delta:+.1%} vs best prior "
+                                    f"{refv:.4g} ({refsrc}); "
+                                    f"threshold -{th:.0%}")
+                else:
+                    v.update(verdict=PASS,
+                             detail=f"{delta:+.1%} vs best prior "
+                                    f"{refv:.4g} ({refsrc})")
+            verdicts.append(v)
+    order = {FAIL: 0, NEW: 1, SKIP: 2, PASS: 3}
+    verdicts.sort(key=lambda v: (order.get(v["verdict"], 9),
+                                 v.get("metric", "")))
+    return verdicts
+
+
+def render_markdown(verdicts: list[dict]) -> str:
+    n_fail = sum(v["verdict"] == FAIL for v in verdicts)
+    lines = ["# Perf sentry", "",
+             ("**FAIL** — " if n_fail else "**PASS** — ")
+             + f"{len(verdicts)} finding(s), {n_fail} failing", "",
+             "| verdict | source | metric | value | reference | Δ | "
+             "detail |",
+             "|---|---|---|---|---|---|---|"]
+    for v in verdicts:
+        delta = (f"{v['delta']:+.1%}" if v.get("delta") is not None
+                 else "-")
+        ref = (f"{v['reference']:.4g}" if v.get("reference") is not None
+               else "-")
+        val = (f"{v['value']:.4g}" if isinstance(v.get("value"),
+                                                 (int, float)) else "-")
+        lines.append(
+            f"| {v['verdict']} | {v['source']} "
+            f"| {v.get('metric', '-')} | {val} | {ref} | {delta} "
+            f"| {v['detail']} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail loudly on rc!=0 bench rows and >threshold "
+                    "rate regressions in the latest BENCH_*/MULTICHIP_* "
+                    "round (or explicit row files)")
+    ap.add_argument("files", nargs="*",
+                    help="row files to judge (driver wrappers or raw "
+                         "bench.py stdout); default: the latest "
+                         "BENCH_r*/MULTICHIP_r* round in --dir")
+    ap.add_argument("--dir", default=".",
+                    help="where the round archives live (history is "
+                         "always read from here)")
+    ap.add_argument("--baseline", default=None,
+                    help="BASELINE.json path (its `published` numbers "
+                         "join the reference set); default: "
+                         "<dir>/BASELINE.json")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="override the default relative regression "
+                         "threshold (e.g. 0.2 = fail below -20%%)")
+    ap.add_argument("--metric-threshold", action="append", default=[],
+                    metavar="SUBSTR=FRACTION",
+                    help="per-metric threshold override, repeatable "
+                         "(e.g. lb2=0.25)")
+    ap.add_argument("--report-only", action="store_true",
+                    help="always exit 0 (CI mode while the trajectory "
+                         "is CPU-only); the report still says FAIL")
+    ap.add_argument("--out", default=None,
+                    help="also write the markdown summary here")
+    args = ap.parse_args(argv)
+
+    overrides = {}
+    if args.threshold is not None:
+        overrides["_default"] = args.threshold
+    for spec in args.metric_threshold:
+        key, _, val = spec.partition("=")
+        overrides[key] = float(val)
+
+    if args.files:
+        paths = args.files
+        latest_round = -1
+    else:
+        rounds = [p for p in
+                  glob.glob(os.path.join(args.dir, "BENCH_*.json"))
+                  + glob.glob(os.path.join(args.dir,
+                                           "MULTICHIP_*.json"))
+                  if _round_of(p) >= 0]
+        if not rounds:
+            print(f"error: no BENCH_r*/MULTICHIP_r* rounds in "
+                  f"{args.dir} and no files given", file=sys.stderr)
+            return 2
+        latest_round = max(_round_of(p) for p in rounds)
+        paths = sorted(p for p in rounds
+                       if _round_of(p) == latest_round)
+
+    sources = [load_source(p) for p in paths]
+    baseline = args.baseline or os.path.join(args.dir, "BASELINE.json")
+    history = load_history(args.dir, latest_round, baseline)
+    verdicts = judge(sources, history, overrides)
+
+    md = render_markdown(verdicts)
+    print(md)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(md)
+        print(f"# wrote {args.out}", file=sys.stderr)
+
+    n_fail = sum(v["verdict"] == FAIL for v in verdicts)
+    if n_fail and not args.report_only:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -5,20 +5,21 @@ Usage:
         [--warm 400] [--iters 30]
 
 Warms the single-device engine past its ramp (underfilled chunks), traces
-a short window of the compiled loop, then aggregates per-op SELF times
-(exclusive of nested control-flow spans — tpu_tree_search/obs/
-chrome_trace.py owns the trace parsing, shared with
-tools/trace_selftime.py and tools/validate_attribution.py) bucketed into
-the step's phases. The tool's own wall-clock phases (warm-up, traced
-window) are flight-recorded as obs/tracelog spans instead of private
-perf_counter bookkeeping, so a `TTS_TRACE_FILE=...` run leaves a
-timeline of the measurement itself. This is the measurement VERDICT r2
-items 8/9 ask for: what the two-phase LB2 step (resp. the LB1 step)
-actually spends its time on.
+a short window of the compiled loop through the shared profiler session
+(tpu_tree_search/obs/profiler.py — the SAME one-at-a-time session behind
+``POST /profile`` and the `profile` CLI subcommand; no direct
+``jax.profiler`` calls live in the tools any more), then aggregates
+per-op SELF times (exclusive of nested control-flow spans —
+tpu_tree_search/obs/chrome_trace.py owns the trace parsing AND the phase
+buckets, shared with tools/trace_selftime.py, tools/search_report.py and
+tools/validate_attribution.py). The tool's own wall-clock phases
+(warm-up, traced window) are flight-recorded as obs/tracelog spans, so a
+`TTS_TRACE_FILE=...` run leaves a timeline of the measurement itself.
+This is the measurement VERDICT r2 items 8/9 ask for: what the two-phase
+LB2 step (resp. the LB1 step) actually spends its time on.
 """
 
 import argparse
-import collections
 import json
 import os
 import sys
@@ -27,31 +28,12 @@ import tempfile
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from tpu_tree_search.engine import device  # noqa: E402
-from tpu_tree_search.obs import tracelog  # noqa: E402
-from tpu_tree_search.obs.chrome_trace import (load_xla_trace,  # noqa: E402
-                                              self_times)
+from tpu_tree_search.obs import profiler, tracelog  # noqa: E402
+from tpu_tree_search.obs.chrome_trace import (bucket_of,  # noqa: E402
+                                              bucketed_self_times,
+                                              load_xla_trace, self_times)
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
-from tpu_tree_search.utils import device_info  # noqa: E402
-
-BUCKETS = [
-    # (bucket, substrings matched against the (lowercased) op name)
-    ("lb2_pair_sweep", ["lb2_bounds"]),
-    ("expand_kernel", ["expand_bounds", "pallas"]),
-    ("sort", ["sort"]),
-    ("gather", ["gather", "take", "fusion."]),
-    ("scatter_write", ["dynamic_update_slice", "dynamic-update-slice",
-                       "scatter"]),
-    ("copy_concat_pad", ["copy", "concatenate", "pad"]),
-]
-
-
-def bucket_of(name):
-    low = name.lower()
-    for bucket, subs in BUCKETS:
-        if any(s in low for s in subs):
-            return bucket
-    return "other"
 
 
 def main():
@@ -83,7 +65,7 @@ def main():
 
     log_dir = args.logdir or tempfile.mkdtemp(prefix="tts_trace_")
     with tracelog.span("profile_step.traced_window", logdir=log_dir):
-        with device_info.trace(log_dir):
+        with profiler.trace(log_dir):
             out = device.run(tables, state, args.lb, args.chunk,
                              max_iters=args.warm + args.iters)
             out.size.block_until_ready()
@@ -99,9 +81,7 @@ def main():
                          "(thread-name heuristic missed; inspect "
                          f"{log_dir} manually)")
 
-    by_bucket = collections.Counter()
-    for name, d in self_us.items():
-        by_bucket[bucket_of(name)] += d
+    by_bucket = bucketed_self_times(self_us)
 
     print(json.dumps({
         "lb": args.lb, "inst": args.inst, "chunk": args.chunk,

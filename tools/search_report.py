@@ -1,9 +1,10 @@
-"""Per-segment search-telemetry table from a flight-recorder trace.
+"""Per-segment search-telemetry table from a flight-recorder trace —
+and self-time attribution from an on-demand profiler capture.
 
-Reads either trace artifact (the JSONL event log or the Chrome
-trace-event JSON — same detection as tools/trace_summary.py) and folds
-the ``search.telemetry`` events the segmented engine driver emits when
-TTS_SEARCH_TELEMETRY / --search-telemetry is on
+Given a FILE, reads either trace artifact (the JSONL event log or the
+Chrome trace-event JSON — same detection as tools/trace_summary.py) and
+folds the ``search.telemetry`` events the segmented engine driver emits
+when TTS_SEARCH_TELEMETRY / --search-telemetry is on
 (engine/checkpoint.run_segmented; the on-device block itself is
 engine/telemetry.py) into two tables:
 
@@ -16,8 +17,17 @@ engine/telemetry.py) into two tables:
   max/mean imbalance factor — the starved-worker view the reference's
   boxplot stats print per pool.
 
+Given a DIRECTORY — an XLA profiler artifact, i.e. what
+``POST /profile``, the `profile` CLI subcommand or
+tools/profile_step.py leave behind — it renders the **self-time
+attribution** instead: per-op device self-times
+(obs/chrome_trace.self_times, control-flow nesting excluded) folded
+into the step's phase buckets, so the hardware-side view lands next to
+the search-side counter lanes.
+
     python tools/search_report.py /tmp/tts-trace.jsonl
     python tools/search_report.py /tmp/tts-trace.chrome.json
+    python tools/search_report.py /tmp/profiles/capture-.../   # XLA dir
 
 Doubles as the CI artifact renderer: the telemetry CI leg uploads this
 table next to the serve-session traces (tests/test_telemetry.py writes
@@ -93,13 +103,58 @@ def render(groups: dict[str, list[dict]]) -> str:
     return "\n".join(lines)
 
 
+def render_selftime(log_dir: str, top: int = 20) -> str | None:
+    """Self-time attribution table from an XLA profiler artifact dir
+    (None when the directory holds no parseable trace)."""
+    from tpu_tree_search.obs.chrome_trace import (bucket_of,
+                                                  bucketed_self_times,
+                                                  load_xla_trace,
+                                                  self_times)
+    events = load_xla_trace(log_dir)
+    if not events:
+        return None
+    self_us, counts = self_times(events)
+    total = sum(self_us.values())
+    if total <= 0:
+        return None
+    lines = [f"self-time attribution ({log_dir})",
+             f"device self-time total: {total / 1e3:.2f} ms", "",
+             f"{'bucket':<16} {'self_ms':>10} {'share':>7}",
+             "-" * 36]
+    for bucket, us in bucketed_self_times(self_us).most_common():
+        lines.append(f"{bucket:<16} {us / 1e3:>10.2f} "
+                     f"{100.0 * us / total:>6.1f}%")
+    lines += ["", f"top {top} ops by device self-time:",
+              f"{'self_ms':>10} {'count':>6}  {'bucket':<16} name",
+              "-" * 70]
+    for name, us in self_us.most_common(top):
+        lines.append(f"{us / 1e3:>10.2f} {counts[name]:>6}  "
+                     f"{bucket_of(name):<16} {str(name)[:80]}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-segment pruning-efficiency / load-imbalance "
                     "table from a flight-recorder trace (JSONL or "
-                    "Chrome JSON) with search telemetry enabled")
-    ap.add_argument("trace", help="trace file path")
+                    "Chrome JSON) with search telemetry enabled — or "
+                    "self-time attribution from an XLA profiler "
+                    "artifact directory (POST /profile, `profile`, "
+                    "tools/profile_step.py)")
+    ap.add_argument("trace", help="trace file path, or an XLA profiler "
+                                  "artifact directory")
+    ap.add_argument("--top", type=int, default=20,
+                    help="ops listed in the self-time table")
     args = ap.parse_args(argv)
+    if os.path.isdir(args.trace):
+        table = render_selftime(args.trace, top=args.top)
+        if table is None:
+            print(f"error: no XLA trace events under {args.trace} "
+                  "(expected plugins/profile/<run>/*.trace.json.gz)",
+                  file=sys.stderr)
+            return 1
+        print(table)
+        return 0
     records = load_records(args.trace)
     if not records:
         print(f"error: no trace records in {args.trace}",

@@ -32,12 +32,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 from tpu_tree_search.engine import device  # noqa: E402
-from tpu_tree_search.obs import tracelog  # noqa: E402
+from tpu_tree_search.obs import profiler, tracelog  # noqa: E402
 from tpu_tree_search.obs.chrome_trace import (load_xla_trace,  # noqa: E402
                                               self_times)
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
-from tpu_tree_search.utils import device_info, phase_timing  # noqa: E402
+from tpu_tree_search.utils import phase_timing  # noqa: E402
 
 KERNEL_OPS = ("expand_bounds", "lb2_bounds", "pallas")
 
@@ -75,7 +75,7 @@ def main():
         log_dir = tempfile.mkdtemp(prefix=f"tts_attr_lb{lb}_")
         with tracelog.span("validate_attribution.traced_window",
                            lb=lb, logdir=log_dir) as win_sp:
-            with device_info.trace(log_dir):
+            with profiler.trace(log_dir):
                 out = device.run(tables, state, lb, args.chunk,
                                  max_iters=args.warm + args.iters)
                 out.size.block_until_ready()
@@ -132,9 +132,9 @@ def main():
         bracket_wall_per_rep = (wall(loop2) - wall(loop1)) / K
         bracket_loop = loop2
         bdir = tempfile.mkdtemp(prefix=f"tts_bracket_lb{lb}_")
-        with device_info.trace(bdir):
+        with profiler.trace(bdir):
             bracket_loop(state).block_until_ready()
-        bracket_self, _ = self_times(load(bdir))
+        bracket_self, _ = self_times(load_xla_trace(bdir))
         bracket_dev_per_rep = sum(bracket_self.values()) / 1e6 / (2 * K)
         # Same loop, wall-timed vs trace device self-time: this
         # validates the attribution's MEASUREMENT method (the unit

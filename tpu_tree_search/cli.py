@@ -171,6 +171,16 @@ def _serve_parser(sub):
                         "shutdown (obs/otel.py; requires the "
                         "opentelemetry SDK — a clean no-op warning "
                         "when it is not installed)")
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="artifact root for POST /profile captures "
+                        "(obs/profiler; one subdirectory per capture; "
+                        "default: <workdir>/profiles)")
+    p.add_argument("--resource-sample-s", type=float, default=None,
+                   help="device-memory/host-RSS sampler cadence in "
+                        "seconds (obs/resource: tts_device_bytes_* "
+                        "gauges + Perfetto memory lanes; default "
+                        "1.0, also via TTS_RESOURCE_SAMPLE_S; <= 0 "
+                        "disables)")
 
 
 def _client_parser(sub):
@@ -214,13 +224,17 @@ def run_serve(args) -> int:
                           max_queue_depth=args.queue_depth,
                           segment_iters=args.segment_iters,
                           phase_profile=(True if args.phase_metrics
-                                         else None)) as srv:
+                                         else None),
+                          resource_sample_s=args.resource_sample_s
+                          ) as srv:
             if args.http_port is not None:
                 from .obs.httpd import start_http_server
                 httpd = start_http_server(srv, host=args.http_host,
-                                          port=args.http_port)
+                                          port=args.http_port,
+                                          profile_dir=args.profile_dir)
                 print(f"observability: {httpd.url}/healthz /metrics "
-                      "/status /trace; POST /submit /cancel",
+                      "/status /trace; POST /submit /cancel "
+                      "/profile?duration_s=N",
                       flush=True)
             print(f"serving: {args.submeshes} submesh(es) x "
                   f"{srv.slots[0].mesh.devices.size} device(s), "
@@ -261,6 +275,77 @@ def run_client(args) -> int:
         return 1
     print(json.dumps(res, indent=1))
     return 0 if res.get("state") == "DONE" else 1
+
+
+def _profile_parser(sub):
+    p = sub.add_parser(
+        "profile",
+        help="standalone capture-on-demand: warm the single-device "
+             "engine past its ramp, capture an XLA profiler trace of "
+             "a steady-state window (obs/profiler — same session as "
+             "POST /profile), and print the self-time attribution")
+    p.add_argument("-i", "--inst", type=int, default=21,
+                   help="Taillard instance id")
+    p.add_argument("-l", "--lb", type=int, default=1, choices=(0, 1, 2))
+    p.add_argument("--chunk", type=int, default=256)
+    p.add_argument("--capacity", type=int, default=1 << 18)
+    p.add_argument("--warm", type=int, default=50,
+                   help="warm-up iterations before the traced window")
+    p.add_argument("--iters", type=int, default=20,
+                   help="traced-window iterations")
+    p.add_argument("--out", type=str, default=None,
+                   help="artifact root (default: a fresh temp dir); "
+                        "each capture gets its own subdirectory")
+    p.add_argument("--top", type=int, default=15,
+                   help="ops to list in the self-time table")
+
+
+def run_profile(args) -> int:
+    import json
+    import tempfile
+
+    from .engine import device
+    from .obs import chrome_trace, profiler
+    from .ops import batched
+    from .problems import taillard
+
+    p = taillard.processing_times(args.inst)
+    ub = taillard.optimal_makespan(args.inst)
+    tables = batched.make_tables(p)
+    state = device.init_state(p.shape[1], args.capacity, ub, p_times=p)
+    state = device.run(tables, state, args.lb, args.chunk,
+                       max_iters=args.warm)
+    state.size.block_until_ready()
+    print(f"# warmed: iters={int(state.iters)} pool={int(state.size)}",
+          file=sys.stderr)
+
+    sess = profiler.session()
+    root = args.out or tempfile.mkdtemp(prefix="tts_profile_")
+    log_dir = sess.fresh_dir(root)
+    with sess.trace(log_dir):
+        out = device.run(tables, state, args.lb, args.chunk,
+                         max_iters=args.warm + args.iters)
+        out.size.block_until_ready()
+
+    self_us, counts = chrome_trace.self_times(
+        chrome_trace.load_xla_trace(log_dir))
+    total = sum(self_us.values())
+    buckets = chrome_trace.bucketed_self_times(self_us)
+    print(json.dumps({
+        "artifact": log_dir, "inst": args.inst, "lb": args.lb,
+        "iters": int(out.iters) - int(state.iters),
+        "evals": int(out.evals) - int(state.evals),
+        "device_self_ms": round(total / 1e3, 2),
+        "buckets_ms": {k: round(v / 1e3, 2)
+                       for k, v in buckets.most_common()},
+    }))
+    print("\n# top ops by device self-time "
+          "(tools/search_report.py renders the same table):")
+    for name, d in self_us.most_common(args.top):
+        print(f"{d / 1e3:10.2f} ms  x{counts[name]:<6} "
+              f"[{chrome_trace.bucket_of(name):>15}]  {name[:90]}")
+    print(f"\n# artifact: {log_dir}")
+    return 0
 
 
 def _nq_parser(sub):
@@ -703,6 +788,7 @@ def main(argv=None) -> int:
     _nq_parser(sub)
     _serve_parser(sub)
     _client_parser(sub)
+    _profile_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
                         "gpu_info, common/gpu_util.cu:5-17)")
@@ -737,6 +823,8 @@ def main(argv=None) -> int:
         return run_serve(args)
     if args.cmd == "client":
         return run_client(args)
+    if args.cmd == "profile":
+        return run_profile(args)
     if args.cmd == "devices":
         from .utils.device_info import print_device_info
         print_device_info()

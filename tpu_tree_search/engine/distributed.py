@@ -747,9 +747,24 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         def run_fn(s, target):
             return driver.run(s, max_iters=target)
 
+        def hb(rep):
+            # resource-observability heartbeat hook: one device-memory
+            # / host-RSS sweep per segment (obs/resource publishes the
+            # tts_device_bytes_* gauges and a resource.sample trace
+            # event, which Perfetto renders as memory lanes beside the
+            # pool/steal counter lanes). Observation-only — a failed
+            # sweep must never stop the search.
+            try:
+                from ..obs import resource as obs_resource
+                obs_resource.sample_now()
+            except Exception:  # noqa: BLE001
+                pass
+            if heartbeat is not None:
+                heartbeat(rep)
+
         out = checkpoint.run_segmented(
             run_fn, state, segment_iters=segment_iters or 2048,
-            checkpoint_path=checkpoint_path, heartbeat=heartbeat,
+            checkpoint_path=checkpoint_path, heartbeat=hb,
             checkpoint_every=checkpoint_every,
             max_total_iters=max_iters, checkpoint_meta=ckpt_meta,
             post_segment=(session.post_segment if session else None),

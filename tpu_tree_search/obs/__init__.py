@@ -21,13 +21,23 @@ on which submesh*. This package is that recorder:
   share);
 - :mod:`~tpu_tree_search.obs.httpd` — ``/healthz`` ``/metrics``
   ``/status`` ``/trace`` HTTP front-end over a running SearchServer
-  (stdlib ``http.server``; the ROADMAP service follow-on).
+  (stdlib ``http.server``; the ROADMAP service follow-on), plus the
+  ``/submit`` ``/cancel`` write path and on-demand ``/profile``;
+- :mod:`~tpu_tree_search.obs.profiler` — the process's ONE door to the
+  XLA profiler: a thread-safe one-at-a-time capture session behind
+  ``POST /profile``, the ``profile`` CLI subcommand and the profiling
+  tools (no direct ``jax.profiler`` calls anywhere else);
+- :mod:`~tpu_tree_search.obs.resource` — device-memory / host-RSS
+  sampler: ``tts_device_bytes_*`` and ``tts_host_rss_bytes`` gauges
+  plus ``resource.sample`` trace events rendered as Perfetto memory
+  lanes.
 
 Everything here is observation-only: instrumentation records
 timestamps and counters, it never changes what the engine explores —
 served node counts stay bit-identical with the recorder on or off.
 """
 
-from . import chrome_trace, metrics, tracelog  # noqa: F401
+from . import chrome_trace, metrics, profiler, resource, tracelog  # noqa: F401
 
-__all__ = ["tracelog", "metrics", "chrome_trace"]
+__all__ = ["tracelog", "metrics", "chrome_trace", "profiler",
+           "resource"]

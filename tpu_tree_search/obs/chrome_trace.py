@@ -32,7 +32,8 @@ import os
 import pathlib
 
 __all__ = ["to_chrome", "write_chrome", "read_jsonl",
-           "load_xla_trace", "self_times"]
+           "load_xla_trace", "self_times", "bucket_of",
+           "bucketed_self_times", "SELF_TIME_BUCKETS"]
 
 
 # ------------------------------------------------------------------ export
@@ -53,6 +54,33 @@ def _track_of(rec: dict) -> str:
 COUNTER_EVENT = "search.telemetry"
 COUNTER_KEYS = ("pruning_rate", "frontier_depth", "pool",
                 "steal_sent", "steal_recv")
+
+# resource-sampler sweeps (obs/resource) render as memory COUNTER lanes
+# beside the search counters: host RSS plus one in-use/peak pair per
+# device, so an HBM ramp lines up with the pool growth that caused it
+RESOURCE_EVENT = "resource.sample"
+
+
+def _counter_samples(rec: dict) -> list[tuple[str, float]]:
+    """(counter_name, value) pairs a record contributes to Perfetto
+    counter tracks; empty for non-counter events."""
+    name = rec.get("name")
+    if name == COUNTER_EVENT:
+        return [(k, rec[k]) for k in COUNTER_KEYS if k in rec]
+    if name == RESOURCE_EVENT:
+        out = []
+        if rec.get("host_rss_bytes") is not None:
+            out.append(("host_rss_bytes", rec["host_rss_bytes"]))
+        for d in rec.get("devices") or ():
+            if not isinstance(d, dict) or d.get("bytes_in_use") is None:
+                continue
+            out.append((f"device{d.get('id', '?')} bytes_in_use",
+                        d["bytes_in_use"]))
+            if d.get("peak_bytes_in_use") is not None:
+                out.append((f"device{d.get('id', '?')} bytes_peak",
+                            d["peak_bytes_in_use"]))
+        return out
+    return []
 
 
 def to_chrome(records: list[dict]) -> dict:
@@ -83,14 +111,12 @@ def to_chrome(records: list[dict]) -> dict:
                                         3)})
         else:
             events.append({**base, "ph": "i", "s": "t"})
-            if rec.get("name") == COUNTER_EVENT:
-                for key in COUNTER_KEYS:
-                    if key in rec:
-                        events.append({
-                            "ph": "C", "pid": 0, "tid": tid,
-                            "name": f"{key} ({track})",
-                            "ts": base["ts"],
-                            "args": {key: rec[key]}})
+            for key, val in _counter_samples(rec):
+                events.append({
+                    "ph": "C", "pid": 0, "tid": tid,
+                    "name": f"{key} ({track})",
+                    "ts": base["ts"],
+                    "args": {key.split(" ")[-1]: val}})
     meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": tid,
              "args": {"name": track}} for track, tid in tids.items()]
     # sorted lanes first, then events in timestamp order: Perfetto does
@@ -146,7 +172,12 @@ def load_xla_trace(log_dir: str | os.PathLike) -> list[dict]:
     return ev
 
 
-def self_times(events: list[dict], lane: str = "XLA Ops"):
+# runtime bookkeeping events in the CPU backend's executor lanes — not
+# ops, never charge time to them
+_CPU_LANE_NOISE = ("ThreadpoolListener::", "ThunkExecutor")
+
+
+def self_times(events: list[dict], lane: str | None = None):
     """Per-op SELF time (µs) and counts from Chrome trace events.
 
     Chrome-trace ``X`` events in the device lane nest by timestamp
@@ -155,15 +186,32 @@ def self_times(events: list[dict], lane: str = "XLA Ops"):
     is charged minus its directly-contained children. Nesting is only
     meaningful within one (pid, tid) lane — events are grouped first so
     multi-core traces don't cross-attribute children.
+
+    `lane=None` auto-detects: the accelerator backends' ``"XLA Ops"``
+    lanes when the trace has any, else the CPU backend's executor
+    lanes (``tf_XLA*`` thread names, runtime bookkeeping events
+    filtered out) — so the same call attributes a TPU trace and the
+    CPU traces CI produces.
     """
     tn = {}
     for e in events:
         if e.get("ph") == "M" and e.get("name") == "thread_name":
             tn[(e["pid"], e["tid"])] = e["args"]["name"]
+    if lane is None:
+        lane = ("XLA Ops" if any(n == "XLA Ops" for n in tn.values())
+                else "tf_XLA")
+
+    def in_lane(name) -> bool:
+        name = str(name)
+        return name == lane or (lane == "tf_XLA"
+                                and name.startswith("tf_XLA"))
+
     lanes = collections.defaultdict(list)
     for e in events:
         if (e.get("ph") == "X" and "dur" in e
-                and tn.get((e.get("pid"), e.get("tid"))) == lane):
+                and in_lane(tn.get((e.get("pid"), e.get("tid"))))
+                and not str(e.get("name", "")).startswith(
+                    _CPU_LANE_NOISE)):
             lanes[(e["pid"], e["tid"])].append(e)
     self_us = collections.Counter()
     counts = collections.Counter()
@@ -181,3 +229,34 @@ def self_times(events: list[dict], lane: str = "XLA Ops"):
                 self_us[stack[-1][1]] -= dur
             stack.append((ts + dur, name))
     return self_us, counts
+
+
+# the search step's phase buckets, matched against (lowercased) op
+# names — shared by tools/profile_step.py, tools/search_report.py and
+# the `profile` CLI subcommand so every self-time table groups ops the
+# same way
+SELF_TIME_BUCKETS = (
+    ("lb2_pair_sweep", ("lb2_bounds",)),
+    ("expand_kernel", ("expand_bounds", "pallas")),
+    ("sort", ("sort",)),
+    ("gather", ("gather", "take", "fusion.")),
+    ("scatter_write", ("dynamic_update_slice", "dynamic-update-slice",
+                       "scatter")),
+    ("copy_concat_pad", ("copy", "concatenate", "pad")),
+)
+
+
+def bucket_of(name: str) -> str:
+    low = str(name).lower()
+    for bucket, subs in SELF_TIME_BUCKETS:
+        if any(s in low for s in subs):
+            return bucket
+    return "other"
+
+
+def bucketed_self_times(self_us) -> "collections.Counter":
+    """Fold a per-op self-time Counter into the step's phase buckets."""
+    out = collections.Counter()
+    for name, d in self_us.items():
+        out[bucket_of(name)] += d
+    return out

@@ -23,7 +23,16 @@ FRONT of a running :class:`~tpu_tree_search.service.SearchServer`:
   ``400`` — the spool is no longer the only way in;
 - ``POST /cancel``  — body ``{"request_id": ...}``; returns
   ``200 {"cancelled": bool}`` (false = already terminal), ``404`` for
-  an unknown id.
+  an unknown id;
+- ``POST /profile?duration_s=N`` — capture-on-demand: start the XLA
+  profiler against the LIVE process for N seconds (default 1, capped
+  at ``utils.config.PROFILE_MAX_DURATION_S``) and return the artifact
+  directory (``obs/profiler``; the TensorBoard profile layout
+  ``tools/search_report.py`` / ``tools/trace_selftime.py`` attribute
+  self-time from). One capture at a time: a concurrent request gets
+  ``409``; a closing server ``503``. The artifact root is
+  ``--profile-dir`` (default: a ``profiles/`` dir under the server's
+  workdir), one fresh subdirectory per capture.
 
 Usage::
 
@@ -38,9 +47,10 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import chrome_trace, metrics, tracelog
+from . import chrome_trace, metrics, profiler, tracelog
 
 __all__ = ["start_http_server", "ObsHttpd"]
 
@@ -61,7 +71,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/")
-    POST_PATHS = ("/submit", "/cancel")
+    POST_PATHS = ("/submit", "/cancel", "/profile")
+
+    def _query(self) -> dict:
+        qs = self.path.split("?", 1)[1] if "?" in self.path else ""
+        return {k: v[-1] for k, v in
+                urllib.parse.parse_qs(qs).items()}
 
     def do_GET(self) -> None:  # noqa: N802 — http.server API
         obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
@@ -77,7 +92,8 @@ class _Handler(BaseHTTPRequestHandler):
         except (OSError, ValueError):
             body = b""
         self._route({"/submit": lambda: obs.submit(body),
-                     "/cancel": lambda: obs.cancel(body)},
+                     "/cancel": lambda: obs.cancel(body),
+                     "/profile": lambda: obs.profile(self._query())},
                     other_method=self.GET_PATHS)
 
     def _route(self, handlers: dict, other_method: tuple = ()) -> None:
@@ -100,7 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": f"unknown path {path!r}",
                      "endpoints": ["/healthz", "/metrics", "/status",
-                                   "/trace", "/submit", "/cancel"]})
+                                   "/trace", "/submit", "/cancel",
+                                   "/profile"]})
                     + "\n", "application/json")
                 return
             obs.http_requests.inc(path=path)
@@ -121,9 +138,11 @@ class ObsHttpd:
 
     def __init__(self, server=None, host: str = "127.0.0.1",
                  port: int = 0, registries=None,
-                 trace: tracelog.TraceLog | None = None):
+                 trace: tracelog.TraceLog | None = None,
+                 profile_dir: str | None = None):
         self.server = server
         self.trace_log = trace
+        self._profile_dir = profile_dir
         regs = list(registries) if registries is not None else []
         if not regs:
             if server is not None and getattr(server, "metrics", None) \
@@ -168,7 +187,7 @@ class ObsHttpd:
         return 200, json.dumps(
             {"service": "tpu_tree_search",
              "endpoints": ["/healthz", "/metrics", "/status", "/trace",
-                           "/submit", "/cancel"]}) + "\n", \
+                           "/submit", "/cancel", "/profile"]}) + "\n", \
             "application/json"
 
     def healthz(self):
@@ -232,6 +251,51 @@ class ObsHttpd:
             {"request_id": rid, "state": "QUEUED"}) + "\n", \
             "application/json"
 
+    @property
+    def profile_dir(self) -> str:
+        """The capture artifact root (created lazily): the configured
+        one, else ``<server workdir>/profiles``, else a temp dir."""
+        if self._profile_dir is None:
+            wd = getattr(self.server, "workdir", None)
+            if wd is not None:
+                self._profile_dir = str(wd / "profiles") \
+                    if hasattr(wd, "__truediv__") \
+                    else f"{wd}/profiles"
+            else:
+                import tempfile
+                self._profile_dir = tempfile.mkdtemp(
+                    prefix="tts_profiles_")
+        return self._profile_dir
+
+    def profile(self, query: dict):
+        """POST /profile?duration_s=N: capture-on-demand against the
+        live process. Returns the artifact directory; 409 while another
+        capture runs, 503 on a closing server, 400 on a bad duration."""
+        from ..utils import config as cfg
+        if self._closing():
+            return 503, json.dumps(
+                {"error": "server closing"}) + "\n", "application/json"
+        try:
+            duration_s = float(query.get("duration_s", 1.0))
+            if not 0 < duration_s <= cfg.PROFILE_MAX_DURATION_S:
+                raise ValueError(
+                    f"duration_s must be in (0, "
+                    f"{cfg.PROFILE_MAX_DURATION_S}]")
+        except (TypeError, ValueError) as e:
+            return 400, json.dumps({"error": str(e)}) + "\n", \
+                "application/json"
+        sess = profiler.session()
+        try:
+            artifact = sess.capture(duration_s,
+                                    sess.fresh_dir(self.profile_dir))
+        except profiler.ProfilerBusyError as e:
+            return 409, json.dumps({"error": str(e)}) + "\n", \
+                "application/json"
+        return 200, json.dumps(
+            {"artifact": artifact, "duration_s": duration_s,
+             "hint": "python tools/search_report.py <artifact>"}) \
+            + "\n", "application/json"
+
     def cancel(self, body: bytes):
         """POST /cancel: cancel a queued/running request by id."""
         if self.server is None:
@@ -258,10 +322,12 @@ class ObsHttpd:
 
 def start_http_server(server=None, host: str = "127.0.0.1",
                       port: int = 0, registries=None,
-                      trace: tracelog.TraceLog | None = None) -> ObsHttpd:
+                      trace: tracelog.TraceLog | None = None,
+                      profile_dir: str | None = None) -> ObsHttpd:
     """Start the observability HTTP front-end on `host:port` (port 0
     binds an ephemeral port — read ``.port``). Returns the running
     :class:`ObsHttpd`; call ``.close()`` (or use as a context manager)
     to stop it."""
     return ObsHttpd(server=server, host=host, port=port,
-                    registries=registries, trace=trace)
+                    registries=registries, trace=trace,
+                    profile_dir=profile_dir)

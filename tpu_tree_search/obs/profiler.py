@@ -1,0 +1,188 @@
+"""On-demand XLA profiler capture — the process's ONE profiling door.
+
+``jax.profiler.start_trace``/``stop_trace`` are process-global: two
+concurrent captures corrupt each other's artifact (the profiler writes
+one TensorBoard run dir at a time) and jax itself raises mid-capture.
+:class:`ProfilerSession` serializes them behind a non-blocking lock —
+one capture at a time, a second caller gets :class:`ProfilerBusyError`
+immediately (the HTTP front-end maps it to ``409 Conflict``) instead of
+a corrupted trace or a surprise exception from inside jax.
+
+Every profiler entry point in the repo routes through here — the
+``POST /profile`` endpoint on a live serve session (obs/httpd), the
+``profile`` CLI subcommand, ``tools/profile_step.py`` and
+``tools/validate_attribution.py`` — so the mutual exclusion holds
+across all of them. **No direct ``jax.profiler`` calls outside this
+module**; the trace-around-a-block helper that used to live in
+``utils/device_info.py`` is this module's :func:`trace`.
+
+Artifacts land as the standard TensorBoard profile layout
+(``<dir>/plugins/profile/<run>/*.trace.json.gz``), parseable by
+``obs/chrome_trace.load_xla_trace`` and renderable by
+``tools/search_report.py`` / ``tools/trace_selftime.py`` — self-time
+attribution next to the flight recorder's counter lanes. Each capture
+is itself flight-recorded (a ``profiler.capture`` span with the
+artifact path) and counted (``tts_profile_captures_total``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from . import metrics, tracelog
+
+__all__ = ["ProfilerBusyError", "ProfilerSession", "session", "trace",
+           "capture"]
+
+
+class ProfilerBusyError(RuntimeError):
+    """A capture is already running (the profiler is process-global and
+    strictly one-at-a-time); retry after it stops."""
+
+
+class ProfilerSession:
+    """Thread-safe one-at-a-time wrapper over the jax profiler.
+
+    ``start(log_dir)`` / ``stop()`` bracket a capture by hand (the HTTP
+    endpoint and the CLI use :meth:`capture`, the tools use the
+    :meth:`trace` context manager). A second ``start`` while a capture
+    runs raises :class:`ProfilerBusyError` without touching jax.
+    """
+
+    def __init__(self, registry=None):
+        self._lock = threading.Lock()
+        self._log_dir: str | None = None
+        self._t_start = 0.0
+        self._registry = registry
+        self._seq = 0
+
+    @property
+    def active(self) -> bool:
+        return self._log_dir is not None
+
+    @property
+    def log_dir(self) -> str | None:
+        return self._log_dir
+
+    def _counter(self):
+        reg = self._registry if self._registry is not None \
+            else metrics.default()
+        return reg.counter("tts_profile_captures_total",
+                           "completed on-demand profiler captures")
+
+    # ------------------------------------------------------------ start/stop
+
+    def start(self, log_dir: str | os.PathLike) -> str:
+        """Begin a capture into `log_dir` (created if needed); returns
+        the artifact root. Raises ProfilerBusyError when one is already
+        running — never corrupts an in-flight capture."""
+        import jax
+
+        if not self._lock.acquire(blocking=False):
+            raise ProfilerBusyError(
+                f"a profiler capture is already running "
+                f"(into {self._log_dir!r})")
+        log_dir = os.fspath(log_dir)
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            jax.profiler.start_trace(log_dir)
+        except BaseException:
+            self._lock.release()
+            raise
+        self._log_dir = log_dir
+        self._t_start = time.monotonic()
+        self._seq += 1
+        return log_dir
+
+    def stop(self) -> str:
+        """End the running capture; returns the artifact root (the
+        directory ``load_xla_trace`` parses). Raises RuntimeError when
+        no capture is running."""
+        import jax
+
+        if self._log_dir is None:
+            raise RuntimeError("no profiler capture is running")
+        log_dir = self._log_dir
+        dur = time.monotonic() - self._t_start
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._log_dir = None
+            self._lock.release()
+        tracelog.event("profiler.capture", logdir=log_dir,
+                       duration_s=round(dur, 3))
+        self._counter().inc()
+        return log_dir
+
+    # ------------------------------------------------------------ high level
+
+    @contextlib.contextmanager
+    def trace(self, log_dir: str | os.PathLike):
+        """Capture around a code block (the tools' idiom: warm up, then
+        trace exactly the timed window)."""
+        self.start(log_dir)
+        try:
+            yield
+        finally:
+            self.stop()
+
+    def capture(self, duration_s: float,
+                log_dir: str | os.PathLike) -> str:
+        """Timed capture: start, sleep `duration_s` while the workload
+        runs in its own threads, stop. Returns the artifact root. The
+        capture-on-demand primitive behind ``POST /profile`` — whatever
+        the devices execute during the window lands in the trace."""
+        self.start(log_dir)
+        try:
+            time.sleep(max(float(duration_s), 0.0))
+        finally:
+            log_dir = self.stop()
+        return log_dir
+
+    def fresh_dir(self, root: str | os.PathLike) -> str:
+        """A unique capture directory under `root` (each capture gets
+        its own TensorBoard run dir so artifacts never interleave).
+        The directory is CREATED here — reservation, not just a name —
+        so two racing callers can never be handed the same path."""
+        root = os.fspath(root)
+        os.makedirs(root, exist_ok=True)
+        stamp = time.strftime("%Y%m%d-%H%M%S")
+        base = os.path.join(root, f"capture-{stamp}")
+        path, n = base, 0
+        while True:
+            try:
+                os.makedirs(path, exist_ok=False)
+                return path
+            except FileExistsError:
+                n += 1
+                path = f"{base}-{n}"
+
+
+# ------------------------------------------------------- process singleton
+
+_session: ProfilerSession | None = None
+_session_lock = threading.Lock()
+
+
+def session() -> ProfilerSession:
+    """THE process-wide profiler session (the jax profiler is global, so
+    its guard must be too)."""
+    global _session
+    with _session_lock:
+        if _session is None:
+            _session = ProfilerSession()
+        return _session
+
+
+def trace(log_dir: str | os.PathLike):
+    """``session().trace(...)`` — the tools' one-liner (replaces the
+    deleted ``utils.device_info.trace``)."""
+    return session().trace(log_dir)
+
+
+def capture(duration_s: float, log_dir: str | os.PathLike) -> str:
+    """``session().capture(...)`` — timed capture-on-demand."""
+    return session().capture(duration_s, log_dir)

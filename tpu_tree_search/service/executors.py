@@ -1,4 +1,5 @@
-"""Compiled-executable cache for the search service.
+"""Compiled-executable cache for the search service, with a
+compile-cost ledger.
 
 The distributed loop costs seconds to minutes to trace + compile (the
 one-off cost utils/compile_cache amortizes ACROSS processes via XLA's
@@ -16,6 +17,19 @@ pays the compile, requests 2..10 start exploring immediately. The
 hit/miss counters ride the server's JSON status snapshot so the reuse is
 observable (and testable) in production, not assumed.
 
+The LEDGER makes the compile cost itself observable: every entry
+records its trace and compile wall seconds (measured on the entry's
+first invocation via the jit AOT path — ``fn.lower(...).compile()`` —
+so the cost is attributed to the entry, not smeared into whichever
+request happened to arrive first) and, where the backend supports
+``compiled.cost_analysis()``, the executable's FLOPs and
+bytes-accessed. The ledger rides ``status_snapshot()`` (the
+``compile_ledger`` key), feeds the ``tts_compile_seconds`` histogram
+on ``/metrics``, and renders as a table via
+``tools/compile_report.py``. When the AOT path is unsupported for a
+program, the entry falls back to timing the first call (compile
+dominated) and says so in its ``method`` field.
+
 Between this cache (same process) and compile_cache.enable() (XLA's
 persistent disk cache, same program shape across processes), a restarted
 server re-serves a warm traffic mix with ~1 s loads instead of ~45 s
@@ -25,6 +39,106 @@ compiles.
 from __future__ import annotations
 
 import threading
+import time
+
+from ..obs import tracelog
+
+
+class _Entry:
+    """One cached loop: the built callable plus its cost record. The
+    trace/compile measurement happens on the FIRST invocation (jit is
+    lazy — at build() time there is nothing to measure yet)."""
+
+    __slots__ = ("fn", "compiled", "record", "_lock", "_measured",
+                 "_on_measured")
+
+    def __init__(self, fn, record: dict, on_measured):
+        self.fn = fn
+        self.compiled = None
+        self.record = record
+        self._lock = threading.Lock()
+        self._measured = False
+        self._on_measured = on_measured
+
+    def __call__(self, *args):
+        if not self._measured:
+            with self._lock:
+                if not self._measured:
+                    return self._first_call(*args)
+        if self.compiled is not None:
+            try:
+                return self.compiled(*args)
+            except (TypeError, ValueError):
+                # AOT executables are stricter about argument layout
+                # than jit; if a later call stops matching, fall back
+                # to the jitted fn permanently (same trace -> the jit
+                # cache compiles once more, correctness unaffected)
+                self.compiled = None
+        return self.fn(*args)
+
+    def _first_call(self, *args):
+        rec = self.record
+        # ONLY lower/compile inside the try: a runtime failure of the
+        # compiled loop itself must propagate to the service retry tier
+        # (re-running it here would be a hidden second execution outside
+        # the retry accounting) and must not be booked as compile cost
+        try:
+            t0 = time.perf_counter()
+            lowered = self.fn.lower(*args)
+            t1 = time.perf_counter()
+            compiled = lowered.compile()
+            t2 = time.perf_counter()
+            rec.update(trace_s=round(t1 - t0, 6),
+                       compile_s=round(t2 - t1, 6),
+                       method="aot")
+            self._cost_analysis(compiled, rec)
+            self.compiled = compiled
+        except Exception:  # noqa: BLE001 — a backend/program that the
+            # AOT path cannot handle still serves through plain jit
+            self.compiled = compiled = None
+        if compiled is not None:
+            self._measured = True
+            self._record_measured()
+            return compiled(*args)
+        # fallback: the first jit call IS trace+compile (+ one execute)
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        rec.update(trace_s=0.0,
+                   compile_s=round(time.perf_counter() - t0, 6),
+                   method="first_call")
+        self._measured = True
+        self._record_measured()
+        return out
+
+    def _record_measured(self) -> None:
+        rec = self.record
+        tracelog.event("executor.compile", key=rec["key"],
+                       trace_s=rec["trace_s"],
+                       compile_s=rec["compile_s"],
+                       method=rec["method"], flops=rec.get("flops"))
+        if self._on_measured is not None:
+            self._on_measured(rec)
+
+    @staticmethod
+    def _cost_analysis(compiled, rec: dict) -> None:
+        try:
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                if ca.get("flops") is not None:
+                    rec["flops"] = float(ca["flops"])
+                if ca.get("bytes accessed") is not None:
+                    rec["bytes_accessed"] = float(ca["bytes accessed"])
+        except Exception:  # noqa: BLE001 — optional per backend
+            pass
+        try:
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                rec["temp_bytes"] = int(
+                    getattr(mem, "temp_size_in_bytes", 0))
+        except Exception:  # noqa: BLE001
+            pass
 
 
 class ExecutorCache:
@@ -40,13 +154,15 @@ class ExecutorCache:
 
     def __init__(self, registry=None):
         self._lock = threading.Lock()
-        self._fns: dict[tuple, object] = {}
+        self._fns: dict[tuple, _Entry] = {}
         self.hits = 0
         self.misses = 0
         # optional metrics mirror (obs/metrics.Registry): the server
         # passes its per-server registry so /metrics exposes the same
-        # hit/miss counts the JSON snapshot reports
+        # hit/miss counts the JSON snapshot reports, plus the
+        # compile-cost histogram the ledger feeds
         self._hits_c = self._misses_c = self._entries_g = None
+        self._compile_h = None
         if registry is not None:
             self._hits_c = registry.counter(
                 "tts_executor_cache_hits_total",
@@ -58,28 +174,61 @@ class ExecutorCache:
                 "tts_executor_cache_entries",
                 "distinct compiled loops held")
             self._entries_g.set_fn(lambda: len(self))
+            self._compile_h = registry.histogram(
+                "tts_compile_seconds",
+                "trace+compile wall seconds per new executable")
+
+    def _measured(self, record: dict) -> None:
+        if self._compile_h is not None:
+            self._compile_h.observe(record["trace_s"]
+                                    + record["compile_s"])
 
     def get_or_build(self, key: tuple, build):
         with self._lock:
-            fn = self._fns.get(key)
-            if fn is not None:
+            entry = self._fns.get(key)
+            if entry is not None:
                 self.hits += 1
                 if self._hits_c is not None:
                     self._hits_c.inc()
-                return fn
+                return entry
             self.misses += 1
             if self._misses_c is not None:
                 self._misses_c.inc()
+            t0 = time.perf_counter()
             fn = build()
-            self._fns[key] = fn
-            return fn
+            record = {
+                "key": _key_repr(key),
+                "build_s": round(time.perf_counter() - t0, 6),
+                # filled in on the entry's first invocation
+                "trace_s": None, "compile_s": None, "method": None,
+                "created_unix": time.time(),
+            }
+            entry = self._fns[key] = _Entry(fn, record, self._measured)
+            return entry
 
     def __len__(self) -> int:
         with self._lock:
             return len(self._fns)
 
     def snapshot(self) -> dict:
-        """JSON-safe stats for the status API."""
+        """JSON-safe stats for the status API. (Schema frozen — the
+        ledger rides status_snapshot()'s own `compile_ledger` key, see
+        ledger_snapshot().)"""
         with self._lock:
             return {"entries": len(self._fns), "hits": self.hits,
                     "misses": self.misses}
+
+    def ledger_snapshot(self) -> list[dict]:
+        """Per-entry compile-cost records, oldest first. `trace_s` /
+        `compile_s` are None until the entry's first invocation has
+        measured them."""
+        with self._lock:
+            entries = list(self._fns.values())
+        return sorted((dict(e.record) for e in entries),
+                      key=lambda r: r["created_unix"])
+
+
+def _key_repr(key: tuple) -> str:
+    """A stable human-readable form of a cache key (tuples of scalars
+    by construction; keep it JSON-safe)."""
+    return "/".join(str(k) for k in key)

@@ -62,6 +62,7 @@ import time
 import numpy as np
 
 from ..obs import metrics as obs_metrics
+from ..obs import resource as obs_resource
 from ..obs import tracelog
 from ..utils import config as cfg
 from ..utils import faults
@@ -124,7 +125,8 @@ class SearchServer:
                  service_retry_base_s: float =
                  cfg.SERVICE_RETRY_BASE_S_DEFAULT,
                  autostart: bool = True,
-                 phase_profile=None):
+                 phase_profile=None,
+                 resource_sample_s: float | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -176,6 +178,23 @@ class SearchServer:
                                  if s.record is not None))
         self.queue = RequestQueue(max_queue_depth)
         self.cache = ExecutorCache(registry=self.metrics)
+        # resource observability: per-device bytes-in-use/peak + host
+        # RSS gauges on THIS server's registry (so /metrics carries
+        # them) plus memory counter lanes in the trace log; the daemon
+        # thread samples on its own cadence, close() retires the series
+        if resource_sample_s is None:
+            resource_sample_s = float(os.environ.get(
+                "TTS_RESOURCE_SAMPLE_S",
+                str(cfg.OBS_RESOURCE_SAMPLE_S_DEFAULT)))
+        self.resources = obs_resource.ResourceSampler(
+            registry=self.metrics, period_s=resource_sample_s)
+        if resource_sample_s > 0:
+            # one sweep up front: the gauges must exist from the first
+            # scrape, not only after the first period elapses
+            try:
+                self.resources.sample()
+            except Exception:  # noqa: BLE001 — observability extra
+                pass
         self.segment_iters = segment_iters
         self.checkpoint_every = checkpoint_every
         self.poll_s = poll_s
@@ -251,6 +270,9 @@ class SearchServer:
                 if rec.state == QUEUED:
                     self._finalize(rec, CANCELLED, error="server shutdown")
                 rec.done_event.set()
+        # stop the resource sampler and retire its gauge series — a
+        # closed server must not keep publishing (or holding) them
+        self.resources.close()
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -398,6 +420,7 @@ class SearchServer:
                      "running": s.record.id if s.record else None}
                     for s in self.slots],
                 "executor_cache": self.cache.snapshot(),
+                "compile_ledger": self.cache.ledger_snapshot(),
                 "counters": self.counters,
                 "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()

@@ -47,6 +47,16 @@ SERVICE_RETRY_BASE_S_DEFAULT = 0.2    # re-dispatch backoff base
 # front-end is wired per entry point (`serve --http-port`), never
 # ambiently — an open port must be an explicit operator choice.
 OBS_TRACE_RING_DEFAULT = 16384        # ring-buffer records kept in RAM
+OBS_RESOURCE_SAMPLE_S_DEFAULT = 1.0   # serve-session resource-sampler
+                                      # cadence (obs/resource): device
+                                      # bytes-in-use/peak + host RSS
+                                      # gauges and memory trace lanes;
+                                      # TTS_RESOURCE_SAMPLE_S overrides,
+                                      # <= 0 disables the daemon thread
+PROFILE_MAX_DURATION_S = 300.0        # POST /profile duration ceiling —
+                                      # a typo'd duration must not pin
+                                      # the profiler (and its artifact
+                                      # growth) for hours
 
 
 @dataclasses.dataclass

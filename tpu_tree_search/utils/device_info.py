@@ -1,16 +1,27 @@
-"""Device introspection (the reference's gpu_info, common/gpu_util.cu:5-17,
-re-expressed for the JAX device model) plus profiler hooks.
+"""Device/host introspection (the reference's gpu_info,
+common/gpu_util.cu:5-17, re-expressed for the JAX device model).
 
-The reference instruments phases with omp_get_wtime() brackets and a
-manual FLOP model (SURVEY.md §5). Here the compiled loop is opaque to
-host timers, so the profiling story is `jax.profiler` traces (`trace`
-below — inspect with TensorBoard or xprof) plus the engine's device-side
-counters (tree/sol/evals/sent/recv/steals per worker).
+Three jobs, all read-only:
+
+- platform plumbing: :func:`apply_platform_override` (the ONE copy of
+  the sitecustomize-safe platform flip) and :func:`resolve_backend`
+  (the bench driver's degrade-don't-die backend bootstrap);
+- memory introspection: :func:`memory_snapshot` (per-device
+  bytes-in-use/peak/limit, with a live-array fallback for backends
+  like CPU whose ``memory_stats()`` returns nothing) and
+  :func:`host_rss_bytes` — the read path under
+  ``obs/resource.ResourceSampler``'s gauges and memory lanes;
+- human-readable :func:`describe_devices` / :func:`print_device_info`
+  (the CLI ``devices`` subcommand).
+
+Profiling does NOT live here any more: the trace-around-a-block helper
+moved to ``obs/profiler.trace`` (one-at-a-time session semantics; no
+direct ``jax.profiler`` calls outside ``obs/``).
 """
 
 from __future__ import annotations
 
-import contextlib
+import os
 
 import jax
 
@@ -23,11 +34,45 @@ def apply_platform_override() -> None:
     workers, test children, __graft_entry__ — call it before their
     first backend touch; without it "CPU" subprocesses silently run on
     the live TPU)."""
-    import os
-
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "axon" not in want and "tpu" not in want:
         jax.config.update("jax_platforms", want)
+
+
+def resolve_backend(probe=None, _update=None) -> tuple[str, bool]:
+    """Initialize SOME usable backend; returns ``(platform, degraded)``.
+
+    The bench driver's bootstrap: when the default backend fails to
+    come up (the ``RuntimeError: Unable to initialize backend`` every
+    ``BENCH_r0*.json`` tail showed on TPU-less hosts), fall back to
+    automatic selection (``JAX_PLATFORMS=''`` — the failed backend's
+    error is cached, so this lands on whatever works) and then to
+    ``cpu`` explicitly. ``degraded=True`` means the run is NOT on the
+    platform the environment asked for — callers must say so in their
+    output instead of reporting a CPU rate as a TPU rate.
+
+    `probe`/`_update` exist for tests (inject a failing probe without
+    flipping the live process's real platform config)."""
+    if probe is None:
+        probe = jax.default_backend
+    if _update is None:
+        def _update(plats: str) -> None:
+            os.environ["JAX_PLATFORMS"] = plats
+            jax.config.update("jax_platforms", plats)
+    try:
+        return probe(), False
+    except RuntimeError:
+        pass
+    last: RuntimeError | None = None
+    for plats in ("", "cpu"):
+        try:
+            _update(plats)
+            return probe(), True
+        except RuntimeError as e:
+            last = e
+            continue
+    raise RuntimeError(
+        f"no usable JAX backend (tried default, '', 'cpu'): {last}")
 
 
 def describe_devices() -> list[dict]:
@@ -52,6 +97,71 @@ def describe_devices() -> list[dict]:
     return out
 
 
+def _live_array_bytes() -> dict:
+    """Live jax-array bytes per device id — the memory fallback for
+    backends whose memory_stats() reports nothing (the CPU mesh the
+    test suite runs on). Sharded arrays charge each shard to its own
+    device."""
+    out: dict = {}
+    try:
+        arrays = jax.live_arrays()
+    except Exception:  # noqa: BLE001 — introspection must never raise
+        return out
+    for a in arrays:
+        try:
+            for s in a.addressable_shards:
+                out[s.device.id] = out.get(s.device.id, 0) \
+                    + int(getattr(s.data, "nbytes", 0))
+        except Exception:  # noqa: BLE001 — deleted/donated arrays race
+            continue
+    return out
+
+
+def memory_snapshot() -> list[dict]:
+    """Per-device memory record for the resource sampler: ``id``,
+    ``platform``, ``bytes_in_use`` (backend-reported, else live-array
+    bytes), ``peak_bytes_in_use``/``bytes_limit`` when the backend
+    reports them (None keys are omitted)."""
+    fallback = None
+    out = []
+    for d in jax.devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API
+            stats = None
+        rec = {"id": int(d.id), "platform": d.platform}
+        if stats:
+            rec["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            for src, dst in (("peak_bytes_in_use", "peak_bytes_in_use"),
+                             ("bytes_limit", "bytes_limit")):
+                if stats.get(src) is not None:
+                    rec[dst] = int(stats[src])
+        else:
+            if fallback is None:
+                fallback = _live_array_bytes()
+            rec["bytes_in_use"] = int(fallback.get(d.id, 0))
+        out.append(rec)
+    return out
+
+
+def host_rss_bytes() -> int | None:
+    """This process's resident set size in bytes (Linux /proc, with a
+    getrusage fallback); None when neither source exists."""
+    try:
+        with open("/proc/self/statm") as f:
+            rss_pages = int(f.read().split()[1])
+        return rss_pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+        rss_kib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return int(rss_kib) * 1024      # peak, not current — best effort
+    except Exception:  # noqa: BLE001
+        return None
+
+
 def print_device_info() -> None:
     for rec in describe_devices():
         line = (f"Device {rec['id']}: {rec['platform']} ({rec['kind']}) "
@@ -60,13 +170,3 @@ def print_device_info() -> None:
             line += (f", HBM {(rec.get('bytes_in_use') or 0) / 2**30:.2f}/"
                      f"{rec['bytes_limit'] / 2**30:.2f} GiB")
         print(line)
-
-
-@contextlib.contextmanager
-def trace(log_dir: str):
-    """jax.profiler trace around a code block."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
