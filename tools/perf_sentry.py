@@ -222,6 +222,29 @@ def judge(sources: list[dict], history: dict,
     return verdicts
 
 
+def render_json(verdicts: list[dict], latest_round: int) -> dict:
+    """Machine-readable verdict (written next to the markdown report):
+    the schema the CI leg uploads and the health layer's `perf` rule
+    ingests (obs/health.py, TTS_HEALTH_PERF_JSON)."""
+    n_fail = sum(v["verdict"] == FAIL for v in verdicts)
+    return {
+        "schema": 1,
+        "round": latest_round if latest_round >= 0 else None,
+        "verdict": FAIL if n_fail else PASS,
+        "n_findings": len(verdicts),
+        "n_fail": n_fail,
+        "reasons": [f"{v.get('source')}: {v.get('metric', '-')} "
+                    f"{v['detail']}"
+                    for v in verdicts if v["verdict"] == FAIL],
+        "metrics": [
+            {k: v.get(k) for k in
+             ("verdict", "source", "metric", "value", "reference",
+              "reference_source", "delta", "threshold", "platform",
+              "degraded", "detail")}
+            for v in verdicts],
+    }
+
+
 def render_markdown(verdicts: list[dict]) -> str:
     n_fail = sum(v["verdict"] == FAIL for v in verdicts)
     lines = ["# Perf sentry", "",
@@ -273,6 +296,11 @@ def main(argv=None) -> int:
                          "is CPU-only); the report still says FAIL")
     ap.add_argument("--out", default=None,
                     help="also write the markdown summary here")
+    ap.add_argument("--json", default=None, dest="json_out",
+                    help="also write the machine-readable verdict here "
+                         "(schema: round, per-metric deltas, verdict, "
+                         "reasons — the health layer's `perf` rule "
+                         "ingests it via TTS_HEALTH_PERF_JSON)")
     args = ap.parse_args(argv)
 
     overrides = {}
@@ -310,6 +338,11 @@ def main(argv=None) -> int:
         with open(args.out, "w") as f:
             f.write(md)
         print(f"# wrote {args.out}", file=sys.stderr)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(render_json(verdicts, latest_round), f, indent=1)
+            f.write("\n")
+        print(f"# wrote {args.json_out}", file=sys.stderr)
 
     n_fail = sum(v["verdict"] == FAIL for v in verdicts)
     if n_fail and not args.report_only:

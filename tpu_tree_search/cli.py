@@ -181,6 +181,13 @@ def _serve_parser(sub):
                         "gauges + Perfetto memory lanes; default "
                         "1.0, also via TTS_RESOURCE_SAMPLE_S; <= 0 "
                         "disables)")
+    p.add_argument("--health-interval-s", type=float, default=None,
+                   help="health rules-engine evaluation cadence in "
+                        "seconds (obs/health: /alerts, /dashboard, "
+                        "tts_alerts gauges; default "
+                        f"{_cfg.OBS_HEALTH_INTERVAL_S_DEFAULT}, also "
+                        "via TTS_HEALTH_INTERVAL_S; <= 0 disables "
+                        "the daemon — thresholds via TTS_HEALTH_*)")
 
 
 def _client_parser(sub):
@@ -225,7 +232,8 @@ def run_serve(args) -> int:
                           segment_iters=args.segment_iters,
                           phase_profile=(True if args.phase_metrics
                                          else None),
-                          resource_sample_s=args.resource_sample_s
+                          resource_sample_s=args.resource_sample_s,
+                          health_interval_s=args.health_interval_s
                           ) as srv:
             if args.http_port is not None:
                 from .obs.httpd import start_http_server
@@ -233,8 +241,8 @@ def run_serve(args) -> int:
                                           port=args.http_port,
                                           profile_dir=args.profile_dir)
                 print(f"observability: {httpd.url}/healthz /metrics "
-                      "/status /trace; POST /submit /cancel "
-                      "/profile?duration_s=N",
+                      "/status /trace /alerts /dashboard; "
+                      "POST /submit /cancel /profile?duration_s=N",
                       flush=True)
             print(f"serving: {args.submeshes} submesh(es) x "
                   f"{srv.slots[0].mesh.devices.size} device(s), "
@@ -346,6 +354,64 @@ def run_profile(args) -> int:
               f"[{chrome_trace.bucket_of(name):>15}]  {name[:90]}")
     print(f"\n# artifact: {log_dir}")
     return 0
+
+
+def _doctor_parser(sub):
+    p = sub.add_parser(
+        "doctor",
+        help="one-shot fleet health verdict: scrape N servers' "
+             "/healthz /status /metrics /alerts (obs/aggregate), "
+             "print the judgment, exit nonzero on any unreachable "
+             "server or firing alert")
+    p.add_argument("urls", nargs="+", metavar="URL",
+                   help="server base URLs (http://host:port)")
+    p.add_argument("--json", action="store_true",
+                   help="print the merged fleet view as JSON instead "
+                        "of the human table")
+    p.add_argument("--dashboard", type=str, default=None,
+                   help="also render the fleet dashboard HTML here "
+                        "(obs/dashboard; self-contained, no external "
+                        "assets — CI uploads it as an artifact)")
+    p.add_argument("--metrics-out", type=str, default=None,
+                   help="also write the merged, origin-labeled "
+                        "Prometheus exposition here (one aggregated "
+                        "scrape target for the fleet)")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-endpoint scrape timeout in seconds")
+
+
+def run_doctor(args) -> int:
+    import json
+
+    from .obs import aggregate, dashboard
+
+    fleet = aggregate.scrape(args.urls, timeout=args.timeout)
+    merged = aggregate.merge(fleet)
+    healthy, reasons = aggregate.verdict(merged)
+    if args.dashboard:
+        with open(args.dashboard, "w") as f:
+            f.write(dashboard.render_fleet(merged))
+        print(f"# wrote {args.dashboard}", file=sys.stderr)
+    if args.metrics_out:
+        with open(args.metrics_out, "w") as f:
+            f.write(aggregate.fleet_to_prometheus(merged))
+        print(f"# wrote {args.metrics_out}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"healthy": healthy, "reasons": reasons,
+                          **{k: v for k, v in merged.items()
+                             if k != "metrics"}}, indent=1))
+    else:
+        for s in merged["servers"]:
+            mark = ("ok" if s["ok"] and s["healthz"] == "ok"
+                    and not s.get("firing") else "UNHEALTHY")
+            print(f"{s['origin']:<24} {mark:<10} "
+                  f"firing={s.get('firing')} "
+                  f"queue={s.get('queue_depth')} "
+                  f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
+                  f"requests={s.get('requests')}")
+        print("healthy" if healthy else
+              "UNHEALTHY:\n  " + "\n  ".join(reasons))
+    return 0 if healthy else 1
 
 
 def _nq_parser(sub):
@@ -789,6 +855,7 @@ def main(argv=None) -> int:
     _serve_parser(sub)
     _client_parser(sub)
     _profile_parser(sub)
+    _doctor_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
                         "gpu_info, common/gpu_util.cu:5-17)")
@@ -801,6 +868,10 @@ def main(argv=None) -> int:
     rp.add_argument("--rate", type=float, default=None,
                     help="measured node-evals/s to compare to the ceiling")
     args = ap.parse_args(argv)
+    if args.cmd == "doctor":
+        # pure scraper: skip the compile cache / backend bootstrap —
+        # the doctor must never touch (or wait for) an accelerator
+        return run_doctor(args)
     if args.platform:
         # Env vars alone are read too early (the environment preloads jax
         # via sitecustomize); flip the platform through jax.config.

@@ -775,6 +775,13 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     def do_save(s, seg_no):
         _retry(lambda: save(checkpoint_path, s, meta=meta_now(seg_no)),
                "checkpoint save", retry_attempts, retry_base_s)
+        # audit hook (TTS_AUDIT=full / TTS_AUDIT_CKPT=1): re-read the
+        # snapshot and require bit-identical counters — BEFORE the
+        # fault injection below, which may corrupt the file on purpose
+        # to exercise the load-side rollback
+        from ..obs import audit as obs_audit
+        if obs_audit.roundtrip_enabled():
+            obs_audit.check_checkpoint_roundtrip(checkpoint_path, s)
         # torn-write / corruption injection targets the just-written
         # file — the load-side rollback to last-good is what it tests
         faults.fire("post_checkpoint", segment=seg_no,

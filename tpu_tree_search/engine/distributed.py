@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..obs import audit as obs_audit
 from ..obs import tracelog
 from ..ops import batched, reference as ref
 from ..ops.batched import BoundTables
@@ -656,7 +657,15 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 f"resharding checkpoint {checkpoint_path} from "
                 f"{old_workers} to {n_dev} workers (elastic resume)",
                 RuntimeWarning, stacklevel=2)
+            # audit hook: the elastic reshard must conserve every
+            # summed counter, the pooled node count and the incumbent
+            # (obs/audit — a drift here is silent wrong answers later)
+            pre_sums = (obs_audit.state_sums(host_state)
+                        if obs_audit.enabled() else None)
             host_state = checkpoint.reshard_state(host_state, n_dev)
+            if pre_sums is not None:
+                obs_audit.check_reshard(pre_sums, host_state,
+                                        edge="elastic_resume")
         # re-home into a capacity whose usable-row limit (scratch margin
         # + balance headroom) covers the fullest resharded pool
         cap0 = cap = host_state.prmu.shape[-1]
@@ -800,7 +809,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     telemetry = None
     if out.telemetry.shape[-1] > 0:
         telemetry = tele.summarize(_fetch(out.telemetry))
-    return DistResult(
+    res = DistResult(
         explored_tree=int(tree_dev.sum()) + fr.tree + h_tree,
         explored_sol=int(sol_dev.sum()) + fr.sol + h_sol,
         best=best,
@@ -818,3 +827,11 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         warmup_tree=fr.tree, warmup_sol=fr.sol,
         complete=int(sizes.sum()) == 0,
     )
+    if obs_audit.enabled():
+        # node-conservation audit on every result (host-side sums over
+        # already-fetched counters — microseconds against a search);
+        # failures surface as audit.fail events, tts_audit_failures
+        # counters and the health layer's `audit` alert (or raise
+        # under TTS_AUDIT_HARD=1)
+        obs_audit.check_result(res)
+    return res

@@ -30,14 +30,28 @@ on which submesh*. This package is that recorder:
 - :mod:`~tpu_tree_search.obs.resource` — device-memory / host-RSS
   sampler: ``tts_device_bytes_*`` and ``tts_host_rss_bytes`` gauges
   plus ``resource.sample`` trace events rendered as Perfetto memory
-  lanes.
+  lanes;
+- :mod:`~tpu_tree_search.obs.health` — the operational judge: an
+  SLO/anomaly rules engine with a pending→firing→resolved alert
+  lifecycle (``tts_alerts`` gauges, ``alert.*`` trace events,
+  ``GET /alerts``);
+- :mod:`~tpu_tree_search.obs.audit` — node-conservation auditor:
+  machine-checked engine invariants (telemetry-vs-counter exactness,
+  reshard/checkpoint conservation) surfaced as the `audit` alert rule,
+  with a hard-fail CI mode;
+- :mod:`~tpu_tree_search.obs.aggregate` — fleet scrape-and-merge of N
+  servers' ``/metrics`` + ``/status`` + ``/alerts`` into one
+  origin-labeled view (the ``doctor`` CLI's input);
+- :mod:`~tpu_tree_search.obs.dashboard` — self-contained HTML
+  dashboard (``GET /dashboard``; stdlib only, no external assets).
 
 Everything here is observation-only: instrumentation records
 timestamps and counters, it never changes what the engine explores —
 served node counts stay bit-identical with the recorder on or off.
 """
 
-from . import chrome_trace, metrics, profiler, resource, tracelog  # noqa: F401
+from . import (aggregate, audit, chrome_trace, dashboard,  # noqa: F401
+               health, metrics, profiler, resource, tracelog)
 
 __all__ = ["tracelog", "metrics", "chrome_trace", "profiler",
-           "resource"]
+           "resource", "health", "audit", "aggregate", "dashboard"]

@@ -1,0 +1,197 @@
+"""Fleet aggregation: scrape N servers' observability into one view.
+
+One SearchServer exposes ``/healthz`` ``/metrics`` ``/status``
+``/alerts``; a pod runs many. This module is the control-plane
+groundwork for the multi-host arc (ROADMAP item 1): scrape every
+server, label everything by its origin, and merge into a single fleet
+snapshot the ``doctor`` CLI judges and ``obs/dashboard.py`` renders.
+Stdlib only (``urllib``) — the aggregator must run anywhere a shell
+does, including the CI doctor-smoke leg.
+
+The pieces:
+
+- :func:`parse_prometheus` — text exposition -> ``(name, labels,
+  value)`` samples (the inverse of metrics.Registry.to_prometheus,
+  enough of the format for our own output);
+- :func:`scrape_one` / :func:`scrape` — fetch one/many servers'
+  endpoints; a down server becomes ``ok: False`` with the error, never
+  an exception (a fleet view that dies when one member does is
+  useless exactly when it is needed);
+- :func:`merge` — one fleet dict: per-server verdict rows, all
+  requests and firing alerts with an ``origin`` field, and every
+  metric sample re-labeled ``{origin="host:port"}``;
+- :func:`fleet_to_prometheus` — the merged samples back out as text
+  exposition (feed a real Prometheus one aggregated target);
+- :func:`verdict` — the doctor's judgment: healthy iff every server
+  scraped, answered healthz 200, and has zero firing alerts.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+__all__ = ["parse_prometheus", "scrape_one", "scrape", "merge",
+           "fleet_to_prometheus", "verdict"]
+
+
+def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
+    """Parse text exposition into (name, labels, value) samples.
+    Comment/blank lines skip; unparseable lines skip (a scraper must
+    not die on one odd sample)."""
+    out = []
+    for ln in text.splitlines():
+        ln = ln.strip()
+        if not ln or ln.startswith("#"):
+            continue
+        try:
+            body, _, val = ln.rpartition(" ")
+            if "{" in body:
+                name, _, rest = body.partition("{")
+                labels = {}
+                for pair in _split_labels(rest.rstrip("}")):
+                    k, _, v = pair.partition("=")
+                    labels[k.strip()] = v.strip().strip('"')
+            else:
+                name, labels = body, {}
+            out.append((name.strip(), labels,
+                        float("inf") if val == "+Inf" else float(val)))
+        except ValueError:
+            continue
+    return out
+
+
+def _split_labels(s: str) -> list[str]:
+    """Split `a="x",b="y,z"` on commas outside quotes."""
+    parts, buf, in_q = [], [], False
+    for ch in s:
+        if ch == '"':
+            in_q = not in_q
+        if ch == "," and not in_q:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    if buf:
+        parts.append("".join(buf))
+    return [p for p in parts if p.strip()]
+
+
+def _get(url: str, timeout: float):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def scrape_one(url: str, timeout: float = 5.0) -> dict:
+    """Scrape one server's /healthz /status /metrics /alerts. `url` is
+    the base (http://host:port). Never raises: an unreachable server
+    returns ``ok: False`` with the error string."""
+    url = url.rstrip("/")
+    origin = url.split("://", 1)[-1]
+    out = {"origin": origin, "url": url, "ok": True, "error": None,
+           "healthz": None, "status": None, "alerts": None,
+           "metrics": []}
+    try:
+        code, body = _get(url + "/healthz", timeout)
+        out["healthz"] = {"code": code, **json.loads(body)}
+    except urllib.error.HTTPError as e:
+        # a draining server answers 503 — that is a health FACT, not a
+        # scrape failure
+        try:
+            out["healthz"] = {"code": e.code, **json.loads(e.read())}
+        except (ValueError, OSError):
+            out["healthz"] = {"code": e.code}
+    except (OSError, ValueError) as e:
+        out.update(ok=False, error=f"healthz: {e}")
+        return out
+    for key, path, parse in (("status", "/status", json.loads),
+                             ("alerts", "/alerts", json.loads),
+                             ("metrics", "/metrics", parse_prometheus)):
+        try:
+            code, body = _get(url + path, timeout)
+            out[key] = parse(body)
+        except (OSError, ValueError) as e:
+            # /alerts may not exist on an older server; only the core
+            # endpoints are load-bearing for the fleet view
+            if key == "alerts":
+                out[key] = None
+            else:
+                out.update(ok=False, error=f"{path}: {e}")
+                return out
+    return out
+
+
+def scrape(urls: list[str], timeout: float = 5.0) -> dict:
+    """Scrape every server; returns {"t", "servers": [scrape_one...]}"""
+    return {"t": time.time(),
+            "servers": [scrape_one(u, timeout=timeout) for u in urls]}
+
+
+def merge(fleet: dict) -> dict:
+    """Fold a `scrape()` result into one fleet view (see module doc)."""
+    servers, requests, alerts, samples = [], [], [], []
+    for s in fleet["servers"]:
+        origin = s["origin"]
+        row = {"origin": origin, "ok": s["ok"], "error": s["error"],
+               "healthz": (s["healthz"] or {}).get("status"),
+               "firing": None, "queue_depth": None, "submeshes": None,
+               "submeshes_busy": None, "requests": 0, "uptime_s": None}
+        st = s.get("status")
+        if st:
+            row["uptime_s"] = st.get("uptime_s")
+            row["queue_depth"] = (st.get("queue") or {}).get("depth")
+            subs = st.get("submeshes") or []
+            row["submeshes"] = len(subs)
+            row["submeshes_busy"] = sum(
+                1 for m in subs if m.get("running"))
+            reqs = st.get("requests") or {}
+            row["requests"] = len(reqs)
+            for rid, snap in reqs.items():
+                requests.append({"origin": origin, **snap})
+        al = s.get("alerts")
+        if al is not None:
+            row["firing"] = al.get("firing", 0)
+            for a in al.get("alerts", []):
+                alerts.append({"origin": origin, **a})
+        for name, labels, value in s.get("metrics") or []:
+            samples.append((name, {**labels, "origin": origin}, value))
+        servers.append(row)
+    firing = [a for a in alerts if a.get("state") == "firing"]
+    return {"t": fleet["t"], "servers": servers, "requests": requests,
+            "alerts": alerts, "firing": len(firing),
+            "metrics": samples}
+
+
+def fleet_to_prometheus(merged: dict) -> str:
+    """Re-render the merged samples as text exposition (origin-labeled;
+    types are lost in the roundtrip, so everything exports untyped)."""
+    lines = []
+    for name, labels, value in merged["metrics"]:
+        inner = ",".join(f'{k}="{v}"'
+                         for k, v in sorted(labels.items()))
+        v = "+Inf" if value == float("inf") else (
+            str(int(value)) if float(value).is_integer() else repr(value))
+        lines.append(f"{name}{{{inner}}} {v}")
+    return "\n".join(lines) + "\n"
+
+
+def verdict(merged: dict) -> tuple[bool, list[str]]:
+    """The doctor's judgment: (healthy, reasons). Healthy iff every
+    server scraped, healthz says ok, and zero alerts are firing."""
+    reasons = []
+    for s in merged["servers"]:
+        if not s["ok"]:
+            reasons.append(f"{s['origin']}: unreachable ({s['error']})")
+        elif s["healthz"] not in ("ok",):
+            reasons.append(f"{s['origin']}: healthz={s['healthz']!r}")
+        if s.get("firing"):
+            reasons.append(f"{s['origin']}: {s['firing']} firing "
+                           "alert(s)")
+    for a in merged["alerts"]:
+        if a.get("state") == "firing":
+            reasons.append(
+                f"{a['origin']}: [{a.get('severity')}] {a.get('rule')} "
+                f"{json.dumps(a.get('detail', {}), sort_keys=True)}")
+    return (not reasons), reasons

@@ -1,0 +1,307 @@
+"""Node-conservation auditor: machine-checked engine invariants.
+
+The reference engine's only correctness story is "the explored-tree
+count matches the paper's table"; the repo's golden suite pins the same
+thing offline. This module checks the *live* invariants — the node
+accounting identities that must hold at every segment, result, and
+checkpoint/elastic-reshard/preempt-resume edge — and records every
+check as a :class:`Finding`, so an accounting drift surfaces as a
+machine-readable audit failure (and an `audit` health alert,
+obs/health.py) instead of a wrong answer a human notices weeks later.
+
+Invariants (exact equalities, not tolerances):
+
+- ``children_conservation`` — every evaluated child is branched, pruned
+  or a leaf: ``branched + pruned + sol == evals`` (telemetry bucket
+  sums vs. engine counters; needs the telemetry block compiled in);
+- ``branched_is_tree`` — telemetry's branched total equals the engine's
+  explored-tree counter; the bound histograms bin exactly the pruned /
+  surviving children;
+- ``steal_flow`` — telemetry steal sent/recv equals the balance tier's
+  sent/recv counters;
+- ``node_conservation`` — a result's totals decompose exactly into
+  warm-up + device + host-tier counts, and ``complete`` is true iff
+  every pool drained;
+- ``reshard_conservation`` — an elastic reshard (N -> M workers)
+  preserves every summed counter, the pooled node count and the
+  incumbent;
+- ``checkpoint_roundtrip`` — a just-written checkpoint loads back with
+  bit-identical counters (CRC-level corruption surfaces as a failure,
+  not a silently wrong resume).
+
+Wiring: ``engine/distributed.search`` audits every result and every
+elastic-reshard resume when :func:`enabled` (``TTS_AUDIT``, default on
+— the checks are host-side numpy sums, microseconds against a search);
+``checkpoint.run_segmented`` re-reads and verifies each snapshot when
+:func:`roundtrip_enabled` (``TTS_AUDIT=full`` / ``TTS_AUDIT_CKPT=1`` —
+off by default: it re-reads the file it just wrote). ``TTS_AUDIT_HARD=1``
+turns any failure into a raised :class:`AuditError` — the CI mode where
+an accounting drift fails the build instead of filing an alert.
+
+Every check lands in the process-global metrics registry
+(``tts_audit_checks_total`` / ``tts_audit_failures_total`` by
+invariant) and the flight recorder (``audit.check`` events, failures
+flagged); :func:`recent_failures` is the read side the health layer's
+`audit` rule consumes.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+
+from . import metrics, tracelog
+
+__all__ = ["AuditError", "Finding", "enabled", "hard", "roundtrip_enabled",
+           "record", "findings", "recent_failures", "clear_findings",
+           "check_result", "check_state", "state_sums", "check_reshard",
+           "check_checkpoint_roundtrip"]
+
+
+class AuditError(RuntimeError):
+    """An engine invariant failed under TTS_AUDIT_HARD=1."""
+
+
+@dataclasses.dataclass
+class Finding:
+    invariant: str
+    ok: bool
+    detail: dict
+    t_unix: float
+
+    def to_json(self) -> dict:
+        return {"invariant": self.invariant, "ok": self.ok,
+                "detail": self.detail, "t_unix": self.t_unix}
+
+
+# recent findings, process-wide: the health layer's `audit` rule and
+# /alerts read this ring; bounded so a flapping invariant cannot leak
+_FINDINGS: collections.deque[Finding] = collections.deque(maxlen=256)
+_LOCK = threading.Lock()
+
+
+def enabled() -> bool:
+    """Result/reshard auditing (TTS_AUDIT; default ON — the checks are
+    host-side sums over already-fetched counters)."""
+    return os.environ.get("TTS_AUDIT", "1").strip().lower() not in (
+        "0", "off", "false", "no")
+
+
+def hard() -> bool:
+    """CI mode: any failed invariant raises AuditError."""
+    return os.environ.get("TTS_AUDIT_HARD", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def roundtrip_enabled() -> bool:
+    """Checkpoint re-read verification (TTS_AUDIT=full or
+    TTS_AUDIT_CKPT=1); off by default — it re-reads every snapshot."""
+    if os.environ.get("TTS_AUDIT", "").strip().lower() == "full":
+        return True
+    return os.environ.get("TTS_AUDIT_CKPT", "").strip().lower() in (
+        "1", "true", "on", "yes")
+
+
+def record(invariant: str, ok: bool, **detail) -> Finding:
+    """Register one check outcome: ring + counters + trace event (and
+    the hard-mode raise). Every check path below funnels through here
+    so the exposition cannot drift from the checks."""
+    f = Finding(invariant=invariant, ok=bool(ok),
+                detail={k: _json_safe(v) for k, v in detail.items()},
+                t_unix=time.time())
+    with _LOCK:
+        _FINDINGS.append(f)
+    reg = metrics.default()
+    reg.counter("tts_audit_checks_total",
+                "audit invariant evaluations").inc(invariant=invariant)
+    if not f.ok:
+        reg.counter("tts_audit_failures_total",
+                    "failed audit invariants").inc(invariant=invariant)
+        tracelog.event("audit.fail", invariant=invariant, **f.detail)
+        if hard():
+            raise AuditError(
+                f"audit invariant {invariant!r} failed: {f.detail}")
+    else:
+        tracelog.event("audit.check", invariant=invariant, ok=True)
+    return f
+
+
+def findings(n: int | None = None) -> list[Finding]:
+    """Most recent findings, oldest first (all when `n` is None)."""
+    with _LOCK:
+        out = list(_FINDINGS)
+    return out if n is None else out[-n:]
+
+
+def recent_failures(window_s: float | None = None) -> list[Finding]:
+    """Failed findings, optionally only those younger than `window_s`
+    — the health layer's `audit` rule input."""
+    cutoff = time.time() - window_s if window_s else None
+    return [f for f in findings() if not f.ok
+            and (cutoff is None or f.t_unix >= cutoff)]
+
+
+def clear_findings() -> None:
+    """Drop the ring (tests; 'recovery' for the audit alert)."""
+    with _LOCK:
+        _FINDINGS.clear()
+
+
+def _json_safe(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    if isinstance(v, (list, tuple)):
+        return [_json_safe(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _json_safe(x) for k, x in v.items()}
+    try:
+        return v.item()
+    except (AttributeError, ValueError):
+        return repr(v)
+
+
+# ------------------------------------------------------------- the checks
+
+
+def check_result(res) -> list[Finding]:
+    """Audit a DistResult: telemetry-vs-counter exactness and total
+    node conservation (engine/distributed.search calls this on every
+    result when `enabled()`)."""
+    out = []
+    pd = res.per_device
+    dev_tree = int(np.asarray(pd.get("tree", [0])).sum())
+    dev_sol = int(np.asarray(pd.get("sol", [0])).sum())
+    dev_evals = int(np.asarray(pd.get("evals", [0])).sum())
+    host_tree = int(np.asarray(pd.get("host_tree", [0])).sum())
+    host_sol = int(np.asarray(pd.get("host_sol", [0])).sum())
+    out.append(record(
+        "node_conservation",
+        res.explored_tree == res.warmup_tree + dev_tree + host_tree
+        and res.explored_sol == res.warmup_sol + dev_sol + host_sol,
+        explored_tree=res.explored_tree, warmup_tree=res.warmup_tree,
+        device_tree=dev_tree, host_tree=host_tree,
+        explored_sol=res.explored_sol, warmup_sol=res.warmup_sol,
+        device_sol=dev_sol, host_sol=host_sol))
+    final = pd.get("final_size")
+    if final is not None:
+        out.append(record(
+            "complete_means_drained",
+            bool(res.complete) == (int(np.asarray(final).sum()) == 0),
+            complete=bool(res.complete),
+            pool=int(np.asarray(final).sum())))
+    t = res.telemetry
+    if t is not None:
+        out.extend(_check_telemetry(t, tree=dev_tree, sol=dev_sol,
+                                    evals=dev_evals,
+                                    sent=int(np.asarray(
+                                        pd.get("sent", [0])).sum()),
+                                    recv=int(np.asarray(
+                                        pd.get("recv", [0])).sum())))
+    return out
+
+
+def _check_telemetry(summary: dict, tree: int, sol: int, evals: int,
+                     sent: int | None = None,
+                     recv: int | None = None) -> list[Finding]:
+    """Telemetry bucket sums vs. engine counters (the ISSUE's
+    popped = pruned + branched-consumed identity, in this engine's
+    terms: every evaluated child is branched, pruned or a leaf)."""
+    out = []
+    branched = int(sum(summary["branched"]))
+    pruned = int(sum(summary["pruned"]))
+    out.append(record("branched_is_tree", branched == tree,
+                      branched=branched, tree=tree))
+    out.append(record("children_conservation",
+                      branched + pruned + sol == evals,
+                      branched=branched, pruned=pruned, sol=sol,
+                      evals=evals))
+    out.append(record(
+        "bound_hist_exact",
+        sum(summary["bound_hist_pruned"]) == pruned
+        and sum(summary["bound_hist_surviving"]) == branched,
+        hist_pruned=sum(summary["bound_hist_pruned"]), pruned=pruned,
+        hist_surviving=sum(summary["bound_hist_surviving"]),
+        branched=branched))
+    if sent is not None and recv is not None:
+        out.append(record("steal_flow",
+                          summary["steal_sent"] == sent
+                          and summary["steal_recv"] == recv,
+                          tele_sent=summary["steal_sent"], sent=sent,
+                          tele_recv=summary["steal_recv"], recv=recv))
+    return out
+
+
+def state_sums(state) -> dict:
+    """Summed counters of a host-side SearchState (single-device or
+    stacked): the conserved quantities an elastic reshard / checkpoint
+    roundtrip must preserve exactly."""
+    def s(x):
+        return int(np.asarray(x, np.int64).sum())
+
+    out = {"size": s(state.size), "tree": s(state.tree),
+           "sol": s(state.sol), "evals": s(state.evals),
+           "iters_max": int(np.atleast_1d(
+               np.asarray(state.iters, np.int64)).max()),
+           "sent": s(state.sent), "recv": s(state.recv),
+           "best": int(np.atleast_1d(
+               np.asarray(state.best, np.int64)).min())}
+    tele_w = int(state.telemetry.shape[-1])
+    if tele_w:
+        from ..engine import telemetry as tele
+        block = np.atleast_2d(np.asarray(state.telemetry, np.int64))
+        # only the additive slots are reshard-invariant; the high-water
+        # mark and the ring merge, they don't sum
+        out["telemetry_counts"] = int(
+            block[:, :tele.O_POOL_HW].sum())
+    return out
+
+
+def check_reshard(before: dict, after_state, edge: str = "reshard"
+                  ) -> list[Finding]:
+    """Conservation across an elastic reshard (or any state re-homing):
+    `before` is `state_sums()` of the pre-edge state."""
+    after = state_sums(after_state)
+    out = []
+    for key, pre in before.items():
+        post = after.get(key)
+        out.append(record(f"{edge}_conservation", post == pre,
+                          quantity=key, before=pre, after=post))
+    return out
+
+
+def check_checkpoint_roundtrip(path, state) -> list[Finding]:
+    """Re-read a just-written checkpoint and require bit-identical
+    counters. A load failure (torn write, CRC mismatch) is itself a
+    failed finding — the write was supposed to be durable."""
+    from ..engine import checkpoint
+    expect = state_sums(state)
+    try:
+        loaded, meta = checkpoint.load(path)
+    except Exception as e:  # noqa: BLE001 — the finding carries it
+        return [record("checkpoint_roundtrip", False,
+                       path=str(path), error=repr(e))]
+    got = state_sums(loaded)
+    return [record("checkpoint_roundtrip", got == expect,
+                   path=str(path), expect=expect, got=got)]
+
+
+def check_state(state, edge: str = "segment") -> list[Finding]:
+    """Audit a host-side state's internal telemetry/counter exactness
+    (per-segment hook; no-op without the telemetry block)."""
+    tele_w = int(state.telemetry.shape[-1])
+    if not tele_w:
+        return []
+    from ..engine import telemetry as tele
+    summary = tele.summarize(np.asarray(state.telemetry))
+    sums = state_sums(state)
+    out = _check_telemetry(summary, tree=sums["tree"], sol=sums["sol"],
+                           evals=sums["evals"], sent=sums["sent"],
+                           recv=sums["recv"])
+    for f in out:
+        f.detail["edge"] = edge
+    return out

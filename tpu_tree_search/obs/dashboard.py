@@ -1,0 +1,271 @@
+"""Self-contained HTML dashboard for one server or a whole fleet.
+
+``GET /dashboard`` (obs/httpd) renders a serve session; the ``doctor``
+CLI renders a fleet scrape (obs/aggregate) to a file. Pure stdlib
+string building — no script tags, no external fonts/CSS/JS, so the
+page opens from an air-gapped artifact store exactly as it opened
+live (the CI leg uploads it as a build artifact).
+
+Layout follows the repo's dataviz conventions: a stat-tile row for the
+headline numbers, single-series sparklines (2px line, direct label, no
+legend) fed by the health monitor's history rings, an alert panel
+using the reserved status palette (icon + label, never color alone),
+and plain tables for requests — values wear text ink, marks carry the
+color. Light and dark are both selected via CSS custom properties.
+"""
+
+from __future__ import annotations
+
+import html
+import time
+
+__all__ = ["render_server", "render_fleet", "sparkline_svg"]
+
+_CSS = """
+:root { color-scheme: light dark; }
+body { margin: 0; padding: 24px; background: var(--surface-1);
+  color: var(--text-primary);
+  font: 14px/1.45 system-ui, -apple-system, sans-serif; }
+body {
+  --surface-1: #fcfcfb; --surface-2: #f1f0ee;
+  --text-primary: #0b0b0b; --text-secondary: #52514e;
+  --grid: #e3e2de; --series-1: #2a78d6;
+  --good: #0ca30c; --warning: #fab219; --serious: #ec835a;
+  --critical: #d03b3b; }
+@media (prefers-color-scheme: dark) {
+  body { --surface-1: #1a1a19; --surface-2: #262624;
+    --text-primary: #ffffff; --text-secondary: #c3c2b7;
+    --grid: #3a3935; --series-1: #3987e5; } }
+h1 { font-size: 18px; margin: 0 0 4px; }
+h2 { font-size: 13px; margin: 28px 0 8px; color: var(--text-secondary);
+  text-transform: uppercase; letter-spacing: .06em; }
+.sub { color: var(--text-secondary); margin: 0 0 20px; }
+.tiles { display: flex; flex-wrap: wrap; gap: 12px; }
+.tile { background: var(--surface-2); border-radius: 8px;
+  padding: 12px 16px; min-width: 120px; }
+.tile .v { font-size: 24px; font-weight: 600; }
+.tile .k { color: var(--text-secondary); font-size: 12px; }
+.tile.bad .v { color: var(--critical); }
+table { border-collapse: collapse; width: 100%; }
+th { text-align: left; color: var(--text-secondary); font-weight: 500;
+  font-size: 12px; }
+th, td { padding: 6px 10px 6px 0;
+  border-bottom: 1px solid var(--grid); }
+td.num { font-variant-numeric: tabular-nums; }
+.sev { font-weight: 600; }
+.sev.critical { color: var(--critical); }
+.sev.warn { color: var(--warning); }
+.sev.info { color: var(--text-secondary); }
+.state-firing { color: var(--critical); font-weight: 600; }
+.state-pending { color: var(--serious); }
+.state-resolved { color: var(--good); }
+.sparks { display: flex; flex-wrap: wrap; gap: 16px; }
+.spark { background: var(--surface-2); border-radius: 8px;
+  padding: 10px 14px; }
+.spark .k { color: var(--text-secondary); font-size: 12px; }
+.spark .v { font-weight: 600; margin-left: 8px; }
+.ok { color: var(--good); } .err { color: var(--critical); }
+.mono { font-family: ui-monospace, monospace; font-size: 12px; }
+footer { margin-top: 32px; color: var(--text-secondary);
+  font-size: 12px; }
+"""
+
+_SEV_ICON = {"critical": "▲", "warn": "●", "info": "○"}
+_STATE_ICON = {"firing": "▲", "pending": "●",
+               "resolved": "✓"}
+
+
+def _esc(v) -> str:
+    return html.escape(str(v))
+
+
+def sparkline_svg(points, width: int = 180, height: int = 36) -> str:
+    """One series as an inline SVG polyline (2px stroke, no axes — the
+    tile label and last value carry the reading; a <title> supplies
+    the hover detail without any script)."""
+    vals = [float(v) for _, v in points]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = (hi - lo) or 1.0
+    n = len(vals)
+    pts = " ".join(
+        f"{(i * (width - 4) / max(n - 1, 1) + 2):.1f},"
+        f"{(height - 3 - (v - lo) / span * (height - 6)):.1f}"
+        for i, v in enumerate(vals))
+    return (
+        f'<svg width="{width}" height="{height}" role="img" '
+        f'aria-label="min {lo:g}, max {hi:g}">'
+        f"<title>min {lo:g} · max {hi:g} · last {vals[-1]:g}</title>"
+        f'<polyline points="{pts}" fill="none" stroke="var(--series-1)" '
+        'stroke-width="2" stroke-linejoin="round" '
+        'stroke-linecap="round"/></svg>')
+
+
+def _fmt(v) -> str:
+    if isinstance(v, float):
+        if abs(v) >= 1e9:
+            return f"{v / 1e9:.2f}G"
+        if abs(v) >= 1e6:
+            return f"{v / 1e6:.2f}M"
+        if v.is_integer():
+            return str(int(v))
+        return f"{v:.3f}"
+    return str(v)
+
+
+def _tile(label: str, value, bad: bool = False) -> str:
+    cls = "tile bad" if bad else "tile"
+    return (f'<div class="{cls}"><div class="v">{_esc(_fmt(value))}'
+            f'</div><div class="k">{_esc(label)}</div></div>')
+
+
+def _alert_rows(alerts: list[dict], with_origin: bool = False) -> str:
+    if not alerts:
+        return ('<tr><td colspan="6" class="ok">'
+                "✓ no alerts recorded</td></tr>")
+    rows = []
+    for a in alerts:
+        sev = a.get("severity", "warn")
+        state = a.get("state", "?")
+        origin = (f"<td>{_esc(a.get('origin', ''))}</td>"
+                  if with_origin else "")
+        detail = ", ".join(f"{k}={_fmt(v) if isinstance(v, float) else v}"
+                           for k, v in (a.get("detail") or {}).items())
+        rows.append(
+            f"<tr>{origin}"
+            f'<td class="sev {_esc(sev)}">{_SEV_ICON.get(sev, "?")} '
+            f"{_esc(sev)}</td>"
+            f"<td>{_esc(a.get('rule'))}</td>"
+            f'<td class="state-{_esc(state)}">'
+            f"{_STATE_ICON.get(state, '')} {_esc(state)}</td>"
+            f'<td class="num">{a.get("fired_count", 0)}</td>'
+            f'<td class="mono">{_esc(detail)}</td></tr>')
+    return "".join(rows)
+
+
+def _request_rows(reqs: list[dict], with_origin: bool = False) -> str:
+    if not reqs:
+        return '<tr><td colspan="9">no requests</td></tr>'
+    rows = []
+    for r in sorted(reqs, key=lambda r: str(r.get("id"))):
+        origin = (f"<td>{_esc(r.get('origin', ''))}</td>"
+                  if with_origin else "")
+        prog = r.get("progress") or {}
+        res = r.get("result") or {}
+        best = res.get("best", prog.get("best", ""))
+        rows.append(
+            f"<tr>{origin}<td>{_esc(r.get('id'))}</td>"
+            f"<td>{_esc(r.get('state'))}</td>"
+            f'<td class="num">{_esc(r.get("submesh", ""))}</td>'
+            f'<td class="num">{r.get("dispatches", 0)}</td>'
+            f'<td class="num">{r.get("preemptions", 0)}</td>'
+            f'<td class="num">{_esc(r.get("spent_s", ""))}</td>'
+            f'<td class="num">{_esc(best)}</td>'
+            f'<td class="mono">{_esc(r.get("error") or "")}</td></tr>')
+    return "".join(rows)
+
+
+def _page(title: str, sub: str, body: str) -> str:
+    return (
+        "<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{_esc(title)}</title><style>{_CSS}</style></head>"
+        f"<body><h1>{_esc(title)}</h1><p class='sub'>{_esc(sub)}</p>"
+        f"{body}<footer>generated "
+        f"{time.strftime('%Y-%m-%d %H:%M:%S')} · tpu_tree_search "
+        "operational dashboard · self-contained (no external assets)"
+        "</footer></body></html>")
+
+
+def render_server(snapshot: dict | None, alerts: dict | None,
+                  history: dict | None) -> str:
+    """One serve session: stat tiles, alert panel, sparklines from the
+    health monitor's history rings, request table."""
+    snapshot = snapshot or {}
+    alerts = alerts or {}
+    firing = alerts.get("firing", 0)
+    queue = snapshot.get("queue") or {}
+    subs = snapshot.get("submeshes") or []
+    busy = sum(1 for s in subs if s.get("running"))
+    counters = snapshot.get("counters") or {}
+    cache = snapshot.get("executor_cache") or {}
+    tiles = "".join([
+        _tile("firing alerts", firing, bad=firing > 0),
+        _tile("queue depth", queue.get("depth", 0)),
+        _tile("submeshes busy", f"{busy}/{len(subs)}"),
+        _tile("done", counters.get("done", 0)),
+        _tile("failed", counters.get("failed", 0),
+              bad=counters.get("failed", 0) > 0),
+        _tile("preemptions", counters.get("preemptions", 0)),
+        _tile("cache hit/miss", f"{cache.get('hits', 0)}/"
+                                f"{cache.get('misses', 0)}"),
+    ])
+    sparks = []
+    for name, points in sorted((history or {}).items()):
+        svg = sparkline_svg(points)
+        if not svg:
+            continue
+        last = points[-1][1]
+        sparks.append(f'<div class="spark"><span class="k">'
+                      f"{_esc(name)}</span><span class='v'>"
+                      f"{_esc(_fmt(float(last)))}</span><br>{svg}</div>")
+    body = (
+        f'<div class="tiles">{tiles}</div>'
+        "<h2>Alerts</h2><table><tr><th>severity</th><th>rule</th>"
+        "<th>state</th><th>fired</th><th>detail</th></tr>"
+        f"{_alert_rows(alerts.get('alerts') or [])}</table>"
+        + (f"<h2>Trends</h2><div class='sparks'>{''.join(sparks)}</div>"
+           if sparks else "")
+        + "<h2>Requests</h2><table><tr><th>id</th><th>state</th>"
+          "<th>submesh</th><th>disp</th><th>preempt</th>"
+          "<th>spent s</th><th>best</th><th>error</th></tr>"
+        + _request_rows(list((snapshot.get("requests") or {}).values()))
+        + "</table>")
+    up = snapshot.get("uptime_s")
+    return _page("tpu_tree_search — server health",
+                 f"uptime {up}s · {len(subs)} submesh(es) · "
+                 f"{alerts.get('evaluations', 0)} health sweeps", body)
+
+
+def render_fleet(merged: dict) -> str:
+    """A fleet scrape (obs/aggregate.merge): per-server verdicts, all
+    alerts and requests origin-labeled."""
+    servers = merged.get("servers") or []
+    firing = merged.get("firing", 0)
+    down = sum(1 for s in servers if not s["ok"])
+    tiles = "".join([
+        _tile("servers", len(servers)),
+        _tile("unreachable", down, bad=down > 0),
+        _tile("firing alerts", firing, bad=firing > 0),
+        _tile("requests", len(merged.get("requests") or [])),
+    ])
+    srv_rows = []
+    for s in servers:
+        ok = s["ok"] and s.get("healthz") == "ok"
+        mark = ('<span class="ok">✓ ok</span>' if ok else
+                f'<span class="err">✗ '
+                f"{_esc(s.get('error') or s.get('healthz'))}</span>")
+        srv_rows.append(
+            f"<tr><td>{_esc(s['origin'])}</td><td>{mark}</td>"
+            f'<td class="num">{_esc(s.get("firing", "-"))}</td>'
+            f'<td class="num">{_esc(s.get("queue_depth", "-"))}</td>'
+            f'<td class="num">{_esc(s.get("submeshes_busy", "-"))}/'
+            f"{_esc(s.get('submeshes', '-'))}</td>"
+            f'<td class="num">{_esc(s.get("requests", 0))}</td>'
+            f'<td class="num">{_esc(s.get("uptime_s", "-"))}</td></tr>')
+    body = (
+        f'<div class="tiles">{tiles}</div>'
+        "<h2>Servers</h2><table><tr><th>origin</th><th>health</th>"
+        "<th>firing</th><th>queue</th><th>busy</th><th>requests</th>"
+        f"<th>uptime s</th></tr>{''.join(srv_rows)}</table>"
+        "<h2>Alerts</h2><table><tr><th>origin</th><th>severity</th>"
+        "<th>rule</th><th>state</th><th>fired</th><th>detail</th></tr>"
+        f"{_alert_rows(merged.get('alerts') or [], with_origin=True)}"
+        "</table>"
+        "<h2>Requests</h2><table><tr><th>origin</th><th>id</th>"
+        "<th>state</th><th>submesh</th><th>disp</th><th>preempt</th>"
+        "<th>spent s</th><th>best</th><th>error</th></tr>"
+        f"{_request_rows(merged.get('requests') or [], with_origin=True)}"
+        "</table>")
+    return _page("tpu_tree_search — fleet health",
+                 f"{len(servers)} server(s) scraped", body)

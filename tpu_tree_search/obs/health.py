@@ -1,0 +1,545 @@
+"""Operational health: the SLO/anomaly rules engine over the obs stack.
+
+PRs 3-5 built a deep *recording* stack; nothing in the repo *judged* it
+— a wedged submesh or a pruning collapse was only visible to a human
+reading Perfetto. This module is the judge: a :class:`HealthMonitor`
+evaluates a set of :class:`Rule`\\ s over the live registries and the
+server snapshot on a fixed interval (a daemon thread per server, or
+on-demand :meth:`HealthMonitor.evaluate_now`), drives each through the
+``pending -> firing -> resolved`` alert lifecycle, and publishes every
+transition three ways:
+
+- flight-recorder events ``alert.pending`` / ``alert.firing`` /
+  ``alert.resolved`` (rule, severity, detail);
+- ``tts_alerts{rule,severity}`` gauges (0 = inactive/resolved, 0.5 =
+  pending, 1 = firing) plus ``tts_alerts_fired_total{rule}``;
+- :meth:`HealthMonitor.alerts_snapshot` — the JSON behind
+  ``GET /alerts`` and the ``doctor`` CLI's exit code.
+
+Built-in rule family (:func:`default_rules`; every threshold is an
+env-overridable ``TTS_HEALTH_*`` knob, defaults in utils/config.py):
+
+``queue_wait``      windowed p99 of ``tts_queue_wait_seconds`` over the
+                    SLO threshold (the admission queue is melting);
+``stall``           a RUNNING request's heartbeat age exceeded the
+                    limit (wedged submesh / hung dispatch — the live
+                    version of the reference's "Still Idle" print);
+``pruning_collapse`` a RUNNING request's ``tts_search_pruning_rate``
+                    fell to ~zero after enough evaluated children —
+                    the search is brute-forcing, the bound is broken;
+``mem_headroom``    ``tts_device_bytes_in_use / _limit`` above the
+                    fraction — the next pool growth will OOM;
+``compile_storm``   executor-cache misses per evaluation interval over
+                    the limit — executable reuse has stopped working
+                    (shape churn, cache-key regression);
+``audit``           obs/audit recorded a failed node-conservation
+                    invariant inside the window (severity critical);
+``perf``            a ``perf_sentry --json`` verdict file says FAIL
+                    (wire CI's artifact via ``TTS_HEALTH_PERF_JSON``).
+
+The monitor also samples a small history ring per evaluation (queue
+depth, busy submeshes, heartbeat age, device bytes, firing count) —
+the sparkline feed for ``GET /dashboard`` (obs/dashboard.py).
+
+Everything here is observation-only: rules READ snapshots and
+registries, never the engine — search results are bit-identical with
+the monitor on or off (pinned in tests/test_health.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import threading
+import time
+
+from ..utils import config as cfg
+from . import audit, metrics, tracelog
+
+__all__ = ["Alert", "Rule", "HealthMonitor", "Thresholds",
+           "default_rules", "PENDING", "FIRING", "RESOLVED"]
+
+PENDING = "pending"
+FIRING = "firing"
+RESOLVED = "resolved"
+
+_SEVERITY_ORDER = {"critical": 0, "page": 0, "warn": 1, "info": 2}
+
+
+def _env_f(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Thresholds:
+    """The rule family's knobs; :meth:`from_env` reads TTS_HEALTH_*."""
+
+    queue_wait_p99_s: float = cfg.HEALTH_QUEUE_WAIT_P99_S_DEFAULT
+    stall_s: float = cfg.HEALTH_STALL_S_DEFAULT
+    stall_warmup_s: float = cfg.HEALTH_STALL_WARMUP_S_DEFAULT
+    mem_frac: float = cfg.HEALTH_MEM_FRAC_DEFAULT
+    compile_storm: float = cfg.HEALTH_COMPILE_STORM_DEFAULT
+    pruning_min_rate: float = cfg.HEALTH_PRUNING_MIN_RATE_DEFAULT
+    pruning_min_nodes: float = cfg.HEALTH_PRUNING_MIN_NODES_DEFAULT
+    audit_window_s: float = cfg.HEALTH_AUDIT_WINDOW_S_DEFAULT
+    perf_json: str | None = None
+
+    @classmethod
+    def from_env(cls) -> "Thresholds":
+        return cls(
+            queue_wait_p99_s=_env_f("TTS_HEALTH_QUEUE_WAIT_P99_S",
+                                    cfg.HEALTH_QUEUE_WAIT_P99_S_DEFAULT),
+            stall_s=_env_f("TTS_HEALTH_STALL_S",
+                           cfg.HEALTH_STALL_S_DEFAULT),
+            stall_warmup_s=_env_f("TTS_HEALTH_STALL_WARMUP_S",
+                                  cfg.HEALTH_STALL_WARMUP_S_DEFAULT),
+            mem_frac=_env_f("TTS_HEALTH_MEM_FRAC",
+                            cfg.HEALTH_MEM_FRAC_DEFAULT),
+            compile_storm=_env_f("TTS_HEALTH_COMPILE_STORM",
+                                 cfg.HEALTH_COMPILE_STORM_DEFAULT),
+            pruning_min_rate=_env_f(
+                "TTS_HEALTH_PRUNING_MIN_RATE",
+                cfg.HEALTH_PRUNING_MIN_RATE_DEFAULT),
+            pruning_min_nodes=_env_f(
+                "TTS_HEALTH_PRUNING_MIN_NODES",
+                cfg.HEALTH_PRUNING_MIN_NODES_DEFAULT),
+            audit_window_s=_env_f("TTS_HEALTH_AUDIT_WINDOW_S",
+                                  cfg.HEALTH_AUDIT_WINDOW_S_DEFAULT),
+            perf_json=os.environ.get("TTS_HEALTH_PERF_JSON") or None)
+
+
+@dataclasses.dataclass
+class Rule:
+    """One condition. `check(ctx) -> (active, detail)`; `for_s` is the
+    dwell an active condition must hold before pending turns firing
+    (0 = fire on first active evaluation)."""
+
+    name: str
+    check: object                 # callable(ctx) -> (bool, dict)
+    severity: str = "warn"
+    for_s: float = 0.0
+    description: str = ""
+
+
+@dataclasses.dataclass
+class Alert:
+    """Lifecycle record of one rule's alert."""
+
+    rule: str
+    severity: str
+    state: str = PENDING
+    since_unix: float = 0.0        # condition first seen active
+    firing_since_unix: float | None = None
+    resolved_unix: float | None = None
+    fired_count: int = 0           # pending->firing transitions
+    detail: dict = dataclasses.field(default_factory=dict)
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class _Ctx:
+    """What a rule sees at evaluation time. `snapshot` is computed at
+    most once per evaluation (rules share it)."""
+
+    def __init__(self, monitor: "HealthMonitor", now: float):
+        self.monitor = monitor
+        self.server = monitor.server
+        self.registry = monitor.registry
+        self.thresholds = monitor.thresholds
+        self.now = now
+        self._snapshot = None
+
+    @property
+    def snapshot(self) -> dict | None:
+        if self._snapshot is None and self.server is not None:
+            self._snapshot = self.server.status_snapshot()
+        return self._snapshot
+
+    def gauge_samples(self, name: str) -> list[tuple[dict, float]]:
+        """Every (labels, value) sample of a gauge/counter across the
+        monitor's registries."""
+        out = []
+        for reg in self.monitor.registries:
+            for m in reg.metrics():
+                if m.name == name and hasattr(m, "samples"):
+                    out.extend((dict(k), v) for _, k, v in m.samples())
+        return out
+
+
+# ------------------------------------------------------- built-in rules
+
+
+def _hist_delta_quantile(prev: dict | None, snap: dict,
+                         q: float) -> tuple[float | None, int]:
+    """Quantile upper bound over the WINDOW between two cumulative
+    histogram snapshots (None when the window saw no observations).
+    Returns (quantile, window_count)."""
+    n = snap.get("count", 0) - (prev or {}).get("count", 0)
+    if n <= 0:
+        return None, 0
+    prev_b = (prev or {}).get("buckets", {})
+    target = q * n
+    for key, c in sorted(snap.get("buckets", {}).items(),
+                         key=lambda kv: float(kv[0])):
+        if c - prev_b.get(key, 0) >= target:
+            return float(key), n
+    return math.inf, n
+
+
+def default_rules(thresholds: Thresholds) -> list[Rule]:
+    """The built-in rule family (closures hold per-monitor state)."""
+    th = thresholds
+    state: dict = {"qw_prev": None, "misses_prev": None}
+
+    def queue_wait(ctx):
+        srv = ctx.server
+        if srv is None or getattr(srv, "metrics", None) is None:
+            return False, {}
+        h = srv.metrics.histogram("tts_queue_wait_seconds")
+        snap = h.snapshot()
+        p99, n = _hist_delta_quantile(state["qw_prev"], snap, 0.99)
+        state["qw_prev"] = snap
+        if p99 is None:
+            return False, {}
+        return p99 > th.queue_wait_p99_s, {
+            "p99_s": p99, "window_count": n,
+            "threshold_s": th.queue_wait_p99_s}
+
+    def stall(ctx):
+        ages = getattr(ctx.server, "heartbeat_ages", lambda: {})()
+        if not ages:
+            return False, {}
+        # a request that has not produced its FIRST heartbeat yet is
+        # still warming up (empty progress snapshot): the gap includes
+        # XLA trace+compile on an executor-cache miss, which runs to
+        # minutes legitimately — judge it against the larger warmup
+        # threshold instead of false-firing a critical alert
+        reqs = (ctx.snapshot or {}).get("requests", {})
+        worst = None
+        for rid, age in ages.items():
+            warming = not (reqs.get(rid) or {}).get("progress")
+            limit = th.stall_warmup_s if warming else th.stall_s
+            if age > limit and (worst is None or age > worst[1]):
+                worst = (rid, age, limit, warming)
+        if worst is None:
+            return False, {}
+        return True, {
+            "request_id": worst[0],
+            "heartbeat_age_s": round(worst[1], 3),
+            "threshold_s": worst[2], "warming": worst[3]}
+
+    def pruning_collapse(ctx):
+        rates = ctx.gauge_samples("tts_search_pruning_rate")
+        popped = ctx.gauge_samples("tts_search_popped")
+        running = _running_ids(ctx)
+        worst = None
+        for labels, rate in rates:
+            rid = labels.get("request")
+            if rid is None or (running is not None
+                               and rid not in running):
+                continue
+            nodes = sum(v for lb, v in popped
+                        if lb.get("request") == rid)
+            if nodes >= th.pruning_min_nodes \
+                    and rate < th.pruning_min_rate:
+                if worst is None or rate < worst[1]:
+                    worst = (rid, rate, nodes)
+        if worst is None:
+            return False, {}
+        return True, {"request_id": worst[0], "pruning_rate": worst[1],
+                      "popped": worst[2],
+                      "threshold_rate": th.pruning_min_rate}
+
+    def mem_headroom(ctx):
+        use = {tuple(sorted(lb.items())): v
+               for lb, v in ctx.gauge_samples("tts_device_bytes_in_use")}
+        worst = None
+        for lb, limit in ctx.gauge_samples("tts_device_bytes_limit"):
+            if limit <= 0:
+                continue
+            u = use.get(tuple(sorted(lb.items())))
+            if u is None:
+                continue
+            frac = u / limit
+            if frac > th.mem_frac and (worst is None
+                                       or frac > worst[1]):
+                worst = (lb.get("device"), frac, u, limit)
+        if worst is None:
+            return False, {}
+        return True, {"device": worst[0], "frac": round(worst[1], 4),
+                      "bytes_in_use": worst[2], "bytes_limit": worst[3],
+                      "threshold_frac": th.mem_frac}
+
+    def compile_storm(ctx):
+        cache = getattr(ctx.server, "cache", None)
+        if cache is None:
+            return False, {}
+        misses = cache.snapshot().get("misses", 0)
+        prev, state["misses_prev"] = state["misses_prev"], misses
+        if prev is None:
+            return False, {}
+        delta = misses - prev
+        return delta >= th.compile_storm, {
+            "misses_in_interval": delta, "misses_total": misses,
+            "threshold": th.compile_storm}
+
+    def audit_rule(ctx):
+        fails = audit.recent_failures(th.audit_window_s)
+        if not fails:
+            return False, {}
+        last = fails[-1]
+        return True, {"failures_in_window": len(fails),
+                      "invariant": last.invariant,
+                      "detail": last.detail,
+                      "window_s": th.audit_window_s}
+
+    def perf(ctx):
+        path = th.perf_json
+        if not path or not os.path.exists(path):
+            return False, {}
+        try:
+            with open(path) as f:
+                verdict = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            return True, {"path": path, "error": repr(e)}
+        if verdict.get("verdict") != "FAIL":
+            return False, {}
+        return True, {"path": path, "round": verdict.get("round"),
+                      "n_fail": verdict.get("n_fail"),
+                      "reasons": verdict.get("reasons", [])[:4]}
+
+    return [
+        Rule("queue_wait", queue_wait, severity="warn",
+             description="queue-wait p99 over the SLO threshold"),
+        Rule("stall", stall, severity="critical",
+             description="RUNNING request heartbeat age over the limit "
+                         "(wedged submesh / hung dispatch)"),
+        Rule("pruning_collapse", pruning_collapse, severity="warn",
+             description="search pruning rate collapsed to ~zero"),
+        Rule("mem_headroom", mem_headroom, severity="critical",
+             description="device memory in-use/limit over the fraction"),
+        Rule("compile_storm", compile_storm, severity="warn",
+             description="executor-cache misses per interval over the "
+                         "limit (executable reuse broken)"),
+        Rule("audit", audit_rule, severity="critical",
+             description="a node-conservation invariant failed "
+                         "(obs/audit.py)"),
+        Rule("perf", perf, severity="warn",
+             description="perf_sentry --json verdict is FAIL"),
+    ]
+
+
+def _running_ids(ctx) -> set | None:
+    snap = ctx.snapshot
+    if snap is None:
+        return None
+    return {rid for rid, r in snap.get("requests", {}).items()
+            if r.get("state") == "RUNNING"}
+
+
+# ----------------------------------------------------------- the monitor
+
+
+class HealthMonitor:
+    """Evaluates rules on an interval and owns the alert lifecycle.
+
+    `server` is duck-typed (anything with ``status_snapshot()``,
+    optionally ``heartbeat_ages()``, ``cache``, ``queue``, ``slots``);
+    None evaluates the registry-only rules. `registry` is where the
+    ``tts_alerts`` gauges land (the server's own registry on a serve
+    session, so ``/metrics`` carries them); rules read from `registry`
+    AND the process-global default (engine metrics live there).
+    `interval_s <= 0` disables the daemon — :meth:`evaluate_now` still
+    works on demand (the doctor/test path).
+    """
+
+    HISTORY = 360        # evaluations kept per history series
+
+    def __init__(self, server=None, registry=None,
+                 rules: list[Rule] | None = None,
+                 thresholds: Thresholds | None = None,
+                 interval_s: float | None = None,
+                 autostart: bool = True):
+        self.server = server
+        self.registry = registry if registry is not None \
+            else metrics.default()
+        self.thresholds = thresholds or Thresholds.from_env()
+        self.rules = (rules if rules is not None
+                      else default_rules(self.thresholds))
+        if interval_s is None:
+            interval_s = _env_f("TTS_HEALTH_INTERVAL_S",
+                                cfg.OBS_HEALTH_INTERVAL_S_DEFAULT)
+        self.interval_s = float(interval_s)
+        self.alerts: dict[str, Alert] = {}
+        self.history: dict[str, list] = {}
+        self._g_alerts = self.registry.gauge(
+            "tts_alerts",
+            "alert state by rule (0 inactive, 0.5 pending, 1 firing)")
+        self._c_fired = self.registry.counter(
+            "tts_alerts_fired_total", "pending->firing transitions")
+        self._c_evals = self.registry.counter(
+            "tts_health_evaluations_total", "health rule sweeps")
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.evaluations = 0
+        if autostart and self.interval_s > 0:
+            self.start()
+
+    @property
+    def registries(self) -> list:
+        regs = [self.registry]
+        dflt = metrics.default()
+        if dflt is not self.registry:
+            regs.append(dflt)
+        return regs
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        with self._lock:
+            if self._thread is not None or self.interval_s <= 0:
+                return
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="tts-health")
+            self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_now()
+            except Exception:  # noqa: BLE001 — the judge must not die
+                pass           # on a snapshot racing server shutdown
+
+    def stop(self) -> None:
+        self._stop.set()
+        th = self._thread
+        if th is not None:
+            th.join(timeout=5)
+        self._thread = None
+
+    def close(self) -> None:
+        self.stop()
+        # retire the alert gauges: a closed server must not keep
+        # publishing rule series (same valve as the resource sampler)
+        self.registry.remove_matching("tts_alerts")
+
+    # -------------------------------------------------------- evaluation
+
+    def evaluate_now(self) -> dict:
+        """One sweep: run every rule, advance lifecycles, publish, and
+        append the history sample. Returns `alerts_snapshot()`."""
+        now = time.time()
+        ctx = _Ctx(self, now)
+        with self._lock:
+            self.evaluations += 1
+            self._c_evals.inc()
+            for rule in self.rules:
+                try:
+                    active, detail = rule.check(ctx)
+                except Exception as e:  # noqa: BLE001 — a broken rule is
+                    # a finding about the rule, never a monitor crash
+                    tracelog.event("alert.rule_error", rule=rule.name,
+                                   error=repr(e))
+                    continue
+                self._advance(rule, bool(active), detail or {}, now)
+            self._sample_history(ctx, now)
+        return self.alerts_snapshot()
+
+    def _advance(self, rule: Rule, active: bool, detail: dict,
+                 now: float) -> None:
+        a = self.alerts.get(rule.name)
+        labels = {"rule": rule.name, "severity": rule.severity}
+        if active:
+            if a is None or a.state == RESOLVED:
+                a = Alert(rule=rule.name, severity=rule.severity,
+                          state=PENDING, since_unix=now, detail=detail,
+                          fired_count=a.fired_count if a else 0)
+                self.alerts[rule.name] = a
+                tracelog.event("alert.pending", **labels, **detail)
+                self._g_alerts.set(0.5, **labels)
+            a.detail = detail
+            if a.state == PENDING and now - a.since_unix >= rule.for_s:
+                a.state = FIRING
+                a.firing_since_unix = now
+                a.fired_count += 1
+                self._c_fired.inc(rule=rule.name)
+                tracelog.event("alert.firing", **labels, **detail)
+                self._g_alerts.set(1.0, **labels)
+        elif a is not None and a.state != RESOLVED:
+            was_firing = a.state == FIRING
+            a.state = RESOLVED
+            a.resolved_unix = now
+            self._g_alerts.set(0.0, **labels)
+            if was_firing:
+                tracelog.event("alert.resolved", **labels,
+                               firing_s=round(
+                                   now - (a.firing_since_unix or now),
+                                   3))
+            # an unconfirmed pending that cleared is not an incident:
+            # no resolved event, and the record drops so /alerts shows
+            # only confirmed history
+            elif a.fired_count == 0:
+                del self.alerts[rule.name]
+
+    def _sample_history(self, ctx: _Ctx, now: float) -> None:
+        def push(name, value):
+            if value is None:
+                return
+            ring = self.history.setdefault(name, [])
+            ring.append((round(now, 3), value))
+            del ring[:-self.HISTORY]
+
+        srv = self.server
+        if srv is not None:
+            if getattr(srv, "queue", None) is not None:
+                push("queue_depth", len(srv.queue))
+            slots = getattr(srv, "slots", None)
+            if slots is not None:
+                push("submeshes_busy",
+                     sum(1 for s in slots if s.record is not None))
+            ages = getattr(srv, "heartbeat_ages", lambda: {})()
+            push("heartbeat_age_max_s",
+                 round(max(ages.values()), 3) if ages else 0.0)
+        use = ctx.gauge_samples("tts_device_bytes_in_use")
+        if use:
+            push("device_bytes_in_use", sum(v for _, v in use))
+        rss = ctx.gauge_samples("tts_host_rss_bytes")
+        if rss:
+            push("host_rss_bytes", rss[0][1])
+        push("alerts_firing",
+             sum(1 for a in self.alerts.values() if a.state == FIRING))
+
+    # -------------------------------------------------------------- read
+
+    def firing(self) -> list[Alert]:
+        with self._lock:
+            return sorted(
+                (a for a in self.alerts.values() if a.state == FIRING),
+                key=lambda a: _SEVERITY_ORDER.get(a.severity, 9))
+
+    def alerts_snapshot(self) -> dict:
+        """JSON behind GET /alerts (and the doctor verdict)."""
+        with self._lock:
+            alerts = sorted(
+                self.alerts.values(),
+                key=lambda a: (a.state != FIRING,
+                               _SEVERITY_ORDER.get(a.severity, 9),
+                               a.rule))
+            return {
+                "t": time.time(),
+                "interval_s": self.interval_s,
+                "evaluations": self.evaluations,
+                "firing": sum(1 for a in alerts if a.state == FIRING),
+                "rules": [{"name": r.name, "severity": r.severity,
+                           "description": r.description}
+                          for r in self.rules],
+                "alerts": [a.to_json() for a in alerts],
+            }

@@ -15,6 +15,10 @@ FRONT of a running :class:`~tpu_tree_search.service.SearchServer`:
   (``SearchServer.status_snapshot()``);
 - ``GET /trace``    — the flight recorder's ring buffer as Chrome
   trace-event JSON (save it, open in Perfetto);
+- ``GET /alerts``   — the health rules engine's alert lifecycle
+  snapshot (obs/health; the ``doctor`` CLI's verdict input);
+- ``GET /dashboard`` — self-contained HTML operational dashboard
+  (obs/dashboard; stdlib only, zero external assets);
 - ``POST /submit``  — admit a request; the JSON body uses the SAME
   payload schema as the file spool (service/spool.py: ``inst`` or
   ``p_times``, ``lb``, ``ub``, ``priority``, ``deadline_s``, ``tag``,
@@ -70,7 +74,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/")
+    GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/alerts",
+                 "/dashboard", "/")
     POST_PATHS = ("/submit", "/cancel", "/profile")
 
     def _query(self) -> dict:
@@ -82,6 +87,7 @@ class _Handler(BaseHTTPRequestHandler):
         obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
         self._route({"/healthz": obs.healthz, "/metrics": obs.metrics,
                      "/status": obs.status, "/trace": obs.trace,
+                     "/alerts": obs.alerts, "/dashboard": obs.dashboard,
                      "/": obs.index}, other_method=self.POST_PATHS)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
@@ -116,8 +122,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": f"unknown path {path!r}",
                      "endpoints": ["/healthz", "/metrics", "/status",
-                                   "/trace", "/submit", "/cancel",
-                                   "/profile"]})
+                                   "/trace", "/alerts", "/dashboard",
+                                   "/submit", "/cancel", "/profile"]})
                     + "\n", "application/json")
                 return
             obs.http_requests.inc(path=path)
@@ -139,10 +145,12 @@ class ObsHttpd:
     def __init__(self, server=None, host: str = "127.0.0.1",
                  port: int = 0, registries=None,
                  trace: tracelog.TraceLog | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 health_monitor=None):
         self.server = server
         self.trace_log = trace
         self._profile_dir = profile_dir
+        self.health_monitor = health_monitor
         regs = list(registries) if registries is not None else []
         if not regs:
             if server is not None and getattr(server, "metrics", None) \
@@ -187,6 +195,7 @@ class ObsHttpd:
         return 200, json.dumps(
             {"service": "tpu_tree_search",
              "endpoints": ["/healthz", "/metrics", "/status", "/trace",
+                           "/alerts", "/dashboard",
                            "/submit", "/cancel", "/profile"]}) + "\n", \
             "application/json"
 
@@ -214,6 +223,37 @@ class ObsHttpd:
         log = self.trace_log or tracelog.get()
         body = json.dumps(chrome_trace.to_chrome(log.records()))
         return 200, body, "application/json"
+
+    def _monitor(self):
+        """The health monitor in play: an explicitly attached one, else
+        the server's own (SearchServer.health)."""
+        if self.health_monitor is not None:
+            return self.health_monitor
+        return getattr(self.server, "health", None)
+
+    def alerts(self):
+        """GET /alerts: the rules engine's lifecycle snapshot. A server
+        without a monitor answers an empty-but-valid document so fleet
+        scrapers need no special case."""
+        mon = self._monitor()
+        if mon is None:
+            body = {"enabled": False, "firing": 0, "alerts": []}
+        else:
+            body = {"enabled": True, **mon.alerts_snapshot()}
+        return 200, json.dumps(body) + "\n", "application/json"
+
+    def dashboard(self):
+        """GET /dashboard: the self-contained HTML view (stdlib only,
+        no external assets — save it and it still renders)."""
+        from . import dashboard as dash
+        snapshot = (self.server.status_snapshot()
+                    if self.server is not None else None)
+        mon = self._monitor()
+        html = dash.render_server(
+            snapshot,
+            mon.alerts_snapshot() if mon is not None else None,
+            dict(mon.history) if mon is not None else None)
+        return 200, html, "text/html; charset=utf-8"
 
     # ------------------------------------------------------- write path
 
@@ -323,11 +363,14 @@ class ObsHttpd:
 def start_http_server(server=None, host: str = "127.0.0.1",
                       port: int = 0, registries=None,
                       trace: tracelog.TraceLog | None = None,
-                      profile_dir: str | None = None) -> ObsHttpd:
+                      profile_dir: str | None = None,
+                      health_monitor=None) -> ObsHttpd:
     """Start the observability HTTP front-end on `host:port` (port 0
     binds an ephemeral port — read ``.port``). Returns the running
     :class:`ObsHttpd`; call ``.close()`` (or use as a context manager)
-    to stop it."""
+    to stop it. `health_monitor` overrides the server's own
+    (``SearchServer.health``) behind ``/alerts`` and ``/dashboard``."""
     return ObsHttpd(server=server, host=host, port=port,
                     registries=registries, trace=trace,
-                    profile_dir=profile_dir)
+                    profile_dir=profile_dir,
+                    health_monitor=health_monitor)

@@ -30,6 +30,7 @@ lives in README.md's Observability section.
 from __future__ import annotations
 
 import math
+import os
 import threading
 import time
 
@@ -69,6 +70,23 @@ class _Metric:
         self.help = help
         self._lock = threading.Lock()
         self._series: dict[tuple, object] = {}
+        # cardinality valve (set by the owning Registry): a NEW label
+        # set beyond the cap is dropped (and reported via _on_drop)
+        # instead of growing the metric without bound — a leaked
+        # per-request label degrades one metric, not the process
+        self._series_cap: int | None = None
+        self._on_drop = None
+
+    def _admit(self, key: tuple) -> bool:
+        """Whether a write to `key` may proceed (caller holds the
+        lock). Existing series always update; only NEW series count
+        against the cap."""
+        if (key in self._series or self._series_cap is None
+                or len(self._series) < self._series_cap):
+            return True
+        if self._on_drop is not None:
+            self._on_drop(self.name)
+        return False
 
     def _labelnames(self) -> list[tuple]:
         with self._lock:
@@ -98,7 +116,8 @@ class Counter(_Metric):
             raise ValueError(f"counter {self.name} cannot decrease ({n})")
         key = _label_key(labels)
         with self._lock:
-            self._series[key] = self._series.get(key, 0) + n
+            if self._admit(key):
+                self._series[key] = self._series.get(key, 0) + n
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -132,13 +151,16 @@ class Gauge(_Metric):
         self._fn = None
 
     def set(self, v: float, **labels) -> None:
+        key = _label_key(labels)
         with self._lock:
-            self._series[_label_key(labels)] = float(v)
+            if self._admit(key):
+                self._series[key] = float(v)
 
     def inc(self, n: float = 1, **labels) -> None:
         key = _label_key(labels)
         with self._lock:
-            self._series[key] = self._series.get(key, 0) + n
+            if self._admit(key):
+                self._series[key] = self._series.get(key, 0) + n
 
     def dec(self, n: float = 1, **labels) -> None:
         self.inc(-n, **labels)
@@ -200,6 +222,8 @@ class Histogram(_Metric):
         with self._lock:
             s = self._series.get(key)
             if s is None:
+                if not self._admit(key):
+                    return
                 s = self._series[key] = _HistSeries(len(self.buckets))
             for i, b in enumerate(self.buckets):
                 if v <= b:
@@ -231,17 +255,48 @@ class Registry:
     instrumentation sites' idiom: `REG.counter("tts_x_total").inc()`
     is safe to call from anywhere, any number of times)."""
 
-    def __init__(self, namespace: str = ""):
+    # the per-metric cap's own accounting metric: exempt from the cap
+    # (its cardinality is bounded by the number of metric NAMES) and
+    # never dropped, or the valve could silence its own report
+    DROPPED = "tts_metrics_dropped_total"
+
+    def __init__(self, namespace: str = "",
+                 max_series_per_metric: int | None = None):
         self.namespace = namespace
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
         self.created_unix = time.time()
+        if max_series_per_metric is None:
+            try:
+                from ..utils.config import OBS_METRIC_MAX_SERIES_DEFAULT
+            except ImportError:
+                OBS_METRIC_MAX_SERIES_DEFAULT = 2048
+            try:
+                max_series_per_metric = int(os.environ.get(
+                    "TTS_METRIC_MAX_SERIES", "")
+                    or OBS_METRIC_MAX_SERIES_DEFAULT)
+            except ValueError:
+                # a typo'd env knob must not take down every Registry()
+                # construction in the process
+                max_series_per_metric = OBS_METRIC_MAX_SERIES_DEFAULT
+        self.max_series_per_metric = (max_series_per_metric
+                                      if max_series_per_metric
+                                      and max_series_per_metric > 0
+                                      else None)
+
+    def _dropped(self, metric_name: str) -> None:
+        self.counter(self.DROPPED,
+                     "label sets dropped by the per-metric cardinality "
+                     "cap").inc(metric=metric_name)
 
     def _get(self, cls, name: str, help: str, **kw) -> _Metric:
         with self._lock:
             m = self._metrics.get(name)
             if m is None:
                 m = self._metrics[name] = cls(name, help, **kw)
+                if name != self.DROPPED:
+                    m._series_cap = self.max_series_per_metric
+                    m._on_drop = self._dropped
             elif not isinstance(m, cls):
                 raise TypeError(
                     f"metric {name!r} already registered as {m.kind}")

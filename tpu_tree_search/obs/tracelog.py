@@ -7,7 +7,10 @@ at exit carrying the start timestamp and the measured duration). Records
 land in a bounded ring buffer (old records drop silently — the recorder
 must never become the memory leak it exists to debug) and, when a sink
 is configured, are appended as JSON-lines to a file as they happen, so a
-killed process leaves a durable record up to its last write.
+killed process leaves a durable record up to its last write. The sink is
+size-capped too (``TTS_TRACE_MAX_MB``, default 64, 0 disables): at the
+cap it rotates to a single ``.1`` sibling and restarts, so a month-long
+serve session's recorder is bounded on disk as well as in RAM.
 
 Record schema (one JSON object per line in the sink)::
 
@@ -87,7 +90,8 @@ class TraceLog:
     file sink. See the module docstring for the record schema."""
 
     def __init__(self, capacity: int = 16384,
-                 sink_path: str | os.PathLike | None = None):
+                 sink_path: str | os.PathLike | None = None,
+                 max_sink_bytes: int | None = None):
         self.t0 = time.monotonic()
         self.t0_unix = time.time()
         self._lock = threading.Lock()
@@ -96,6 +100,23 @@ class TraceLog:
         self._seq = itertools.count()
         self._tls = threading.local()
         self._sink = None
+        self._sink_bytes = 0
+        self.rotations = 0
+        # size-capped rotation (TTS_TRACE_MAX_MB, 0 disables): at the
+        # cap the sink rolls to a `.1` sibling and restarts — a long
+        # serve session's recorder is bounded at ~2x the cap on disk
+        if max_sink_bytes is None:
+            try:
+                from ..utils.config import OBS_TRACE_MAX_MB_DEFAULT
+            except ImportError:
+                OBS_TRACE_MAX_MB_DEFAULT = 64
+            try:
+                mb = float(os.environ.get("TTS_TRACE_MAX_MB", "")
+                           or OBS_TRACE_MAX_MB_DEFAULT)
+            except ValueError:   # a typo'd env knob must not take down
+                mb = OBS_TRACE_MAX_MB_DEFAULT  # the recorder
+            max_sink_bytes = int(mb * (1 << 20))
+        self.max_sink_bytes = max(int(max_sink_bytes), 0)
         self.dropped = 0           # records evicted from the ring
         if sink_path:
             self.set_sink(sink_path)
@@ -115,11 +136,35 @@ class TraceLog:
             d = os.path.dirname(path)
             if d:
                 os.makedirs(d, exist_ok=True)
+            try:
+                self._sink_bytes = os.path.getsize(path)
+            except OSError:
+                self._sink_bytes = 0
             self._sink = open(path, "a", buffering=1)   # line-buffered
-            self._sink.write(json.dumps(
-                {"kind": "meta", "t0_unix": self.t0_unix,
-                 "pid": os.getpid()}) + "\n")
+            meta = json.dumps({"kind": "meta", "t0_unix": self.t0_unix,
+                               "pid": os.getpid()}) + "\n"
+            self._sink.write(meta)
+            self._sink_bytes += len(meta)
             self._sink_path = path
+
+    def _rotate_locked(self) -> None:
+        """Roll the sink to `<path>.1` (replacing any previous rollover)
+        and restart it fresh; caller holds the lock. A rotation failure
+        downgrades to sink-off — the recorder must never raise."""
+        path = self._sink_path
+        try:
+            self._sink.close()
+            os.replace(path, path + ".1")
+            self._sink_bytes = 0
+            self._sink = open(path, "a", buffering=1)
+            meta = json.dumps(
+                {"kind": "meta", "t0_unix": self.t0_unix,
+                 "pid": os.getpid(), "rotation": self.rotations + 1})
+            self._sink.write(meta + "\n")
+            self._sink_bytes += len(meta) + 1
+            self.rotations += 1
+        except (OSError, ValueError):
+            self._sink = None
 
     @property
     def sink_path(self) -> str | None:
@@ -156,7 +201,12 @@ class TraceLog:
             self._buf.append(rec)
             if self._sink is not None:
                 try:
-                    self._sink.write(json.dumps(rec) + "\n")
+                    line = json.dumps(rec) + "\n"
+                    self._sink.write(line)
+                    self._sink_bytes += len(line)
+                    if self.max_sink_bytes \
+                            and self._sink_bytes >= self.max_sink_bytes:
+                        self._rotate_locked()
                 except (OSError, ValueError):
                     # a torn sink (disk full, closed fd) must never take
                     # the search down; the ring buffer keeps recording

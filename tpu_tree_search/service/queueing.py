@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import heapq
 import threading
+import time
 
 from .request import PREEMPTED, QUEUED, RequestRecord
 
@@ -77,6 +78,7 @@ class RequestQueue:
                 raise AdmissionError(
                     f"queue full: depth {depth} at the admission bound "
                     f"{self.max_depth}; retry later or raise the bound")
+            rec.queued_t = time.monotonic()
             heapq.heappush(self._heap,
                            (-rec.request.priority, rec.seq, rec))
             self.peak_depth = max(self.peak_depth, depth + 1)
@@ -85,6 +87,7 @@ class RequestQueue:
         """Put a preempted/re-dispatched request back in line.
         Bypasses the admission bound (the request was already admitted)."""
         with self._lock:
+            rec.queued_t = time.monotonic()
             heapq.heappush(self._heap,
                            (-rec.request.priority, rec.seq, rec))
             self.peak_depth = max(self.peak_depth, self._depth())

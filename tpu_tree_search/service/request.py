@@ -105,6 +105,11 @@ class RequestRecord:
     request: SearchRequest
     state: str = QUEUED
     submitted_t: float = 0.0
+    queued_t: float = 0.0               # last admit/requeue time — the
+                                        # queue-wait clock's start
+    last_heartbeat_t: float | None = None   # last engine heartbeat (or
+                                        # dispatch) — the stall rule's
+                                        # liveness signal
     started_t: float | None = None      # current dispatch's start
     finished_t: float | None = None
     spent_prev_s: float = 0.0           # execution time of past dispatches
@@ -154,6 +159,13 @@ class RequestRecord:
             "tag": self.request.tag or self.id,
             "stop_reason": self.stop_reason,
             "hold": self.hold,
+            # liveness for the health layer's stall rule / dashboard:
+            # seconds since the engine last heartbeat this request
+            # (None unless RUNNING)
+            "heartbeat_age_s": (
+                round(time.monotonic() - self.last_heartbeat_t, 3)
+                if self.state == RUNNING
+                and self.last_heartbeat_t is not None else None),
             "progress": dict(self.progress),
         }
         res = self.result

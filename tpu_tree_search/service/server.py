@@ -61,6 +61,7 @@ import time
 
 import numpy as np
 
+from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
 from ..obs import resource as obs_resource
 from ..obs import tracelog
@@ -126,7 +127,8 @@ class SearchServer:
                  cfg.SERVICE_RETRY_BASE_S_DEFAULT,
                  autostart: bool = True,
                  phase_profile=None,
-                 resource_sample_s: float | None = None):
+                 resource_sample_s: float | None = None,
+                 health_interval_s: float | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -155,6 +157,10 @@ class SearchServer:
         self._m_spent = self.metrics.histogram(
             "tts_request_spent_seconds",
             "accumulated execution time of terminal requests")
+        self._m_queue_wait = self.metrics.histogram(
+            "tts_queue_wait_seconds",
+            "admit/requeue -> dispatch wait (the health layer's "
+            "queue_wait SLO reads its windowed p99)")
         self.metrics.gauge(
             "tts_queue_depth", "requests waiting for a submesh"
             ).set_fn(lambda: len(self.queue))
@@ -213,6 +219,15 @@ class SearchServer:
         self._t0 = time.monotonic()
         self._closing = threading.Event()
         self._scheduler: threading.Thread | None = None
+        # the operational judge (obs/health): SLO/anomaly rules over
+        # this server's registries + snapshot on a daemon interval,
+        # surfaced as /alerts, tts_alerts gauges and alert.* events.
+        # interval None resolves to TTS_HEALTH_INTERVAL_S inside the
+        # monitor; <= 0 disables the daemon (evaluate_now() still
+        # works for tests and the doctor path).
+        self.health = obs_health.HealthMonitor(
+            server=self, registry=self.metrics,
+            interval_s=health_interval_s)
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir))
@@ -273,6 +288,8 @@ class SearchServer:
         # stop the resource sampler and retire its gauge series — a
         # closed server must not keep publishing (or holding) them
         self.resources.close()
+        # same valve for the health daemon and its tts_alerts series
+        self.health.close()
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -398,6 +415,18 @@ class SearchServer:
             self.queue.requeue(rec)
             return True
 
+    def heartbeat_ages(self) -> dict:
+        """Seconds since each RUNNING request's last engine heartbeat —
+        the health layer's `stall` rule input (a wedged submesh stops
+        heartbeating long before it stops holding its slot)."""
+        now = time.monotonic()
+        with self._lock:
+            return {rec.id: now - rec.last_heartbeat_t
+                    for slot in self.slots
+                    if (rec := slot.record) is not None
+                    and rec.state == RUNNING
+                    and rec.last_heartbeat_t is not None}
+
     def status_snapshot(self) -> dict:
         """One JSON-safe dict describing the whole server: queue depth
         and order, per-submesh occupancy, executor-cache hit/miss
@@ -450,12 +479,14 @@ class SearchServer:
                DEADLINE: "deadline", FAILED: "failed"}[state]
         self._m_terminal.inc(state=key)
         self._m_spent.observe(rec.spent_s())
-        if self.phase_profile is not None:
-            # live-attribution series are per-request labeled; retire
-            # them with the request or a long-serving process grows
-            # gauge cardinality without bound
-            self.metrics.remove_matching("tts_phase_seconds",
-                                         request=rec.id)
+        # live-attribution series are per-request labeled; retire them
+        # with the request or a long-serving process grows gauge
+        # cardinality without bound. Unconditional: remove_matching on
+        # a metric that was never created is a free no-op, and gating
+        # it on phase_profile left series behind when the knob was
+        # flipped off mid-lifetime
+        self.metrics.remove_matching("tts_phase_seconds",
+                                     request=rec.id)
         # same cardinality valve for the search-telemetry series
         # (engine/telemetry.publish, fed by the heartbeat below)
         from ..engine import telemetry as tele_mod
@@ -564,6 +595,11 @@ class SearchServer:
         rec.dispatches += 1
         rec.stop_reason = None
         rec.started_t = time.monotonic()
+        # the queue-wait SLO observation (admit/requeue -> here) and
+        # the stall rule's liveness baseline until the first heartbeat
+        if rec.queued_t:
+            self._m_queue_wait.observe(rec.started_t - rec.queued_t)
+        rec.last_heartbeat_t = rec.started_t
         tracelog.event("request.dispatch", request_id=rec.id,
                        submesh=slot.index, dispatch=rec.dispatches,
                        queue_depth=len(self.queue))
@@ -595,6 +631,7 @@ class SearchServer:
                       if self.phase_profile is not None else None)
 
         def hb(rep):
+            rec.last_heartbeat_t = time.monotonic()
             rec.progress = {
                 "segment": rep.segment, "iters": rep.iters,
                 "tree": rep.tree, "sol": rep.sol, "best": rep.best,

@@ -57,6 +57,48 @@ PROFILE_MAX_DURATION_S = 300.0        # POST /profile duration ceiling —
                                       # a typo'd duration must not pin
                                       # the profiler (and its artifact
                                       # growth) for hours
+OBS_TRACE_MAX_MB_DEFAULT = 64         # tracelog JSONL sink rotation cap
+                                      # (TTS_TRACE_MAX_MB; 0 disables):
+                                      # at the cap the sink rolls to a
+                                      # single `.1` sibling so a month-
+                                      # long serve session cannot fill
+                                      # the disk with its own recorder
+OBS_METRIC_MAX_SERIES_DEFAULT = 2048  # per-metric label-set cap
+                                      # (TTS_METRIC_MAX_SERIES): above
+                                      # it new series are DROPPED and
+                                      # counted in
+                                      # tts_metrics_dropped_total — a
+                                      # leaked per-request label must
+                                      # degrade the metric, not the
+                                      # process
+
+# Operational-health defaults (obs/health.py — the SLO/anomaly rules
+# engine every serve session runs). Env-driven (TTS_HEALTH_*) for the
+# same respawn-survival reason as the knobs above; <= 0 interval
+# disables the daemon. Threshold semantics are documented per rule in
+# README.md's Operations section.
+OBS_HEALTH_INTERVAL_S_DEFAULT = 2.0       # TTS_HEALTH_INTERVAL_S
+HEALTH_QUEUE_WAIT_P99_S_DEFAULT = 60.0    # TTS_HEALTH_QUEUE_WAIT_P99_S
+HEALTH_STALL_S_DEFAULT = 30.0             # TTS_HEALTH_STALL_S — max
+                                          # heartbeat age of a RUNNING
+                                          # request before `stall` fires
+HEALTH_STALL_WARMUP_S_DEFAULT = 300.0     # TTS_HEALTH_STALL_WARMUP_S —
+                                          # the stall limit BEFORE the
+                                          # first heartbeat, when the
+                                          # gap legitimately includes
+                                          # an XLA trace+compile
+HEALTH_MEM_FRAC_DEFAULT = 0.92            # TTS_HEALTH_MEM_FRAC —
+                                          # in_use/limit above this
+                                          # fires `mem_headroom`
+HEALTH_COMPILE_STORM_DEFAULT = 6          # TTS_HEALTH_COMPILE_STORM —
+                                          # executor-cache misses per
+                                          # evaluation interval
+HEALTH_PRUNING_MIN_RATE_DEFAULT = 0.0005  # TTS_HEALTH_PRUNING_MIN_RATE
+HEALTH_PRUNING_MIN_NODES_DEFAULT = 100_000  # ...only judged past this
+                                            # many evaluated children
+HEALTH_AUDIT_WINDOW_S_DEFAULT = 300.0     # TTS_HEALTH_AUDIT_WINDOW_S —
+                                          # how long an audit failure
+                                          # keeps the `audit` rule firing
 
 
 @dataclasses.dataclass
