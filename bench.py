@@ -79,6 +79,55 @@ def bench_one(tables, p, ub, lb_kind: int, chunk: int, iters: int,
     return evals, dt, state, tele0
 
 
+def bench_segment_gap(p, ub, inst: int):
+    """One segmented distributed mini-run measuring the mean device-idle
+    gap between segments (the tts_segment_gap_seconds histogram the
+    segmented drivers record; TTS_OVERLAP drives it to ~0). Emitted as
+    its own LOWER-IS-BETTER row so tools/perf_sentry.py can gate
+    overlap regressions once hardware rows exist. TTS_BENCH_SEGGAP=0
+    skips it; the overlap flag itself is whatever TTS_OVERLAP says, and
+    the row records which mode it measured."""
+    from tpu_tree_search.engine import checkpoint, distributed
+    from tpu_tree_search.obs import metrics as obs_metrics
+    from tpu_tree_search.utils import config as cfg
+
+    overlap = cfg.env_flag(cfg.OVERLAP_FLAG)
+    # register with the driver's own buckets/help: the registry pins
+    # whatever the FIRST registration says, and this call can precede
+    # the driver's
+    hist = obs_metrics.default().histogram(
+        "tts_segment_gap_seconds", checkpoint.GAP_HELP,
+        buckets=checkpoint.GAP_BUCKETS)
+    before = hist.snapshot()
+    # small segments + a bounded round count: enough boundaries for a
+    # stable mean without stretching the bench (the gap is a per-
+    # boundary cost, independent of the instance's size)
+    distributed.search(p, lb_kind=1, init_ub=ub, chunk=64,
+                       capacity=1 << 16, min_seed=32, segment_iters=8,
+                       max_rounds=16, heartbeat=None)
+    after = hist.snapshot()
+    n = after["count"] - before["count"]
+    if n <= 0:
+        print("# segment-gap bench SKIPPED: no segment boundaries "
+              "recorded", file=sys.stderr)
+        return
+    gap = (after["sum"] - before["sum"]) / n
+    row = {
+        "metric": f"pfsp_ta{inst:03d}_segment_gap_s",
+        "value": round(gap, 6),
+        "unit": "seconds_per_boundary",
+        "direction": "lower",
+        "segments": int(n),
+        "overlap": int(overlap),
+        "platform": PLATFORM,
+    }
+    if DEGRADED:
+        row["degraded"] = True
+    print(json.dumps(row))
+    print(f"# segment_gap mean={gap * 1e3:.3f}ms over {n} boundaries "
+          f"(overlap={int(overlap)})", file=sys.stderr)
+
+
 def main():
     inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
     # 65536 parents/step measured best on v5e after the bf16 act matmul
@@ -159,6 +208,9 @@ def main():
         print(f"# lb={lb_kind} evals={evals} dt={dt:.3f}s iters={it} "
               f"chunk={chunk} pool={int(state.size)} "
               f"best={int(state.best)}", file=sys.stderr)
+
+    if os.environ.get("TTS_BENCH_SEGGAP", "1") != "0":
+        bench_segment_gap(p, ub, inst)
 
 
 if __name__ == "__main__":

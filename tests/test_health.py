@@ -142,12 +142,22 @@ def test_stall_alert_fires_once_and_resolves_bitident(
     # with fetch + collectives) and well below the injected 3 s delay,
     # so exactly the fault fires the rule
     monkeypatch.setenv("TTS_HEALTH_STALL_S", "1.0")
+    # under TTS_OVERLAP the injected delay lands at the SPECULATIVE
+    # dispatch of segment 2 — before the request's first heartbeat —
+    # so the gap is judged against the warmup threshold; keep it above
+    # a warm (executor-cache hit) dispatch and below the 3 s delay so
+    # the rule still fires exactly once in either mode
+    monkeypatch.setenv("TTS_HEALTH_STALL_WARMUP_S", "2.0")
     monkeypatch.setenv("TTS_AUDIT", "1")
     inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=2)
     base = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
                               n_devices=8, **KW)
+    # share_incumbent pinned off: the warm request below publishes the
+    # optimum, and the bit-identity assertion vs `base` defines
+    # UNSHARED semantics (sharing is covered by tests/test_overlap.py)
     with SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
-                      health_interval_s=0.05) as srv:
+                      health_interval_s=0.05,
+                      share_incumbent=False) as srv:
         # warm the executor cache so the faulted request's dispatch
         # goes straight into segments — otherwise the first compile
         # itself (seconds on CPU) trips the 0.3 s stall threshold and
@@ -514,9 +524,13 @@ def test_every_terminal_state_retires_request_series(fresh_obs,
     from tpu_tree_search.engine import telemetry as tele
 
     inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=2)
+    # share_incumbent pinned off: all four requests solve the SAME
+    # instance, and the DEADLINE one must stay slow enough to exceed
+    # its 1 ms budget — a folded optimum from the DONE request would
+    # legitimately finish it early (sharing: tests/test_overlap.py)
     srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
                        autostart=False, service_retry_attempts=0,
-                       health_interval_s=0)
+                       health_interval_s=0, share_incumbent=False)
     try:
         rids = {}
         rids["DONE"] = srv.submit(SearchRequest(

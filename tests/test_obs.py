@@ -226,7 +226,12 @@ def test_serve_session_flight_recorder_end_to_end(fresh_obs, baselines,
     slow = PFSPInstance.synthetic(jobs=8, machines=3, seed=5)
     fast = PFSPInstance.synthetic(jobs=7, machines=3, seed=6)
     other = PFSPInstance.synthetic(jobs=7, machines=3, seed=2)
-    with SearchServer(n_submeshes=2, workdir=tmp_path / "wd") as srv:
+    # share_incumbent pinned off: ra/rb solve the SAME instance and the
+    # test asserts bit-identity vs standalone runs — a cross-request
+    # fold would (correctly) shrink one request's tree (sharing
+    # semantics are covered by tests/test_overlap.py)
+    with SearchServer(n_submeshes=2, workdir=tmp_path / "wd",
+                      share_incumbent=False) as srv:
         httpd = start_http_server(srv)
         try:
             # two low-priority requests occupy both submeshes; the

@@ -1,0 +1,459 @@
+"""Raw-speed arc tests: async segment overlap + incumbent sharing.
+
+The two contracts under test (ISSUE 7):
+
+- **Overlap is free**: TTS_OVERLAP pipelines segmented execution
+  (speculative dispatch with donated carries, writer-thread
+  checkpoints) with BIT-IDENTICAL node accounting — same tree/sol/
+  evals/best as the sync driver on the same run, same checkpoint
+  durability story (`.prev` rollback survives a corrupted async
+  write), audit invariants green across the async edge, and the
+  device-idle gap between segments measurably ~0.
+
+- **Sharing only tightens**: the cross-request incumbent board
+  (engine/incumbent.py) folds monotone-only — an empty board is a
+  no-op (bit-parity), a tighter published bound strictly reduces
+  bound evaluations at the same optimum, and concurrent same-instance
+  service requests finish with the same optimum and strictly fewer
+  total evals than unshared.
+"""
+
+import threading
+import warnings
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import checkpoint, distributed, incumbent
+from tpu_tree_search.engine import sequential as seq
+from tpu_tree_search.obs import audit as obs_audit
+from tpu_tree_search.obs import metrics as obs_metrics
+from tpu_tree_search.obs import tracelog
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+from tpu_tree_search.utils import faults
+
+
+@pytest.fixture
+def fault_plan():
+    yield faults.configure
+    faults.reset()
+
+
+@pytest.fixture
+def fresh_registry():
+    """Isolate the process-global engine registry (gap histograms,
+    fold counters) from other tests in the session."""
+    prev = obs_metrics.install(obs_metrics.Registry())
+    yield obs_metrics.default()
+    obs_metrics.install(prev)
+
+
+def _setup():
+    # seed=7: the largest ub=opt tree of the tiny synthetic family
+    # (495 pushed nodes) — segments actually segment
+    inst = PFSPInstance.synthetic(jobs=8, machines=4, seed=7)
+    opt = inst.brute_force_optimum()
+    return inst, opt
+
+
+def _dist(inst, opt, **kw):
+    kw.setdefault("n_devices", 4)
+    kw.setdefault("chunk", 4)
+    kw.setdefault("capacity", 1 << 12)
+    kw.setdefault("min_seed", 8)
+    kw.setdefault("heartbeat", None)
+    return distributed.search(inst.p_times, lb_kind=1, init_ub=opt, **kw)
+
+
+def _counts(res):
+    return (res.explored_tree, res.explored_sol, res.best,
+            int(np.asarray(res.per_device["evals"]).sum()))
+
+
+# ------------------------------------------------------------- overlap
+
+
+def test_overlap_bit_parity(tmp_path):
+    """Same tree/sol/evals/best with the pipelined driver on and off —
+    the acceptance criterion's parity half."""
+    inst, opt = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    off = _dist(inst, opt, segment_iters=2,
+                checkpoint_path=str(tmp_path / "off.npz"), overlap=False)
+    on = _dist(inst, opt, segment_iters=2,
+               checkpoint_path=str(tmp_path / "on.npz"), overlap=True)
+    assert off.complete and on.complete
+    assert _counts(on) == _counts(off)
+    assert (on.explored_tree, on.explored_sol, on.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_overlap_env_flag(tmp_path, monkeypatch):
+    """overlap=None resolves the TTS_OVERLAP env flag; the overlapped
+    segment spans prove which driver ran."""
+    inst, opt = _setup()
+    monkeypatch.setenv("TTS_OVERLAP", "1")
+    log = tracelog.TraceLog()
+    prev = tracelog.install(log)
+    try:
+        res = _dist(inst, opt, segment_iters=2, overlap=None)
+    finally:
+        tracelog.install(prev)
+    assert res.complete
+    assert any(r.get("name") == "segment" and r.get("overlapped")
+               for r in log.records())
+
+
+def test_overlap_overflow_grows_losslessly():
+    """A pool too small for the run grows mid-pipeline and resumes from
+    exactly where the loop stopped — no explored node lost."""
+    inst, opt = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    res = _dist(inst, opt, capacity=1 << 8, segment_iters=2,
+                overlap=True)
+    assert res.complete
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_overlap_resume_across_modes(tmp_path):
+    """A checkpoint written through the ASYNC writer resumes under the
+    sync driver (and vice versa) with exact totals — the two modes
+    share one on-disk format and one accounting."""
+    inst, opt = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    ck = tmp_path / "x.npz"
+    part = _dist(inst, opt, segment_iters=2, max_rounds=2,
+                 checkpoint_path=str(ck), overlap=True)
+    assert ck.exists() and not part.complete
+    res = _dist(inst, opt, checkpoint_path=str(ck), overlap=False)
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_overlap_stop_event_checkpoints_and_resumes(tmp_path):
+    """Preemption under overlap: the stop lands within one extra
+    segment (the drained speculative dispatch), the state is
+    checkpointed by the writer before return, and the resume finishes
+    with oracle-exact totals."""
+    inst, opt = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    ck = tmp_path / "pre.npz"
+    ev = threading.Event()
+    seen = []
+
+    def hb(rep):
+        seen.append(rep.segment)
+        if rep.segment >= 2:
+            ev.set()
+
+    part = _dist(inst, opt, segment_iters=2, checkpoint_path=str(ck),
+                 heartbeat=hb, stop_event=ev, overlap=True)
+    assert not part.complete and ck.exists()
+    res = _dist(inst, opt, checkpoint_path=str(ck), overlap=True)
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_async_writer_crash_during_write_rolls_back(tmp_path, fault_plan):
+    """The drill the async edge must survive: the checkpoint written at
+    the LAST segment is corrupted (the writer-thread post_checkpoint
+    injection — a stand-in for a crash mid-write), and the resume rolls
+    back to the rotating `.prev` last-good instead of resuming garbage.
+    Totals stay oracle-exact."""
+    inst, opt = _setup()
+    want = seq.pfsp_search(inst, lb=1, init_ub=opt)
+    ck = tmp_path / "c.npz"
+    log = tracelog.TraceLog()
+    prev = tracelog.install(log)
+    try:
+        # segment_iters=2 / max_rounds=2 yields exactly 4 segments on
+        # this state (balance_period 4); segment 4's save is the final
+        # file — corrupting it leaves segment 3's as `.prev`
+        fault_plan("corrupt_checkpoint=4")
+        part = _dist(inst, opt, segment_iters=2, max_rounds=2,
+                     checkpoint_path=str(ck), overlap=True)
+    finally:
+        tracelog.install(prev)
+    assert not part.complete
+    assert ck.exists() and (tmp_path / "c.npz.prev").exists()
+    # the saves really crossed the writer thread
+    saves = [r for r in log.records()
+             if r.get("name") == "checkpoint.save"]
+    assert saves and all(r["thread"] == "tts-ckpt-writer"
+                         and r.get("async_write") for r in saves)
+    faults.reset()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        res = _dist(inst, opt, checkpoint_path=str(ck), overlap=True)
+    assert any("corrupt" in str(x.message) for x in w)
+    assert (res.explored_tree, res.explored_sol, res.best) == \
+           (want.explored_tree, want.explored_sol, want.best)
+
+
+def test_writer_preserves_rotation_order(tmp_path):
+    """FIFO writer: after N submits of successive states, the current
+    file holds the last state and `.prev` the one before — the rotation
+    invariant the bounded queue must not reorder."""
+    from tpu_tree_search.engine import device
+    from tpu_tree_search.ops import batched
+
+    inst, opt = _setup()
+    tables = batched.make_tables(inst.p_times)
+    state = device.init_state(inst.jobs, 1 << 10, opt,
+                              p_times=inst.p_times)
+    ck = tmp_path / "w.npz"
+    writer = checkpoint.AsyncCheckpointWriter(max_pending=1)
+    try:
+        iters_seen = []
+        for k in (2, 4, 6):
+            state = device.run(tables, state, 1, 8, max_iters=k)
+            iters_seen.append(int(state.iters))
+            writer.submit(str(ck), state, {"mark": k}, segment=k)
+        writer.drain()
+    finally:
+        writer.close()
+    cur, meta = checkpoint.load(ck)
+    prevst, prevmeta = checkpoint.load(str(ck) + ".prev")
+    assert int(meta["mark"]) == 6 and int(prevmeta["mark"]) == 4
+    assert int(np.asarray(cur.iters)) == iters_seen[-1]
+    assert int(np.asarray(prevst.iters)) == iters_seen[-2]
+
+
+def test_overlap_gap_metric_zero(fresh_registry):
+    """The measured device-idle half of the acceptance criterion: with
+    overlap on (and no checkpoint sync points) every recorded gap is
+    exactly 0 — dispatch always precedes the previous fetch — while the
+    sync driver records positive host-processing gaps."""
+    inst, opt = _setup()
+    _dist(inst, opt, segment_iters=2, overlap=True)
+    on = fresh_registry.histogram("tts_segment_gap_seconds",
+                                  "").snapshot()
+    assert on["count"] > 0 and on["sum"] == 0.0
+    _dist(inst, opt, segment_iters=2, overlap=False)
+    both = fresh_registry.histogram("tts_segment_gap_seconds",
+                                    "").snapshot()
+    assert both["count"] > on["count"]
+    assert both["sum"] >= on["sum"]
+
+
+def test_overlap_audit_green_across_async_edge(tmp_path, monkeypatch,
+                                               fresh_registry):
+    """TTS_AUDIT=full + TTS_AUDIT_HARD=1 over an overlapped checkpointed
+    run: the roundtrip audit re-reads every snapshot ON the writer
+    thread against sums captured at prepare() time — any conservation
+    drift across the async edge would raise, and the findings ring must
+    show the checks green."""
+    inst, opt = _setup()
+    monkeypatch.setenv("TTS_AUDIT", "full")
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+    obs_audit.clear_findings()
+    res = _dist(inst, opt, segment_iters=2,
+                checkpoint_path=str(tmp_path / "a.npz"), overlap=True)
+    assert res.complete
+    rts = [f for f in obs_audit.findings()
+           if f.invariant == "checkpoint_roundtrip"]
+    assert rts and all(f.ok for f in rts)
+
+
+# ----------------------------------------------------------- incumbents
+
+
+def test_incumbent_board_basics():
+    b = incumbent.IncumbentBoard()
+    k = incumbent.instance_key(np.arange(12).reshape(3, 4))
+    assert b.peek(k) is None
+    assert b.publish(k, 100)
+    assert not b.publish(k, 100)      # equal never "improves"
+    assert not b.publish(k, 120)      # looser never lands
+    assert b.publish(k, 90)
+    assert b.peek(k) == 90 and b.snapshot() == {k: 90}
+    # keys: same table same key; group namespaces; different table differs
+    p = np.arange(12).reshape(3, 4)
+    assert incumbent.instance_key(p) == incumbent.instance_key(p.copy())
+    assert incumbent.instance_key(p) != incumbent.instance_key(p + 1)
+    assert incumbent.instance_key(p, group="t1") != \
+        incumbent.instance_key(p)
+
+
+def test_client_never_publishes_no_incumbent_sentinel(fresh_registry):
+    """A cold request with no schedule yet holds best == I32_MAX — the
+    'nothing found' sentinel, not a makespan. The client must refuse to
+    board it: no entry, no direction=out count, no bogus 'global best'
+    of 2147483647 on /status."""
+    b = incumbent.IncumbentBoard()
+    k = incumbent.instance_key(np.arange(12).reshape(3, 4))
+    c = incumbent.BoardClient(b, k)
+    assert not c.publish(np.iinfo(np.int32).max)
+    assert b.peek(k) is None and len(b) == 0
+    folds = fresh_registry.counter("tts_incumbent_folds_total", "")
+    assert folds.value(direction="out") == 0
+    assert c.publish(1081) and b.peek(k) == 1081
+
+
+def test_incumbent_board_bounded(monkeypatch):
+    """The board evicts least-recently-updated keys past
+    TTS_INCUMBENT_MAX_KEYS (a month-long many-tenant server must not
+    grow its /status snapshot without bound); a re-publish refreshes
+    recency, and eviction is invisible to correctness (peek -> None is
+    always a valid, merely looser, answer)."""
+    b = incumbent.IncumbentBoard(max_keys=2)
+    ks = [incumbent.instance_key(np.arange(12).reshape(3, 4) + i)
+          for i in range(3)]
+    b.publish(ks[0], 100)
+    b.publish(ks[1], 200)
+    b.publish(ks[0], 90)              # refresh k0's recency
+    b.publish(ks[2], 300)             # evicts k1, the stalest
+    assert b.peek(ks[1]) is None
+    assert b.peek(ks[0]) == 90 and b.peek(ks[2]) == 300
+    assert len(b) == 2
+    monkeypatch.setenv("TTS_INCUMBENT_MAX_KEYS", "not-a-number")
+    assert incumbent.IncumbentBoard()._max_keys > 0  # typo -> default
+
+
+def test_fold_audit_gated_on_tts_audit(monkeypatch):
+    """TTS_AUDIT=0 disables the incumbent_monotone audit like every
+    other auditor call site — a sharing-enabled server with auditing
+    off must not book findings (or raise under TTS_AUDIT_HARD) from
+    the fold path."""
+    monkeypatch.setenv("TTS_AUDIT", "0")
+    board = incumbent.IncumbentBoard()
+    k = incumbent.instance_key(np.arange(12).reshape(3, 4))
+    client = incumbent.BoardClient(board, k)
+    board.publish(k, 50)
+    obs_audit.clear_findings()
+    assert client.cap() == 50
+    assert not [f for f in obs_audit.findings()
+                if f.invariant == "incumbent_monotone"]
+
+
+def test_share_parity_with_empty_board():
+    """A board holding nothing but this search's own publishes is a
+    bit-exact no-op (the fold is min(best, own best)) — the sharing
+    flag cannot change a lone request's answer."""
+    inst, opt = _setup()
+    plain = _dist(inst, opt, segment_iters=2)
+    board = incumbent.IncumbentBoard()
+    shared = _dist(inst, opt, segment_iters=2, incumbent_board=board)
+    assert _counts(shared) == _counts(plain)
+    assert board.peek(incumbent.instance_key(inst.p_times)) == opt
+
+
+def test_incumbent_fold_tightens_pruning(fresh_registry):
+    """A pre-published optimum folds in as the pruning ceiling: same
+    optimum, strictly fewer bound evaluations than the unshared run —
+    and the monotone audit + direction-labeled fold counters record
+    the exchange."""
+    inst, opt = _setup()
+    base = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                              n_devices=4, chunk=4, capacity=1 << 12,
+                              min_seed=8, segment_iters=2,
+                              heartbeat=None)
+    board = incumbent.IncumbentBoard()
+    board.publish(incumbent.instance_key(inst.p_times), opt)
+    obs_audit.clear_findings()
+    shared = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                                n_devices=4, chunk=4, capacity=1 << 12,
+                                min_seed=8, segment_iters=2,
+                                heartbeat=None, incumbent_board=board)
+    assert shared.best == base.best == opt
+    assert int(np.asarray(shared.per_device["evals"]).sum()) < \
+        int(np.asarray(base.per_device["evals"]).sum())
+    monotone = [f for f in obs_audit.findings()
+                if f.invariant == "incumbent_monotone"]
+    assert monotone and all(f.ok for f in monotone)
+    folds = fresh_registry.counter("tts_incumbent_folds_total", "")
+    assert folds.value(direction="in") >= 1
+    assert folds.value(direction="out") >= 1
+
+
+def test_service_concurrent_same_instance_share(fresh_registry):
+    """The acceptance criterion's service half: two concurrent requests
+    on the same instance — one seeded with the optimum, one cold —
+    finish with the same optimum and strictly fewer TOTAL bound
+    evaluations when TTS_SHARE_INCUMBENT wiring is on than off."""
+    inst, opt = _setup()
+
+    def run_pair(share):
+        with SearchServer(n_submeshes=2, share_incumbent=share,
+                          segment_iters=4) as srv:
+            ra = srv.submit(SearchRequest(
+                p_times=inst.p_times, lb_kind=1, init_ub=opt, chunk=4,
+                capacity=1 << 12, min_seed=8))
+            rb = srv.submit(SearchRequest(
+                p_times=inst.p_times, lb_kind=1, init_ub=None, chunk=4,
+                capacity=1 << 12, min_seed=8))
+            a = srv.result(ra, timeout=300).result
+            b = srv.result(rb, timeout=300).result
+            snap = srv.status_snapshot()
+        total = (int(np.asarray(a.per_device["evals"]).sum())
+                 + int(np.asarray(b.per_device["evals"]).sum()))
+        return a, b, total, snap
+
+    a0, b0, unshared, snap0 = run_pair(False)
+    a1, b1, shared, snap1 = run_pair(True)
+    assert a0.best == b0.best == a1.best == b1.best == opt
+    assert shared < unshared
+    assert snap0["incumbents"] is None
+    assert snap1["incumbents"] == {
+        incumbent.instance_key(inst.p_times): opt}
+
+
+def test_share_group_isolates(fresh_registry):
+    """share_group namespaces the exchange: a request in group 'a'
+    must not see a bound published under group 'b' for the same
+    instance."""
+    inst, opt = _setup()
+    board = incumbent.IncumbentBoard()
+    board.publish(incumbent.instance_key(inst.p_times, group="b"), opt)
+    res = distributed.search(
+        inst.p_times, lb_kind=1, init_ub=None, n_devices=4, chunk=4,
+        capacity=1 << 12, min_seed=8, segment_iters=2, heartbeat=None,
+        incumbent_board=board,
+        incumbent_key=incumbent.instance_key(inst.p_times, group="a"))
+    base = distributed.search(
+        inst.p_times, lb_kind=1, init_ub=None, n_devices=4, chunk=4,
+        capacity=1 << 12, min_seed=8, segment_iters=2, heartbeat=None)
+    # isolated: identical work to the unshared run, board gained the
+    # 'a' group's own publish beside the untouched 'b' entry
+    assert _counts(res) == _counts(base)
+    assert board.snapshot() == {
+        incumbent.instance_key(inst.p_times, group="b"): opt,
+        incumbent.instance_key(inst.p_times, group="a"): base.best}
+
+
+# ---------------------------------------------------------- gap table
+
+
+def test_search_report_segment_gaps():
+    import importlib.util
+    import os
+    import sys
+    tools = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools")
+    sys.path.insert(0, tools)
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "search_report", os.path.join(tools, "search_report.py"))
+        sr = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(sr)
+    finally:
+        sys.path.remove(tools)
+    # sync-shaped spans: back to back with host gaps between them
+    recs = [
+        {"name": "segment", "ts": 0.0, "dur": 1.0, "segment": 1,
+         "request_id": "r1"},
+        {"name": "segment", "ts": 1.5, "dur": 1.0, "segment": 2,
+         "request_id": "r1"},
+        # overlapped-shaped: span 3 starts BEFORE span 2 ends -> clamp 0
+        {"name": "segment", "ts": 2.0, "dur": 1.0, "segment": 3,
+         "request_id": "r1", "overlapped": True},
+    ]
+    gaps = sr.segment_gaps(recs)
+    g = gaps["r1"]
+    assert g["segments"] == 3 and g["overlapped"] == 1
+    assert g["gap_total_s"] == pytest.approx(0.5)
+    assert g["gap_max_ms"] == pytest.approx(500.0)
+    table = sr.render_gaps(gaps)
+    assert "r1" in table and "segment gaps" in table

@@ -327,6 +327,59 @@ def test_perf_sentry_regression_and_pass(tmp_path, capsys):
                              "--threshold", "0.3"]) == 0
 
 
+def test_perf_sentry_lower_is_better_direction(tmp_path, capsys):
+    """The segment-gap family regresses UPWARD: the reference is the
+    MINIMUM prior value and a value above it by more than the threshold
+    FAILs, while a further drop passes (and becomes the new best)."""
+    gap = "pfsp_ta014_segment_gap_s"
+    _wrapper(tmp_path, "BENCH_r01.json",
+             rows=[_row(metric=gap, value=0.004,
+                        unit="seconds_per_boundary")])
+    # a LOWER later round must be the retained reference, not the max
+    _wrapper(tmp_path, "BENCH_r02.json",
+             rows=[_row(metric=gap, value=0.002,
+                        unit="seconds_per_boundary")])
+    # +100% above the 0.002 minimum prior: a first-class FAIL
+    bad = _wrapper(tmp_path, "BENCH_r03.json",
+                   rows=[_row(metric=gap, value=0.004,
+                              unit="seconds_per_boundary")])
+    assert perf_sentry.main([bad, "--dir", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "FAIL" in out and "lowest prior" in out and "0.002" in out
+    # dropping further than the reference passes (overlap doing its job)
+    ok = _wrapper(tmp_path, "BENCH_r04.json",
+                  rows=[_row(metric=gap, value=0.0)])
+    assert perf_sentry.main([ok, "--dir", str(tmp_path)]) == 0
+    # the machine-readable verdict carries the direction
+    jp = tmp_path / "sentry.json"
+    perf_sentry.main([bad, "--dir", str(tmp_path), "--report-only",
+                      "--json", str(jp)])
+    j = json.loads(jp.read_text())
+    m = [v for v in j["metrics"] if v["metric"] == gap][0]
+    assert m["direction"] == "lower" and m["verdict"] == "FAIL"
+
+
+def test_perf_sentry_overlap_mode_not_cross_compared(tmp_path, capsys):
+    """A gap row's TTS_OVERLAP mode travels with it: an overlap-off
+    round judged against an overlap-on ~0.0 reference (or vice versa)
+    is SKIP, not FAIL — a sync gap is not a pipelined-gap regression."""
+    gap = "pfsp_ta014_segment_gap_s"
+    _wrapper(tmp_path, "BENCH_r01.json",
+             rows=[_row(metric=gap, value=0.0,
+                        unit="seconds_per_boundary", overlap=1)])
+    off = _wrapper(tmp_path, "BENCH_r02.json",
+                   rows=[_row(metric=gap, value=0.0021,
+                              unit="seconds_per_boundary", overlap=0)])
+    assert perf_sentry.main([off, "--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "SKIP" in out and "overlap mode" in out
+    # same mode still compares (and FAILs on a real upward move)
+    bad = _wrapper(tmp_path, "BENCH_r03.json",
+                   rows=[_row(metric=gap, value=0.004,
+                              unit="seconds_per_boundary", overlap=1)])
+    assert perf_sentry.main([bad, "--dir", str(tmp_path)]) == 1
+
+
 def test_perf_sentry_degraded_rows_not_rate_compared(tmp_path, capsys):
     _wrapper(tmp_path, "BENCH_r01.json", rows=[_row(value=1.0e8)])
     deg = _wrapper(tmp_path, "BENCH_r02.json",

@@ -134,7 +134,11 @@ def test_priority_preemption_and_checkpoint_resume(baselines, tmp_path):
     arrival preempts exactly one, runs to completion, and the preempted
     request resumes from its checkpoint to bit-identical totals."""
     slow, fast = small(5, jobs=8), small(6)
-    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+    # share_incumbent pinned off: both slow requests solve the SAME
+    # instance and the resume-exactness assertion compares each to the
+    # unshared baseline (sharing is covered by tests/test_overlap.py)
+    with SearchServer(n_submeshes=2, workdir=tmp_path,
+                      share_incumbent=False) as srv:
         slow_ids = [srv.submit(SearchRequest(
             p_times=slow.p_times, lb_kind=1, priority=0,
             segment_iters=32, checkpoint_every=1,
@@ -185,7 +189,12 @@ def test_corrupt_checkpoint_on_preemption_resumes_from_last_good(
     last-good snapshot (never load garbage, never FAIL the request) and
     still reach bit-identical totals."""
     inst = small(5, jobs=8)
-    with SearchServer(n_submeshes=2, workdir=tmp_path) as srv:
+    # share_incumbent pinned off: the board remembers bests published
+    # BEFORE the rollback, so a resumed dispatch would fold them in
+    # and (correctly) explore fewer nodes than the unshared baseline
+    # this test pins (sharing is covered by tests/test_overlap.py)
+    with SearchServer(n_submeshes=2, workdir=tmp_path,
+                      share_incumbent=False) as srv:
         # segment_iters=16 keeps dozens of segments ahead of the
         # preempt below — the stop must land while work remains
         rid = srv.submit(SearchRequest(

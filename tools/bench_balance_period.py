@@ -81,7 +81,8 @@ def main():
                                            period, tc, 2 * chunk, limit)
 
         def call():
-            out = loop(tables, jnp.int64(target), *stacked)
+            out = loop(tables, jnp.int64(target),
+                       jnp.int32(distributed.I32_MAX), *stacked)
             jax.block_until_ready(out)
 
         call()  # compile+warm at the final signature

@@ -105,7 +105,8 @@ def main():
     stacked = tuple(x[None] for x in state)
 
     def dist():
-        out = loop(tables, jnp.int64(target), *stacked)
+        out = loop(tables, jnp.int64(target),
+                   jnp.int32(distributed.I32_MAX), *stacked)
         jax.block_until_ready(out)
 
     ms_dist = timed(dist)

@@ -51,6 +51,12 @@ THRESHOLDS = {
     "lb2": 0.15,
 }
 
+# metric-name substrings whose values regress UPWARD (latencies, idle
+# gaps): the reference best is the MINIMUM prior value and a value
+# above it by more than the threshold FAILs. Everything else is a rate
+# (higher is better). First matching substring wins.
+LOWER_IS_BETTER = ("segment_gap", "_seconds", "latency")
+
 PASS, FAIL, NEW, SKIP = "PASS", "FAIL", "NEW", "SKIP"
 
 
@@ -59,6 +65,12 @@ def threshold_for(metric: str, overrides: dict) -> float:
         if pat != "_default" and pat in metric:
             return th
     return overrides.get("_default", THRESHOLDS["_default"])
+
+
+def direction_for(metric: str) -> int:
+    """+1 = higher is better (rates, the default); -1 = lower is
+    better (the segment-gap / latency family)."""
+    return -1 if any(s in metric for s in LOWER_IS_BETTER) else 1
 
 
 def _round_of(path: str) -> int:
@@ -117,11 +129,14 @@ def load_history(directory: str, before_round: int,
     `directory` plus BASELINE.json's published numbers."""
     best: dict = {}
 
-    def offer(metric, value, src, platform=None):
+    def offer(metric, value, src, platform=None, mode=None):
         if value is None:
             return
-        if metric not in best or value > best[metric][0]:
-            best[metric] = (float(value), src, platform)
+        better = (value > best[metric][0] if direction_for(metric) > 0
+                  else value < best[metric][0]) \
+            if metric in best else True
+        if better:
+            best[metric] = (float(value), src, platform, mode)
 
     for path in sorted(glob.glob(os.path.join(directory,
                                               "BENCH_*.json"))):
@@ -135,7 +150,7 @@ def load_history(directory: str, before_round: int,
             if row.get("degraded"):
                 continue            # fallback-platform rate: not a bar
             offer(row.get("metric"), row.get("value"), src["source"],
-                  row.get("platform"))
+                  row.get("platform"), row.get("overlap"))
     if baseline_path and os.path.exists(baseline_path):
         try:
             with open(baseline_path) as f:
@@ -183,37 +198,62 @@ def judge(sources: list[dict], history: dict,
                  "degraded": bool(row.get("degraded"))}
             ref = history.get(metric)
             refplat = ref[2] if ref is not None else None
+            refmode = (ref[3] if ref is not None and len(ref) > 3
+                       else None)
             plat_mismatch = (ref is not None and refplat
                              and row.get("platform")
                              and refplat != row["platform"])
-            if ref is not None and (v["degraded"] or plat_mismatch):
-                # a fallback-platform (or different-platform) rate
-                # compared against the reference best would always
-                # "regress" — a CPU rate is not a TPU finding
+            # rows carry their TTS_OVERLAP mode precisely so an
+            # overlap-off gap is never judged against an overlap-on
+            # ~0.0 reference (or vice versa) — different mode, no bar
+            mode_mismatch = (ref is not None and refmode is not None
+                             and row.get("overlap") is not None
+                             and refmode != row["overlap"])
+            if ref is not None and (v["degraded"] or plat_mismatch
+                                    or mode_mismatch):
+                # a fallback-platform (or different-platform, or
+                # different-overlap-mode) value compared against the
+                # reference best would always "regress" — a CPU rate
+                # is not a TPU finding, a sync gap not a pipelined one
+                why = (f"overlap mode {row.get('overlap')!r} vs "
+                       f"reference mode {refmode!r}" if mode_mismatch
+                       else f"platform {row.get('platform')!r}"
+                       + (" (degraded)" if v["degraded"] else "")
+                       + f" vs reference platform {refplat!r}")
                 v.update(verdict=SKIP,
-                         detail=f"platform {row.get('platform')!r}"
-                                + (" (degraded)" if v["degraded"]
-                                   else "")
-                                + f" vs reference platform "
-                                  f"{refplat!r}; rate not compared "
-                                  f"(reference {ref[0]:.4g})")
+                         detail=f"{why}; rate not compared "
+                                f"(reference {ref[0]:.4g})")
             elif ref is None:
                 v.update(verdict=NEW,
                          detail="no prior value for this metric")
             else:
                 refv, refsrc = ref[0], ref[1]
                 th = threshold_for(metric, overrides)
-                delta = (value - refv) / refv if refv else 0.0
+                direction = direction_for(metric)
+                # a 0.0 reference is REAL for the lower-is-better
+                # family (a perfect-overlap gap round); floor the
+                # denominator so a later nonzero gap still reads as a
+                # huge upward move instead of silently passing
+                delta = (value - refv) / max(refv, 1e-9)
                 v.update(reference=refv, reference_source=refsrc,
-                         delta=delta, threshold=th)
-                if delta < -th:
+                         delta=delta, threshold=th,
+                         direction=("lower" if direction < 0
+                                    else "higher"))
+                # regression = the metric moved AGAINST its direction
+                # by more than the threshold: rates fail below -th,
+                # lower-is-better metrics (segment_gap_s) fail above +th
+                regressed = (delta < -th if direction > 0
+                             else delta > th)
+                word = "best" if direction > 0 else "lowest"
+                if regressed:
+                    sign = "-" if direction > 0 else "+"
                     v.update(verdict=FAIL,
-                             detail=f"{delta:+.1%} vs best prior "
+                             detail=f"{delta:+.1%} vs {word} prior "
                                     f"{refv:.4g} ({refsrc}); "
-                                    f"threshold -{th:.0%}")
+                                    f"threshold {sign}{th:.0%}")
                 else:
                     v.update(verdict=PASS,
-                             detail=f"{delta:+.1%} vs best prior "
+                             detail=f"{delta:+.1%} vs {word} prior "
                                     f"{refv:.4g} ({refsrc})")
             verdicts.append(v)
     order = {FAIL: 0, NEW: 1, SKIP: 2, PASS: 3}
@@ -239,8 +279,8 @@ def render_json(verdicts: list[dict], latest_round: int) -> dict:
         "metrics": [
             {k: v.get(k) for k in
              ("verdict", "source", "metric", "value", "reference",
-              "reference_source", "delta", "threshold", "platform",
-              "degraded", "detail")}
+              "reference_source", "delta", "threshold", "direction",
+              "platform", "degraded", "detail")}
             for v in verdicts],
     }
 

@@ -15,7 +15,12 @@ engine/telemetry.py) into two tables:
 - **load imbalance**: for distributed segments (the event carries
   per-worker eval deltas), min/max/mean evals per worker and the
   max/mean imbalance factor — the starved-worker view the reference's
-  boxplot stats print per pool.
+  boxplot stats print per pool;
+- **segment gaps**: device idle between consecutive ``segment`` spans
+  (dispatch -> results-ready intervals; needs no telemetry flag) —
+  run it on a TTS_OVERLAP=0 and a TTS_OVERLAP=1 trace of the same
+  workload and the table IS the overlap win: the gap column collapses
+  to ~0 when the pipelined driver dispatches ahead of the fetch.
 
 Given a DIRECTORY — an XLA profiler artifact, i.e. what
 ``POST /profile``, the `profile` CLI subcommand or
@@ -45,6 +50,7 @@ sys.path.insert(
 from trace_summary import load_records  # noqa: E402
 
 TELEMETRY_EVENT = "search.telemetry"
+SEGMENT_SPAN = "segment"
 
 
 def fold(records: list[dict]) -> dict[str, list[dict]]:
@@ -100,6 +106,59 @@ def render(groups: dict[str, list[dict]]) -> str:
     n_seg = sum(len(v) for v in groups.values())
     lines.append("")
     lines.append(f"{len(groups)} run(s), {n_seg} telemetry segment(s)")
+    return "\n".join(lines)
+
+
+def segment_gaps(records: list[dict]) -> dict[str, dict]:
+    """Device-idle gaps between consecutive ``segment`` spans, grouped
+    by request id ('-' for unserved runs).
+
+    A segment span covers [dispatch, results-ready]; the gap between
+    span N's end and span N+1's start is time the device waited on the
+    host (heartbeat, checkpoint write, stop checks). With TTS_OVERLAP
+    the next dispatch lands BEFORE the previous results return, so
+    consecutive spans overlap and the gap clamps to 0 — running this
+    table on a before/after pair of traces is the overlap win, measured.
+    """
+    spans: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("name") == SEGMENT_SPAN and "dur" in r:
+            spans.setdefault(str(r.get("request_id") or "-"),
+                             []).append(r)
+    out: dict[str, dict] = {}
+    for rid, ss in spans.items():
+        ss.sort(key=lambda r: (float(r.get("ts", 0.0)),
+                               r.get("segment", 0)))
+        gaps = []
+        for prev, cur in zip(ss, ss[1:]):
+            end = float(prev["ts"]) + float(prev.get("dur", 0.0))
+            gaps.append(max(0.0, float(cur["ts"]) - end))
+        busy = sum(float(r.get("dur", 0.0)) for r in ss)
+        out[rid] = {
+            "segments": len(ss),
+            "overlapped": sum(1 for r in ss if r.get("overlapped")),
+            "busy_s": busy,
+            "gap_total_s": sum(gaps),
+            "gap_mean_ms": (1e3 * sum(gaps) / len(gaps)) if gaps else 0.0,
+            "gap_max_ms": 1e3 * max(gaps, default=0.0),
+            "gap_share": (sum(gaps) / (busy + sum(gaps))
+                          if busy + sum(gaps) > 0 else 0.0),
+        }
+    return out
+
+
+def render_gaps(gaps: dict[str, dict]) -> str:
+    hdr = (f"{'request':<10} {'segs':>5} {'ovl':>4} {'busy_s':>9} "
+           f"{'gap_s':>8} {'gap_mean':>9} {'gap_max':>9} {'idle%':>6}")
+    lines = ["", "segment gaps (device idle between segment spans; "
+                 "~0 with TTS_OVERLAP)", hdr, "-" * len(hdr)]
+    for rid in sorted(gaps):
+        g = gaps[rid]
+        lines.append(
+            f"{rid:<10} {g['segments']:>5} {g['overlapped']:>4} "
+            f"{g['busy_s']:>9.3f} {g['gap_total_s']:>8.3f} "
+            f"{g['gap_mean_ms']:>7.1f}ms {g['gap_max_ms']:>7.1f}ms "
+            f"{100.0 * g['gap_share']:>5.1f}%")
     return "\n".join(lines)
 
 
@@ -161,13 +220,21 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     groups = fold(records)
-    if not groups:
+    gaps = segment_gaps(records)
+    if not groups and not gaps:
         print(f"error: {len(records)} records but no "
-              f"'{TELEMETRY_EVENT}' events in {args.trace} — was the "
-              "run started with TTS_SEARCH_TELEMETRY=1 / "
-              "--search-telemetry?", file=sys.stderr)
+              f"'{TELEMETRY_EVENT}' events or '{SEGMENT_SPAN}' spans "
+              f"in {args.trace} — was the run started with "
+              "TTS_SEARCH_TELEMETRY=1 / --search-telemetry, or "
+              "segmented at all?", file=sys.stderr)
         return 1
-    print(render(groups))
+    if groups:
+        print(render(groups))
+    else:
+        print(f"# no '{TELEMETRY_EVENT}' events (TTS_SEARCH_TELEMETRY "
+              "off) — segment-gap table only", file=sys.stderr)
+    if gaps:
+        print(render_gaps(gaps))
     return 0
 
 

@@ -188,6 +188,23 @@ def _serve_parser(sub):
                         f"{_cfg.OBS_HEALTH_INTERVAL_S_DEFAULT}, also "
                         "via TTS_HEALTH_INTERVAL_S; <= 0 disables "
                         "the daemon — thresholds via TTS_HEALTH_*)")
+    p.add_argument("--overlap", action="store_true",
+                   help="pipeline segmented execution (also via "
+                        "TTS_OVERLAP=1): the next segment dispatches "
+                        "before the previous segment's counters are "
+                        "fetched (donated carries) and checkpoint "
+                        "serialization moves to a writer thread — "
+                        "device-idle gap between segments -> ~0 "
+                        "(tts_segment_gap_seconds), bit-identical "
+                        "node accounting")
+    p.add_argument("--share-incumbent", action="store_true",
+                   help="share best-makespan incumbents across "
+                        "concurrent same-instance requests (also via "
+                        "TTS_SHARE_INCUMBENT=1): each segment boundary "
+                        "publishes the submesh's best and folds the "
+                        "global best in as the next pruning ceiling "
+                        "(monotone-only, audited; "
+                        "tts_incumbent_folds_total)")
 
 
 def _client_parser(sub):
@@ -221,6 +238,12 @@ def run_serve(args) -> int:
     if args.search_telemetry:
         # static compile-in flag, read at each request's state init
         os.environ["TTS_SEARCH_TELEMETRY"] = "1"
+    if args.overlap:
+        # env too, not just the server knob: campaign-style respawns
+        # and in-process tools must see the same static flag
+        os.environ["TTS_OVERLAP"] = "1"
+    if args.share_incumbent:
+        os.environ["TTS_SHARE_INCUMBENT"] = "1"
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -233,7 +256,10 @@ def run_serve(args) -> int:
                           phase_profile=(True if args.phase_metrics
                                          else None),
                           resource_sample_s=args.resource_sample_s,
-                          health_interval_s=args.health_interval_s
+                          health_interval_s=args.health_interval_s,
+                          overlap=(True if args.overlap else None),
+                          share_incumbent=(True if args.share_incumbent
+                                           else None)
                           ) as srv:
             if args.http_port is not None:
                 from .obs.httpd import start_http_server

@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import pathlib
+import queue
 import threading
 import time
 import warnings
@@ -141,13 +142,19 @@ def _to_np(x) -> np.ndarray:
     return np.asarray(x)
 
 
-def _fetch_many(xs: tuple) -> tuple:
+def _fetch_many(xs: tuple, fire: bool = True) -> tuple:
     """One batched device->host fetch of several small arrays. On a
     remote-TPU runtime every separate np.asarray is a full roundtrip;
     a single device_get puts all transfers in flight together, so the
     batch costs ~one latency instead of len(xs). Multihost shards fall
-    back to the collective allgather path per leaf."""
-    faults.fire("host_fetch")      # deterministic transient-error hook
+    back to the collective allgather path per leaf.
+
+    `fire=False` skips the fault-injection hook: checkpoint-state
+    fetches reuse this batching but were never an injection point (the
+    resilience tests' fail_host_fetch budgets count HEARTBEAT fetches),
+    and the budget must not drift when the save path batches too."""
+    if fire:
+        faults.fire("host_fetch")  # deterministic transient-error hook
     if any(not getattr(x, "is_fully_addressable", True) for x in xs):
         return tuple(_to_np(x) for x in xs)
     import jax
@@ -196,6 +203,13 @@ def resume_path(path: str | pathlib.Path) -> pathlib.Path | None:
 # pools compress to tens-of-MB..GB
 _BYTES_BUCKETS = (1e4, 1e5, 1e6, 1e7, 1e8, 1e9)
 
+# segment-gap buckets (seconds): sub-ms when overlapped, up to the cost
+# of a full heartbeat + checkpoint round when not
+GAP_BUCKETS = (0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0, 30.0)
+GAP_HELP = ("device-idle gap between consecutive segments: dispatch of "
+            "segment N+1 minus results-ready of segment N, clamped at 0 "
+            "(TTS_OVERLAP drives this to ~0)")
+
 
 def save(path: str | pathlib.Path, state: SearchState,
          meta: dict | None = None):
@@ -211,12 +225,19 @@ def save(path: str | pathlib.Path, state: SearchState,
         except OSError:
             pass          # non-writer multihost rank, or racing rotate
         sp.set(bytes=nbytes)
+    _record_save_metrics(sp.dur, nbytes)
+
+
+def _record_save_metrics(dur: float, nbytes: int) -> None:
+    """Post-write bookkeeping shared by the sync :func:`save` and the
+    async writer thread — one definition so the two drivers' series
+    (names, help, buckets) can never drift."""
     reg = obs_metrics.default()
     reg.counter("tts_checkpoint_saves_total",
                 "checkpoint snapshots written").inc()
     reg.histogram("tts_checkpoint_save_seconds",
                   "checkpoint save latency (fetch+compress+fsync)"
-                  ).observe(sp.dur)
+                  ).observe(dur)
     if nbytes:
         reg.histogram("tts_checkpoint_bytes", "checkpoint file size",
                       buckets=_BYTES_BUCKETS).observe(nbytes)
@@ -241,13 +262,35 @@ def _save_impl(path: str | pathlib.Path, state: SearchState,
     the new snapshot — never a half-written file under the resume path
     (load_resilient picks the newest loadable one).
     """
+    arrays = snapshot_arrays(state, meta)
+    if arrays is None:
+        return                           # non-writer multihost rank
+    _write_snapshot(path, arrays)
+
+
+def snapshot_arrays(state: SearchState, meta: dict | None = None
+                    ) -> dict | None:
+    """Fetch a state's live rows and assemble the checkpoint payload
+    (everything up to, but not including, the schema/CRC stamps). The
+    host half of a save, split out so the async writer path can run it
+    on the DISPATCH thread — while the device arrays are still valid —
+    and hand the host arrays to the writer thread for the compress +
+    fsync half (:func:`_write_snapshot`).
+
+    The fetch is ONE batched device_get of every live-row slice — the
+    per-leaf roundtrips the old save paid (len(fields) latencies on a
+    remote-TPU tunnel) collapse to one.
+
+    Returns None on non-writer multihost ranks: every rank must reach
+    this point (the fetches are collective allgathers there), but only
+    process 0 may write — concurrent writes + renames of one tmp file
+    on a shared filesystem can corrupt or race the checkpoint."""
     sizes = np.atleast_1d(_to_np(state.size))
     n = int(sizes.max())
-    arrays = {}
-    for f, x in zip(SearchState._fields, state):
-        if f in POOL_FIELDS:
-            x = x[..., :n]               # feature-major: row axis is last
-        arrays[f] = _to_np(x)
+    leaves = tuple(x[..., :n] if f in POOL_FIELDS else x
+                   for f, x in zip(SearchState._fields, state))
+    arrays = dict(zip(SearchState._fields,
+                      _fetch_many(leaves, fire=False)))
     arrays["meta_capacity"] = np.asarray(state.prmu.shape[-1])
     arrays["meta_pool_layout"] = np.asarray(1)   # 1 = feature-major
     if meta:
@@ -258,15 +301,18 @@ def _save_impl(path: str | pathlib.Path, state: SearchState,
                              "by the checkpoint format")
         for k, v in meta.items():
             arrays[f"meta_{k}"] = np.asarray(v)
-    # Multi-controller: every process reaches this point (the _to_np
-    # fetches above are COLLECTIVE allgathers, so all ranks must run
-    # them and all hold identical data), but only process 0 writes —
-    # concurrent writes + renames of the same tmp file on a shared
-    # filesystem can corrupt or race the checkpoint. resume reads the
-    # same shared path on every process (load() is read-only).
     import jax
     if jax.process_index() != 0:
-        return
+        return None
+    return arrays
+
+
+def _write_snapshot(path: str | pathlib.Path, arrays: dict) -> None:
+    """The durable half of a save: stamp schema + CRC, write to a temp
+    file, fsync, rotate current -> `.prev` last-good, rename into
+    place, fsync the directory. Pure host work on already-fetched
+    arrays — exactly what the async checkpoint writer runs off the
+    dispatch thread. Idempotent w.r.t. retry (stamps overwrite)."""
     arrays["meta_schema_version"] = np.asarray(SCHEMA_VERSION)
     arrays["meta_crc32"] = np.asarray(_payload_crc(arrays), np.uint32)
     path = pathlib.Path(path)
@@ -291,6 +337,159 @@ def _save_impl(path: str | pathlib.Path, state: SearchState,
             os.close(dfd)
     except OSError:
         pass   # not every filesystem supports directory fsync
+
+
+class AsyncCheckpointWriter:
+    """Single writer thread that takes checkpoint serialization + fsync
+    off the segment dispatch thread (half of TTS_OVERLAP — see
+    :func:`run_segmented`).
+
+    Ordering and durability:
+
+    - ONE thread, FIFO queue: writes land in submission order, so the
+      current/``.prev`` rotation invariant of :func:`_write_snapshot`
+      holds exactly as in the sync path — the last-good sibling is
+      always the previous successfully written snapshot, never dropped
+      or reordered;
+    - the queue is BOUNDED (config.ASYNC_CKPT_QUEUE_DEPTH): a dispatch
+      thread outrunning the disk blocks in :meth:`enqueue` —
+      back-pressure, never an unbounded buffer of multi-MB snapshots
+      and never a silently dropped write;
+    - the host-fetch half (:func:`snapshot_arrays`) runs on the CALLING
+      thread via :meth:`prepare` — the device arrays may be donated to
+      the next segment's dispatch immediately afterwards — and only
+      the compress + fsync + rotate half crosses the thread;
+    - :meth:`drain` blocks until everything queued is ON DISK and
+      re-raises the first writer-side error; every overlapped exit path
+      drains before returning, so a returned state always has its final
+      checkpoint durable (the same contract the sync path gives).
+
+    The writer re-installs the submitting thread's fault plan and trace
+    context (request id kept; ``submesh`` dropped so its spans render
+    on a dedicated ``tts-ckpt-writer`` Perfetto lane) and runs the same
+    post-write hooks the sync path runs, in the same order: the
+    checkpoint-roundtrip audit — against counter sums captured at
+    prepare() time, so the conservation check spans the async edge —
+    and then the ``post_checkpoint`` fault injection."""
+
+    def __init__(self, retry_attempts: int | None = None,
+                 retry_base_s: float | None = None,
+                 max_pending: int | None = None):
+        from ..utils import config as _cfg
+        if retry_attempts is None:
+            retry_attempts = int(os.environ.get(
+                "TTS_RETRY_ATTEMPTS", _cfg.RETRY_ATTEMPTS_DEFAULT))
+        if retry_base_s is None:
+            retry_base_s = float(os.environ.get(
+                "TTS_RETRY_BASE_S", _cfg.RETRY_BASE_S_DEFAULT))
+        self.retry_attempts = retry_attempts
+        self.retry_base_s = retry_base_s
+        self._q: queue.Queue = queue.Queue(
+            maxsize=max_pending or _cfg.ASYNC_CKPT_QUEUE_DEPTH)
+        self._err: BaseException | None = None
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="tts-ckpt-writer")
+        self._thread.start()
+
+    # ------------------------------------------------- submitting side
+
+    def prepare(self, path, state: SearchState, meta: dict | None = None,
+                segment: int | None = None) -> dict | None:
+        """Fetch + assemble the snapshot on the CALLING thread (the
+        arrays must be read before the pools are donated onward).
+        Returns the task for :meth:`enqueue` — or None when this rank
+        must not write (non-writer multihost process)."""
+        from ..obs import audit as obs_audit
+        arrays = snapshot_arrays(state, meta)
+        if arrays is None:
+            return None
+        sums = None
+        if obs_audit.roundtrip_enabled():
+            host = SearchState(*(arrays[f] for f in SearchState._fields))
+            sums = obs_audit.state_sums(host)
+        ctx = {**tracelog.current_context(), "submesh": None}
+        return {"path": str(path), "arrays": arrays, "sums": sums,
+                "segment": segment, "plan": faults.active(), "ctx": ctx}
+
+    def enqueue(self, task: dict | None) -> None:
+        """Queue a prepared task; blocks at the back-pressure bound.
+        Re-raises the first pending writer-side error first (an
+        earlier failed write must not be papered over by later ones)."""
+        self._raise_pending()
+        if task is None:
+            return
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        self._q.put(task)
+
+    def submit(self, path, state: SearchState, meta: dict | None = None,
+               segment: int | None = None) -> None:
+        """prepare() + enqueue() in one call."""
+        self.enqueue(self.prepare(path, state, meta, segment=segment))
+
+    def drain(self) -> None:
+        """Block until every queued snapshot is on disk; re-raise the
+        first writer-side error (a failed final save must fail the run,
+        exactly as the sync path would)."""
+        self._q.join()
+        self._raise_pending()
+
+    def close(self, raise_pending: bool = True) -> None:
+        """Drain, stop the thread, optionally surface pending errors
+        (False on exception-unwind paths, where masking the original
+        error with a writer error would hide the root cause)."""
+        if not self._closed:
+            self._closed = True
+            self._q.put(None)
+            self._thread.join()
+        if raise_pending:
+            self._raise_pending()
+
+    def _raise_pending(self) -> None:
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise err
+
+    # ---------------------------------------------------- writer thread
+
+    def _loop(self) -> None:
+        while True:
+            task = self._q.get()
+            try:
+                if task is None:
+                    return
+                self._write_one(task)
+            except BaseException as e:  # noqa: BLE001 — surfaced at the
+                if self._err is None:   # next enqueue()/drain()
+                    self._err = e
+            finally:
+                self._q.task_done()
+
+    def _write_one(self, task: dict) -> None:
+        path = task["path"]
+        with faults.scoped(task["plan"]), \
+                tracelog.get().context(**task["ctx"]):
+            with tracelog.span("checkpoint.save", path=path,
+                               async_write=True) as sp:
+                _retry(lambda: _write_snapshot(path, task["arrays"]),
+                       "checkpoint save", self.retry_attempts,
+                       self.retry_base_s)
+                nbytes = 0
+                try:
+                    nbytes = os.path.getsize(path)
+                except OSError:
+                    pass
+                sp.set(bytes=nbytes)
+            _record_save_metrics(sp.dur, nbytes)
+            from ..obs import audit as obs_audit
+            if task["sums"] is not None:
+                # audit BEFORE the fault injection below, same order as
+                # the sync do_save: the injected corruption is a
+                # load-side drill, not a write-side failure
+                obs_audit.check_checkpoint_roundtrip(path, task["sums"])
+            faults.fire("post_checkpoint", segment=task["segment"],
+                        path=path)
 
 
 def load(path: str | pathlib.Path,
@@ -676,6 +875,99 @@ class SegmentReport:
     telemetry: dict | None = None
 
 
+class _ReportFolder:
+    """Per-segment report assembly shared by the sync and overlapped
+    segment drivers: fold a fetched counter/telemetry block into the
+    per-worker stats dict, the per-segment ``search.telemetry`` delta
+    event, the SegmentReport, the explored-node throughput counter and
+    the no-progress stall check. ONE implementation, so the on/off
+    bit-parity the overlap feature promises extends to everything the
+    two drivers record — a schema or semantics change cannot land in
+    one driver and silently drift the other."""
+
+    def __init__(self, state: SearchState, t0: float, stall_limit: int,
+                 start_iters: int):
+        self.t0 = t0
+        self.stall_limit = stall_limit
+        self.stalls = 0
+        self.last = (start_iters, -1, -1)
+        # resumed states carry cumulative totals; throughput metrics
+        # must count only THIS run's progress. Telemetry width via
+        # .shape, never np.asarray: materializing a state leaf here
+        # raises on multihost runs (non-addressable shards — the
+        # hazard _to_np exists for)
+        self.prev_tree = int(np.atleast_1d(_to_np(state.tree)).sum())
+        self.tele_w = int(state.telemetry.shape[-1])
+        # search-telemetry deltas start from the INCOMING block (a
+        # resumed checkpoint's counts must not re-report as segment-1
+        # activity)
+        self.prev_tele = (
+            tele.merge(np.atleast_2d(_to_np(state.telemetry)))
+            if self.tele_w else None)
+        self.prev_evals = np.atleast_1d(_to_np(state.evals)).copy()
+        self.nodes_c = obs_metrics.default().counter(
+            "tts_nodes_explored_total",
+            "explored-node throughput (segment deltas)")
+
+    def fold(self, fetched: tuple, seg: int) -> SegmentReport:
+        (f_iters, f_tree, f_sol, sizes, f_best, f_steals, _f_ovf,
+         f_evals) = fetched[:8]
+        iters = int(f_iters.max())
+        tree = int(f_tree.sum())
+        sol = int(f_sol.sum())
+        size = int(sizes.sum())
+        per_worker = None
+        if sizes.ndim:                      # stacked distributed state
+            per_worker = {"size": sizes.tolist(),
+                          "steals": f_steals.tolist(),
+                          "best": f_best.tolist(),
+                          "iters": f_iters.tolist(),
+                          "evals": f_evals.tolist()}
+        tele_summary = None
+        if self.tele_w:
+            # cumulative summary for the report + a per-segment DELTA
+            # event for the trace — the time series Perfetto counter
+            # tracks and tools/search_report.py render
+            merged = tele.merge(np.atleast_2d(fetched[8]))
+            tele_summary = tele.summarize(merged)
+            deltas = tele.delta_counts(merged, self.prev_tele)
+            evals_d = np.atleast_1d(f_evals) - self.prev_evals
+            ev = {}
+            if sizes.ndim:
+                ev = {"workers": int(sizes.shape[0]),
+                      "evals_pw": evals_d.tolist()}
+            tracelog.event(
+                "search.telemetry", segment=seg, **deltas, pool=size,
+                pool_hw=tele_summary["pool_highwater"],
+                best=int(f_best.min()),
+                improvements=tele_summary["improvements"], **ev)
+            self.prev_tele = merged
+            self.prev_evals = np.atleast_1d(f_evals).copy()
+        # per-segment DELTA, so the counter is live throughput, not the
+        # cumulative totals a resumed checkpoint would double-report
+        self.nodes_c.inc(max(tree - self.prev_tree, 0))
+        self.prev_tree = tree
+        return SegmentReport(
+            segment=seg, iters=iters, tree=tree, sol=sol,
+            best=int(f_best.min()), pool_size=size,
+            elapsed=time.perf_counter() - self.t0,
+            per_worker=per_worker, evals=int(f_evals.sum()),
+            telemetry=tele_summary)
+
+    def check_stall(self, report: SegmentReport) -> None:
+        key = (report.iters, report.tree, report.sol)
+        if key == self.last:
+            self.stalls += 1
+            if self.stalls >= self.stall_limit:
+                raise RuntimeError(
+                    f"search stalled: no progress across {self.stalls} "
+                    f"segments (iters={report.iters}, "
+                    f"pool={report.pool_size})")
+        else:
+            self.stalls = 0
+        self.last = key
+
+
 def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   checkpoint_path: str | None = None,
                   checkpoint_every: int = 1,
@@ -688,7 +980,10 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                   should_stop=None,
                   retry_attempts: int | None = None,
                   retry_base_s: float | None = None,
-                  segment_timeout_s: float | None = None):
+                  segment_timeout_s: float | None = None,
+                  overlap: bool = False,
+                  grow_fn=None,
+                  stop_pending=None):
     """Drive `run_fn(state, target_total_iters) -> state` to exhaustion in
     bounded segments.
 
@@ -727,6 +1022,43 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     TTS_RETRY_ATTEMPTS (3), TTS_RETRY_BASE_S (0.5) and
     TTS_SEG_TIMEOUT_S (0 = off). Deterministic fault injection for all
     of these lives in utils/faults.py (TTS_FAULTS).
+
+    Overlap (`overlap=True`, the driver side of TTS_OVERLAP —
+    engine/distributed.search resolves the flag and supplies the
+    hooks): `run_fn` must then be an ASYNC dispatch (returns the next
+    state's futures without blocking — _DistDriver.run_async, pool
+    leaves donated) and execution pipelines: segment N+1 is dispatched
+    BEFORE segment N's counters are fetched, so the heartbeat always
+    consumes the PREVIOUS segment's report while the device computes,
+    and the device-idle gap between segments (the new
+    `tts_segment_gap_seconds` histogram; both modes record it) drops
+    to ~0. Checkpoint serialization + fsync move to a bounded-queue
+    AsyncCheckpointWriter thread; only the live-row host fetch stays on
+    the dispatch thread (checkpoint segments therefore dispatch after
+    that fetch — the one per-`checkpoint_every` synchronization the
+    format's rotation invariants require). `grow_fn(state) -> state`
+    is the lossless overflow recovery (fetch + grow + recommit);
+    `stop_pending() -> bool` is a report-free stop probe that skips
+    speculative dispatch when a stop was already requested. Exit
+    conditions are evaluated one segment later than the sync path
+    (the in-flight speculative segment is drained, never discarded —
+    it no-ops when the pool is empty or overflowed), so a stop request
+    costs at most one extra segment; totals at exhaustion are
+    bit-identical to overlap-off. Incompatible with `post_segment`
+    (the host-tier merge mutates state the pipeline has already
+    donated) — callers must force overlap off alongside a host tier.
+
+    The resilience contract under overlap is NARROWER than sync's: a
+    transient error in segment EXECUTION cannot be retried in place —
+    the failed dispatch's input pools were donated, so there is no
+    prior state to re-run and the retry wrapper around the counter
+    fetch can only re-observe the poisoned output. In-place retries
+    cover the host-side I/O edges (fetch, save); recovery from a
+    failed segment is the OUTER tier's job — checkpoint re-dispatch
+    (the service's re-queue path, `load_resilient` standalone), which
+    is exactly what the durability layer exists for. Runs that need
+    in-place execution retries (no checkpoint, no supervisor) should
+    keep overlap off.
     """
     from ..utils import config as _cfg
     if retry_attempts is None:
@@ -747,25 +1079,38 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         # is a distributed hang, strictly worse than the transient it
         # retries. Fail loudly instead; multihost recovery is
         # restart-the-job-level (every process resumes from the shared
-        # checkpoint), not retry-in-place.
+        # checkpoint), not retry-in-place. The same reasoning disables
+        # overlap: speculative dispatch would reorder collectives
+        # against the allgather-bearing fetches.
         retry_attempts = 1
+        overlap = False
+    if overlap:
+        if post_segment is not None:
+            raise ValueError(
+                "overlap=True is incompatible with post_segment (the "
+                "host-tier merge mutates state the pipeline has already "
+                "donated); run the host tier with overlap off")
+        return _run_segmented_overlap(
+            run_fn, state, segment_iters=segment_iters,
+            checkpoint_path=checkpoint_path,
+            checkpoint_every=checkpoint_every, heartbeat=heartbeat,
+            max_segments=max_segments, max_total_iters=max_total_iters,
+            stall_limit=stall_limit, raise_on_overflow=raise_on_overflow,
+            checkpoint_meta=checkpoint_meta, should_stop=should_stop,
+            retry_attempts=retry_attempts, retry_base_s=retry_base_s,
+            segment_timeout_s=segment_timeout_s, grow_fn=grow_fn,
+            stop_pending=stop_pending)
     t0 = time.perf_counter()
     seg = 0
-    stalls = 0
     start_iters = int(_to_np(state.iters).max())
-    # resumed states carry cumulative totals; throughput metrics must
-    # count only THIS run's progress
-    prev_tree = int(np.atleast_1d(_to_np(state.tree)).sum())
-    # search-telemetry deltas start from the INCOMING block (a resumed
-    # checkpoint's counts must not re-report as segment-1 activity).
-    # Width via .shape, never np.asarray: materializing a state leaf
-    # here raises on multihost runs (non-addressable shards — the
-    # hazard _to_np exists for)
-    tele_w = int(state.telemetry.shape[-1])
-    prev_tele = (tele.merge(np.atleast_2d(_to_np(state.telemetry)))
-                 if tele_w else None)
-    prev_evals = np.atleast_1d(_to_np(state.evals)).copy()
-    last = (start_iters, -1, -1)
+    folder = _ReportFolder(state, t0, stall_limit, start_iters)
+    # device-idle accounting shared with the overlapped driver: the gap
+    # between segment N's results landing on the host and segment N+1's
+    # dispatch is time the device spends waiting on the host (heartbeat,
+    # checkpoint, stop checks) — the exact interval TTS_OVERLAP removes
+    gap_hist = obs_metrics.default().histogram(
+        "tts_segment_gap_seconds", GAP_HELP, buckets=GAP_BUCKETS)
+    results_ready_t = None
 
     def meta_now(seg):
         base = checkpoint_meta() if callable(checkpoint_meta) \
@@ -804,6 +1149,8 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         # failure), so a retried segment redoes identical work; the
         # watchdog wraps each attempt separately
         prev_state = state
+        if results_ready_t is not None:
+            gap_hist.observe(max(0.0, time.monotonic() - results_ready_t))
         with tracelog.span("segment", segment=seg + 1) as seg_span:
             state = _retry(
                 lambda: _with_watchdog(
@@ -829,60 +1176,25 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                         (state.iters, state.tree, state.sol,
                          state.size, state.best, state.steals,
                          state.overflow, state.evals)
-                        + ((state.telemetry,) if tele_w else ())),
+                        + ((state.telemetry,) if folder.tele_w
+                           else ())),
                     segment_timeout_s, f"segment {seg} result fetch"),
                 "per-segment host fetch", retry_attempts, retry_base_s)
-            (f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf,
-             f_evals) = fetched[:8]
-            iters = int(f_iters.max())
-            tree = int(f_tree.sum())
-            sol = int(f_sol.sum())
-            size = int(sizes.sum())
-            seg_span.set(iters=iters, tree=tree, sol=sol, pool=size,
-                         best=int(f_best.min()))
-        per_worker = None
-        if sizes.ndim:                          # stacked distributed state
-            per_worker = {"size": sizes.tolist(),
-                          "steals": f_steals.tolist(),
-                          "best": f_best.tolist(),
-                          "iters": f_iters.tolist(),
-                          "evals": f_evals.tolist()}
-        tele_summary = None
-        if tele_w:
-            # cumulative summary for the report + a per-segment DELTA
-            # event for the trace — the time series Perfetto counter
-            # tracks and tools/search_report.py render
-            merged = tele.merge(np.atleast_2d(fetched[8]))
-            tele_summary = tele.summarize(merged)
-            deltas = tele.delta_counts(merged, prev_tele)
-            evals_d = np.atleast_1d(f_evals) - prev_evals
-            ev = {}
-            if sizes.ndim:
-                ev = {"workers": int(sizes.shape[0]),
-                      "evals_pw": evals_d.tolist()}
-            tracelog.event(
-                "search.telemetry", segment=seg, **deltas,
-                pool=size,
-                pool_hw=tele_summary["pool_highwater"],
-                best=int(f_best.min()),
-                improvements=tele_summary["improvements"], **ev)
-            prev_tele = merged
-            prev_evals = np.atleast_1d(f_evals).copy()
-        report = SegmentReport(
-            segment=seg, iters=iters, tree=tree, sol=sol,
-            best=int(f_best.min()), pool_size=size,
-            elapsed=time.perf_counter() - t0, per_worker=per_worker,
-            evals=int(f_evals.sum()), telemetry=tele_summary)
-        reg = obs_metrics.default()
-        reg.histogram("tts_segment_seconds",
-                      "segment wall latency (execute+fetch)"
-                      ).observe(seg_span.dur)
-        # per-segment DELTA, so the counter is live throughput, not the
-        # cumulative totals a resumed checkpoint would double-report
-        reg.counter("tts_nodes_explored_total",
-                    "explored-node throughput (segment deltas)"
-                    ).inc(max(tree - prev_tree, 0))
-        prev_tree = tree
+            results_ready_t = time.monotonic()
+            f_ovf = fetched[6]
+            seg_span.set(iters=int(fetched[0].max()),
+                         tree=int(fetched[1].sum()),
+                         sol=int(fetched[2].sum()),
+                         pool=int(fetched[3].sum()),
+                         best=int(fetched[4].min()))
+        # fold AFTER the span closes so the `segment` span record still
+        # precedes its search.telemetry event in the record stream
+        report = folder.fold(fetched, seg)
+        iters, size = report.iters, report.pool_size
+        obs_metrics.default().histogram(
+            "tts_segment_seconds",
+            "segment wall latency (execute+fetch)"
+            ).observe(seg_span.dur)
         if heartbeat is not None:
             heartbeat(report)
         if checkpoint_path and seg % checkpoint_every == 0:
@@ -912,15 +1224,7 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
         if should_stop is not None and should_stop(report):
             final_save(state, seg)
             return state
-        if (iters, tree, sol) == last:
-            stalls += 1
-            if stalls >= stall_limit:
-                raise RuntimeError(
-                    f"search stalled: no progress across {stalls} segments "
-                    f"(iters={iters}, pool={size})")
-        else:
-            stalls = 0
-        last = (iters, tree, sol)
+        folder.check_stall(report)
         if max_segments is not None and seg >= max_segments:
             final_save(state, seg)
             return state
@@ -928,3 +1232,201 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
                 and iters >= start_iters + max_total_iters):
             final_save(state, seg)
             return state
+
+
+def _run_segmented_overlap(run_fn, state: SearchState, *, segment_iters,
+                           checkpoint_path, checkpoint_every, heartbeat,
+                           max_segments, max_total_iters, stall_limit,
+                           raise_on_overflow, checkpoint_meta,
+                           should_stop, retry_attempts, retry_base_s,
+                           segment_timeout_s, grow_fn, stop_pending):
+    """The pipelined segment driver behind `run_segmented(overlap=True)`.
+
+    Pipeline shape (see run_segmented's docstring for the contract):
+    segment N+1 is dispatched — donated carries, so the in-flight state
+    is never copied — BEFORE segment N's counter block is fetched; the
+    heartbeat then consumes segment N's report while the device runs
+    N+1. Exit conditions found in segment N's report drain the
+    in-flight segment (a no-op when the pool is empty or overflowed —
+    the compiled loop's condition re-checks both) instead of discarding
+    it, so node accounting is bit-identical to the sync driver.
+    Checkpoint segments synchronize only for the live-row host fetch;
+    compression + fsync run on the AsyncCheckpointWriter thread.
+
+    `segment` spans are emitted with EXPLICIT [dispatch, results-ready]
+    timestamps (tracelog.span_at): consecutive spans overlap in wall
+    time exactly when the device ran back-to-back, which is what the
+    search_report gap table and the tts_segment_gap_seconds histogram
+    measure."""
+    t0 = time.perf_counter()
+    seg = 0
+    start_iters = int(_to_np(state.iters).max())
+    folder = _ReportFolder(state, t0, stall_limit, start_iters)
+    reg = obs_metrics.default()
+    gap_hist = reg.histogram("tts_segment_gap_seconds", GAP_HELP,
+                             buckets=GAP_BUCKETS)
+    seg_hist = reg.histogram("tts_segment_seconds",
+                             "segment wall latency (execute+fetch)")
+    writer = (AsyncCheckpointWriter(retry_attempts=retry_attempts,
+                                    retry_base_s=retry_base_s)
+              if checkpoint_path else None)
+
+    def target_for(k: int) -> int:
+        t = start_iters + k * segment_iters
+        if max_total_iters is not None:
+            t = min(t, start_iters + max_total_iters)
+        return t
+
+    def meta_now(seg_no):
+        base = checkpoint_meta() if callable(checkpoint_meta) \
+            else dict(checkpoint_meta or {})
+        return {**base, "segment": seg_no}
+
+    def fetch_counters(cur, seg_no):
+        # the ONLY per-segment fetch on the hot path: the small
+        # counter/telemetry block (the full state is fetched solely on
+        # checkpoint segments, via the writer's prepare())
+        return _retry(
+            lambda: _with_watchdog(
+                lambda: _fetch_many(
+                    (cur.iters, cur.tree, cur.sol, cur.size, cur.best,
+                     cur.steals, cur.overflow, cur.evals)
+                    + ((cur.telemetry,) if folder.tele_w else ())),
+                segment_timeout_s, f"segment {seg_no} result fetch"),
+            "per-segment host fetch", retry_attempts, retry_base_s)
+
+    try:
+        faults.fire("segment_start", segment=1)
+        dispatch_t = time.monotonic()
+        cur = run_fn(state, target_for(1))
+        halting = False
+        results_ready_t = None
+        while True:
+            seg += 1
+            this_dispatch_t = dispatch_t
+            is_ckpt = bool(checkpoint_path) \
+                and seg % checkpoint_every == 0
+
+            def can_speculate():
+                return (not halting
+                        and (max_segments is None or seg < max_segments)
+                        and target_for(seg + 1) > target_for(seg)
+                        and not (stop_pending is not None
+                                 and stop_pending()))
+
+            spec = spec_t = None
+            next_fired = False   # fired segment_start for seg+1 yet?
+            if not is_ckpt and can_speculate():
+                faults.fire("segment_start", segment=seg + 1)
+                next_fired = True
+                spec_t = time.monotonic()
+                spec = run_fn(cur, target_for(seg + 1))
+
+            fetched = fetch_counters(cur, seg)
+            prev_ready_t = results_ready_t
+            results_ready_t = time.monotonic()
+            (f_iters, f_tree, f_sol, sizes, f_best, f_steals, f_ovf,
+             f_evals) = fetched[:8]
+
+            # lossless overflow recovery, pipelined edition: the
+            # speculative segment no-oped on the overflow flag, so
+            # adopt it, grow every pool, and re-run the SAME segment
+            # target from exactly where the loop stopped
+            while bool(f_ovf.any()) and grow_fn is not None:
+                if spec is not None:
+                    cur, spec = spec, None
+                cur = run_fn(grow_fn(cur), target_for(seg))
+                fetched = fetch_counters(cur, seg)
+                results_ready_t = time.monotonic()
+                (f_iters, f_tree, f_sol, sizes, f_best, f_steals,
+                 f_ovf, f_evals) = fetched[:8]
+
+            if is_ckpt:
+                # synchronization point: the live rows must be read
+                # before the pools are donated to the next dispatch —
+                # prepare() on this thread, then dispatch, then hand
+                # the compress+fsync to the writer (enqueue may block
+                # on back-pressure, but the device is already running)
+                task = _retry(
+                    lambda: _with_watchdog(
+                        lambda: writer.prepare(
+                            checkpoint_path, cur, meta_now(seg),
+                            segment=seg),
+                        segment_timeout_s,
+                        f"segment {seg} checkpoint fetch"),
+                    "checkpoint state fetch", retry_attempts,
+                    retry_base_s)
+                if can_speculate():
+                    faults.fire("segment_start", segment=seg + 1)
+                    next_fired = True
+                    spec_t = time.monotonic()
+                    spec = run_fn(cur, target_for(seg + 1))
+                writer.enqueue(task)
+
+            tracelog.span_at("segment", this_dispatch_t,
+                             results_ready_t, segment=seg,
+                             iters=int(f_iters.max()),
+                             tree=int(f_tree.sum()),
+                             sol=int(f_sol.sum()),
+                             pool=int(sizes.sum()),
+                             best=int(f_best.min()), overlapped=True)
+            if prev_ready_t is not None:
+                gap_hist.observe(max(0.0, this_dispatch_t - prev_ready_t))
+            seg_hist.observe(max(results_ready_t - this_dispatch_t, 0.0))
+            report = folder.fold(fetched, seg)
+            iters, size = report.iters, report.pool_size
+            if heartbeat is not None:
+                heartbeat(report)
+            faults.fire("post_segment", segment=seg)
+
+            overflow_exit = bool(f_ovf.any())
+            exit_now = halting or overflow_exit or size == 0
+            if not exit_now and should_stop is not None \
+                    and should_stop(report):
+                exit_now = True
+            if not exit_now and max_segments is not None \
+                    and seg >= max_segments:
+                exit_now = True
+            if not exit_now and max_total_iters is not None \
+                    and iters >= start_iters + max_total_iters:
+                exit_now = True
+            if exit_now:
+                if spec is not None:
+                    # drain the in-flight speculative segment first: a
+                    # no-op on an empty/overflowed pool, at most one
+                    # segment of extra work on a stop request — its
+                    # output is the state the exit below must persist
+                    halting = True
+                    cur, dispatch_t = spec, spec_t
+                    continue
+                if checkpoint_path and seg % checkpoint_every != 0:
+                    writer.submit(checkpoint_path, cur, meta_now(seg),
+                                  segment=seg)
+                if writer is not None:
+                    writer.drain()
+                if overflow_exit and raise_on_overflow:
+                    hint = (f"resume from {checkpoint_path} with a "
+                            "larger capacity" if checkpoint_path else
+                            "rerun with a larger capacity, or catch "
+                            "PoolOverflow and grow() its .state")
+                    raise PoolOverflow(
+                        f"pool overflow at segment {seg} (pool={size}): "
+                        f"search incomplete; {hint}", cur)
+                return cur
+            folder.check_stall(report)
+            if spec is not None:
+                cur, dispatch_t = spec, spec_t
+            else:
+                if not next_fired:
+                    # an abandoned speculation (overflow recovery)
+                    # already fired this segment's injection point;
+                    # firing again would double-spend fault budgets
+                    # and break overlap-vs-sync injection parity
+                    faults.fire("segment_start", segment=seg + 1)
+                dispatch_t = time.monotonic()
+                cur = run_fn(cur, target_for(seg + 1))
+    finally:
+        if writer is not None:
+            # success paths drained above; this is the unwind valve —
+            # never mask an in-flight exception with a writer error
+            writer.close(raise_pending=False)

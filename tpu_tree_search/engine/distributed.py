@@ -43,9 +43,19 @@ from ..parallel import balance as bal
 from ..parallel.mesh import WORKER_AXIS, shard_map, worker_mesh
 from . import sequential as seq
 from . import telemetry as tele
-from .device import SearchState, row_limit as device_row_limit, step
+from .device import I32_MAX, SearchState, row_limit as device_row_limit, \
+    step
 
 AX = WORKER_AXIS
+
+# donation under shard_map is best-effort: a backend that cannot alias
+# a given buffer falls back to a copy and warns per execution — noise,
+# not an error, on the CPU test mesh (the overlapped driver still gets
+# async dispatch; only the zero-copy carry is backend-dependent).
+# run_async scopes the suppression to its own donating dispatch so
+# importing this module never mutes the diagnostic for anyone else's
+# donate_argnums code.
+import warnings as _warnings  # noqa: E402
 
 # per-worker byte budget for one balance round's all_to_all buffers
 # (each way); caps the DEFAULT transfer_cap at production shapes — see
@@ -295,7 +305,8 @@ def _expand(s: SearchState):
 
 def build_dist_loop(mesh, tables, make_local_step,
                     balance_period: int, transfer_cap: int,
-                    min_transfer: int, limit: int):
+                    min_transfer: int, limit: int,
+                    donate_pools: bool = False):
     """Compile a distributed search loop for any problem: state sharded
     over the worker axis, problem tables replicated.
 
@@ -305,13 +316,26 @@ def build_dist_loop(mesh, tables, make_local_step,
     by the driver so both the step scratch block and the balance receive
     block fit above it (see _balance_round).
 
-    The compiled function has signature `run(tables, max_iters, *state)`
-    with `max_iters` a TRACED cumulative per-worker iteration ceiling
-    (like device.run's): segmented drivers pass a new ceiling every
-    segment and hit the compile cache."""
+    The compiled function has signature
+    `run(tables, max_iters, bound_cap, *state)` with `max_iters` a
+    TRACED cumulative per-worker iteration ceiling (like device.run's)
+    and `bound_cap` a TRACED pruning ceiling folded into the incumbent
+    at loop entry (`min(best, bound_cap)` — pass I32_MAX for "no cap").
+    The cap is how cross-request incumbent sharing reaches the compiled
+    loop without a retrace (engine/incumbent.py); with the cap at
+    I32_MAX the fold is the identity, so non-sharing runs are
+    bit-identical to the pre-cap loop. Segmented drivers pass a new
+    ceiling/cap every segment and hit the compile cache.
 
-    def worker_loop(tables, max_iters, *state_leaves):
+    `donate_pools=True` donates the pool leaves (prmu/depth/aux) to the
+    XLA call, so the while-loop carry aliases the input buffers instead
+    of copying them — the overlapped driver's dispatch
+    (_DistDriver.run_async) requires it; the caller must treat the
+    input state's pool arrays as CONSUMED."""
+
+    def worker_loop(tables, max_iters, bound_cap, *state_leaves):
         s = _local_state(*state_leaves)
+        s = s._replace(best=jnp.minimum(s.best, bound_cap))
 
         def cond(s: SearchState):
             has_work = jax.lax.psum(s.size, AX) > 0
@@ -330,11 +354,16 @@ def build_dist_loop(mesh, tables, make_local_step,
 
     spec_state = tuple(P(AX) for _ in SearchState._fields)
     spec_tables = jax.tree.map(lambda _: P(), tables)
-    return jax.jit(shard_map(
+    sharded = shard_map(
         worker_loop, mesh,
-        in_specs=(spec_tables, P()) + spec_state,
+        in_specs=(spec_tables, P(), P()) + spec_state,
         out_specs=spec_state,
-    ))
+    )
+    if donate_pools:
+        # args: 0=tables, 1=max_iters, 2=bound_cap, 3=prmu, 4=depth,
+        # 5=aux (SearchState field order), then the scalar leaves
+        return jax.jit(sharded, donate_argnums=(3, 4, 5))
+    return jax.jit(sharded)
 
 
 # ---------------------------------------------------------------------------
@@ -470,12 +499,13 @@ class _DistDriver:
     def limit(self, capacity: int) -> int:
         return min(self.limit_fn(capacity), capacity - self.n_recv)
 
-    def _loop(self, capacity: int):
-        if capacity not in self._loops:
+    def _loop(self, capacity: int, donate: bool = False):
+        memo_key = (capacity, donate)
+        if memo_key not in self._loops:
             build = lambda: build_dist_loop(  # noqa: E731
                 self.mesh, self.tables, self.make_local_step,
                 self.balance_period, self.transfer_cap, self.min_transfer,
-                limit=self.limit(capacity))
+                limit=self.limit(capacity), donate_pools=donate)
             if self.loop_cache is not None:
                 # consult the shared cache ONCE per driver+capacity (the
                 # local memo absorbs the per-segment lookups), so its
@@ -485,20 +515,34 @@ class _DistDriver:
                                        self.transfer_cap,
                                        self.min_transfer,
                                        self.limit(capacity))
-                self._loops[capacity] = self.loop_cache.get_or_build(
+                if donate:
+                    # a donating executable has different buffer-alias
+                    # semantics: it must never be handed to a caller
+                    # that expects its inputs to survive
+                    key = key + ("donate",)
+                self._loops[memo_key] = self.loop_cache.get_or_build(
                     key, build)
             else:
-                self._loops[capacity] = build()
-        return self._loops[capacity]
+                self._loops[memo_key] = build()
+        return self._loops[memo_key]
 
     def commit(self, state: SearchState) -> SearchState:
         """Commit host-built state leaves to the mesh."""
         return SearchState(*(_to_mesh(self.mesh, s, x)
                              for s, x in zip(self.spec_state, state)))
 
-    def run(self, state: SearchState, max_iters=None) -> SearchState:
+    @staticmethod
+    def _cap(bound_cap) -> jnp.ndarray:
+        return jnp.asarray(I32_MAX if bound_cap is None else bound_cap,
+                           jnp.int32)
+
+    def run(self, state: SearchState, max_iters=None,
+            bound_cap=None) -> SearchState:
         """Run until exhaustion or the cumulative per-worker iteration
-        ceiling, growing pools and resuming on overflow."""
+        ceiling, growing pools and resuming on overflow. `bound_cap`
+        (optional) is folded into the incumbent at loop entry — the
+        cross-request incumbent-sharing input (None = I32_MAX = the
+        identity fold)."""
         from . import checkpoint
 
         ceiling = (np.iinfo(np.int64).max if max_iters is None
@@ -506,11 +550,30 @@ class _DistDriver:
         while True:
             capacity = state.prmu.shape[-1]
             out = SearchState(*self._loop(capacity)(
-                self.tables, jnp.asarray(ceiling, jnp.int64), *state))
+                self.tables, jnp.asarray(ceiling, jnp.int64),
+                self._cap(bound_cap), *state))
             if not bool(_fetch(out.overflow).any()):
                 return out
             grown = checkpoint.grow(fetch_state(out), capacity * 2)
             state = self.commit(grown)
+
+    def run_async(self, state: SearchState, max_iters,
+                  bound_cap=None) -> SearchState:
+        """Dispatch ONE compiled-loop invocation and return its output
+        futures WITHOUT blocking — the overlapped segment driver's
+        dispatch hook. The pool leaves of `state` are DONATED (the
+        while-loop carry aliases them; zero copies in flight), so the
+        caller must not touch state.prmu/depth/aux afterwards; the
+        scalar counter leaves stay fetchable. Overflow is NOT checked
+        here — the overlapped driver reads the flag from its async
+        counter fetch and recovers via grow_fn."""
+        capacity = state.prmu.shape[-1]
+        with _warnings.catch_warnings():
+            _warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            return SearchState(*self._loop(capacity, donate=True)(
+                self.tables, jnp.asarray(int(max_iters), jnp.int64),
+                self._cap(bound_cap), *state))
 
     def seed(self, frontier: Frontier, capacity: int, jobs: int,
              init_best: int) -> SearchState:
@@ -554,7 +617,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            heartbeat=None, host_fraction: int = 0,
            host_threads: int = 0,
            stop_event=None, should_stop=None,
-           loop_cache=None, checkpoint_meta_extra=None) -> DistResult:
+           loop_cache=None, checkpoint_meta_extra=None,
+           overlap: bool | None = None,
+           incumbent_board=None, incumbent_key=None) -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
@@ -604,8 +669,26 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     `checkpoint_meta_extra` (dict or callable returning one) is merged
     into every checkpoint's meta — the service rides its cumulative
     spent_s clock on it so compute budgets survive preempt/resume
-    across server lifetimes."""
-    from . import checkpoint, hybrid
+    across server lifetimes.
+
+    `overlap` (None = the TTS_OVERLAP env flag) pipelines segmented
+    execution: the next segment is dispatched — donated pool carries —
+    before the previous segment's counters are fetched, and checkpoint
+    serialization moves to a writer thread, so the device never idles
+    on the host between segments (checkpoint.run_segmented's overlap
+    contract; bit-identical node accounting on or off). Forced off
+    beside a `-C` host tier (its per-segment incumbent merge needs the
+    synchronous boundary) and under multi-controller JAX.
+
+    `incumbent_board` / `incumbent_key` (service-provided; see
+    engine/incumbent.py) joins this search to the cross-request
+    best-bound exchange: every segment boundary publishes the current
+    best and folds the board's global best in as the next segment's
+    pruning ceiling — a traced input, never a retrace, monotone-only
+    by construction (and audited). `incumbent_key` defaults to the
+    instance's content hash."""
+    from ..utils import config as _cfg
+    from . import checkpoint, hybrid, incumbent as inc_mod
 
     if mesh is None:
         mesh = worker_mesh(n_devices)
@@ -720,6 +803,27 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]].astype(adt)
         state = driver.seed(fr, capacity, jobs, init_best)
 
+    if overlap is None:
+        overlap = _cfg.env_flag(_cfg.OVERLAP_FLAG)
+    # the host tier's per-segment incumbent merge (post_segment) needs
+    # the synchronous boundary; overlap yields to it. Multi-controller
+    # must also stay sync HERE, not only in run_segmented's own guard:
+    # the choice of run_fn below follows use_overlap, and handing the
+    # sync driver the donating non-growing run_async would turn every
+    # overflow into a hard PoolOverflow instead of a lossless grow.
+    use_overlap = (bool(overlap) and session is None
+                   and jax.process_count() == 1)
+
+    client = None
+    if incumbent_board is not None:
+        client = inc_mod.BoardClient(
+            incumbent_board,
+            incumbent_key or inc_mod.instance_key(p_times))
+        # seed the exchange with this search's starting incumbent (a
+        # resumed checkpoint's best, or the warm-up/init_ub bound) so
+        # same-instance peers tighten before our first segment lands
+        client.publish(int(np.atleast_1d(_fetch(state.best)).min()))
+
     max_iters = (None if max_rounds is None
                  else max_rounds * balance_period)
     stop_fn = None
@@ -732,7 +836,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         # the segmented path below is spanned per segment inside
         # run_segmented; this is the only otherwise-unobserved run shape
         with tracelog.span("engine.run", workers=n_dev):
-            out = driver.run(state, max_iters)
+            out = driver.run(state, max_iters,
+                             bound_cap=client.cap() if client else None)
     else:
         ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol,
                      # the host tier's seed rides every checkpoint so a
@@ -753,8 +858,25 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                          else checkpoint_meta_extra)
                 return {**base_meta, **extra}
 
-        def run_fn(s, target):
-            return driver.run(s, max_iters=target)
+        grow_fn = stop_pending = None
+        if use_overlap:
+            # async dispatch with donated pool carries; overflow
+            # recovery and exit draining live in the overlapped driver
+            def run_fn(s, target):
+                return driver.run_async(
+                    s, target, bound_cap=client.cap() if client else None)
+
+            def grow_fn(s):
+                return driver.commit(checkpoint.grow(
+                    fetch_state(s), s.prmu.shape[-1] * 2))
+
+            if stop_event is not None:
+                stop_pending = stop_event.is_set
+        else:
+            def run_fn(s, target):
+                return driver.run(
+                    s, max_iters=target,
+                    bound_cap=client.cap() if client else None)
 
         def hb(rep):
             # resource-observability heartbeat hook: one device-memory
@@ -768,6 +890,10 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 obs_resource.sample_now()
             except Exception:  # noqa: BLE001
                 pass
+            if client is not None:
+                # the cross-request exchange's publish half: fold this
+                # submesh's freshest best into the board every segment
+                client.publish(rep.best)
             if heartbeat is not None:
                 heartbeat(rep)
 
@@ -777,11 +903,14 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
             checkpoint_every=checkpoint_every,
             max_total_iters=max_iters, checkpoint_meta=ckpt_meta,
             post_segment=(session.post_segment if session else None),
-            should_stop=stop_fn)
+            should_stop=stop_fn, overlap=use_overlap, grow_fn=grow_fn,
+            stop_pending=stop_pending)
 
     h_tree = h_sol = h_expanded = 0
     host_stats = {}
     best = int(_fetch(out.best).min())
+    if client is not None:
+        client.publish(best)   # the final fold: peers prune against it
     if session is not None:
         session.offer(best)      # freshest device bound before the join
         h_tree, h_sol, h_best, h_expanded = session.join()

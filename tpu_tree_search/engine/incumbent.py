@@ -1,0 +1,213 @@
+"""Cross-request incumbent sharing: the process-wide best-bound board.
+
+The reference's distributed engine gets a large part of its win from the
+MPI best-makespan exchange (PAPER.md's inter-node redistribution +
+best-bound broadcast): every rank prunes against the GLOBALLY best
+incumbent, not its own. Our search service multiplexes concurrent
+requests onto disjoint submeshes — until this module, two requests
+solving the same instance each pruned only against their own best, so
+both explored subtrees the other had already bounded away.
+
+`IncumbentBoard` is the in-process analogue of that MPI exchange: a
+thread-safe map from problem-instance identity to the best makespan any
+request has found. At every segment boundary a participating search
+
+- PUBLISHES its current best (a min-fold: the board only tightens), and
+- FOLDS the board's value in as the next segment's pruning ceiling — a
+  traced ``bound_cap`` scalar input to the compiled loop
+  (engine/distributed.build_dist_loop applies ``min(best, bound_cap)``
+  at loop entry), so folding never retraces or recompiles.
+
+Monotonicity is the safety story: a fold can only TIGHTEN pruning
+(``min`` both ways), which preserves correctness — any published value
+is the makespan of a real schedule of the same instance, hence a valid
+upper bound for every sharer. `BoardClient` audits this on every fold
+(obs/audit's ``incumbent_monotone`` invariant: the ceiling handed to a
+request never loosens) and counts exchanges in
+``tts_incumbent_folds_total{direction}`` ("out" = this search improved
+the board, "in" = the board tightened this search).
+
+Keying: :func:`instance_key` hashes the processing-time table (shape +
+bytes), so only requests on the SAME instance share; an optional
+``group`` namespaces further (the service maps
+``SearchRequest.share_group`` here — tenants can opt a tag family into
+or out of a sharing pool).
+
+The board is owned by the service layer (service/server.SearchServer
+builds one when sharing is enabled — the ``TTS_SHARE_INCUMBENT`` flag
+or the ``share_incumbent`` knob) and handed to
+``engine/distributed.search`` per request; the engine itself never
+consults process globals, so standalone runs are byte-for-byte
+unaffected.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from ..obs import metrics as obs_metrics
+from ..obs import tracelog
+
+__all__ = ["IncumbentBoard", "BoardClient", "instance_key"]
+
+# engine/device.I32_MAX, the "no incumbent yet" sentinel — mirrored
+# here (cheap int, no jax import) so publish can refuse it: the
+# sentinel is not the makespan of any real schedule, and boarding it
+# would book a bogus direction=out exchange and pollute /status
+_NO_INCUMBENT = np.iinfo(np.int32).max
+
+
+def instance_key(p_times, group: str | None = None) -> str:
+    """Problem-instance identity: a content hash of the processing-time
+    table (dtype-normalized, shape included), optionally namespaced by
+    `group`. Two requests share incumbents iff their keys match."""
+    p = np.ascontiguousarray(np.asarray(p_times, dtype=np.int64))
+    h = hashlib.sha1()
+    h.update(np.asarray(p.shape, np.int64).tobytes())
+    h.update(p.tobytes())
+    digest = h.hexdigest()[:16]
+    return f"{group}/{digest}" if group else digest
+
+
+class IncumbentBoard:
+    """Thread-safe best-bound map; values only ever decrease (min-fold).
+
+    The write path is :meth:`publish`, the read path :meth:`peek`;
+    both are O(1) dict operations under one lock — segment boundaries
+    are the only callers, so contention is structurally negligible
+    against a segment's device compute.
+
+    Bounded: at most `max_keys` distinct instance keys
+    (TTS_INCUMBENT_MAX_KEYS, same bounded-observability stance as the
+    metrics cardinality valve) — entries persist past request
+    completion on purpose (a later same-instance request warm-starts
+    from the known best), so a long-lived many-tenant server evicts
+    the least-recently-updated key instead of growing without bound.
+    Eviction only forfeits that warm-start tightening; monotonicity
+    makes a missing entry always safe."""
+
+    def __init__(self, max_keys: int | None = None):
+        from ..utils import config as _cfg
+        if max_keys is None:
+            try:
+                max_keys = int(os.environ.get(
+                    "TTS_INCUMBENT_MAX_KEYS",
+                    _cfg.INCUMBENT_MAX_KEYS_DEFAULT))
+            except ValueError:
+                max_keys = _cfg.INCUMBENT_MAX_KEYS_DEFAULT
+        self._lock = threading.Lock()
+        self._max_keys = max(1, int(max_keys))
+        self._best: dict[str, int] = {}
+
+    def publish(self, key: str, value: int, source: str = "") -> bool:
+        """Min-fold `value` into the board; True iff it improved the
+        global best for `key` (the "out" direction of the exchange)."""
+        value = int(value)
+        with self._lock:
+            cur = self._best.get(key)
+            if cur is not None and cur <= value:
+                return False
+            # re-insert to mark recency (dict order = update order),
+            # then evict the stalest keys past the bound
+            self._best.pop(key, None)
+            self._best[key] = value
+            while len(self._best) > self._max_keys:
+                self._best.pop(next(iter(self._best)))
+        obs_metrics.default().counter(
+            "tts_incumbent_folds_total",
+            "cross-request incumbent exchanges by direction "
+            "(out = published an improvement to the board, "
+            "in = folded a tighter global bound into a search)"
+            ).inc(direction="out")
+        tracelog.event("incumbent.publish", key=key, value=value,
+                       prev=cur, source=source or None)
+        return True
+
+    def peek(self, key: str) -> int | None:
+        """Current global best for `key` (None = nothing published)."""
+        with self._lock:
+            return self._best.get(key)
+
+    def snapshot(self) -> dict:
+        """JSON-safe view for status APIs: {key: best}."""
+        with self._lock:
+            return dict(self._best)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._best)
+
+
+class BoardClient:
+    """One search's binding to a board: publish/fold with the monotone
+    audit and the direction-labeled fold counters built in. The engine
+    calls :meth:`cap` once per segment dispatch and :meth:`publish`
+    once per heartbeat — both cheap, both host-side."""
+
+    def __init__(self, board: IncumbentBoard, key: str,
+                 source: str = ""):
+        self.board = board
+        self.key = key
+        self.source = source
+        self._last_cap: int | None = None   # last ceiling handed out
+        self._last_best: int | None = None  # last local best seen
+
+    def publish(self, best) -> bool:
+        best = int(best)
+        if best >= _NO_INCUMBENT:
+            return False    # nothing found yet — sentinel, not a bound
+        self._last_best = (best if self._last_best is None
+                           else min(self._last_best, best))
+        return self.board.publish(self.key, best, source=self.source)
+
+    def cap(self) -> int | None:
+        """The pruning ceiling for the next segment (None = no fold).
+        Folds ONLY when the board is strictly tighter than this
+        search's own best: the board's entry for a lone request is its
+        own published best, and folding that global min into every
+        worker would pre-broadcast the incumbent ahead of the engine's
+        own balance-round exchange — changing per-worker node
+        accounting even with nothing shared. Skipping the self-fold
+        keeps a single participating request bit-identical to an
+        unshared run (pinned by tests/test_overlap.py) while a
+        genuinely tighter peer bound still folds. Audited monotone:
+        the board can only tighten, so a ceiling LOOSER than one
+        previously handed out means the exchange itself is broken —
+        that is an audit failure, and the loose value is clamped so
+        the search still never regresses."""
+        g = self.board.peek(self.key)
+        if g is None or (self._last_best is not None
+                         and g >= self._last_best):
+            return None
+        from ..obs import audit as obs_audit
+        audit_on = obs_audit.enabled()
+        if self._last_cap is not None and g > self._last_cap:
+            # never true by construction (publish is a min-fold); the
+            # auditor exists to catch exactly the "never true" breaking.
+            # The clamp is safety, not observability — it stays even
+            # with TTS_AUDIT=0.
+            if audit_on:
+                obs_audit.check_incumbent_fold(self.key, self._last_cap,
+                                               g)
+            g = self._last_cap
+        elif audit_on and (self._last_cap is None or g < self._last_cap):
+            obs_audit.check_incumbent_fold(self.key, self._last_cap, g)
+        if self._last_best is None or g < self._last_best:
+            # the board is about to tighten this search's pruning —
+            # the "in" direction of the exchange
+            obs_metrics.default().counter(
+                "tts_incumbent_folds_total",
+                "cross-request incumbent exchanges by direction "
+                "(out = published an improvement to the board, "
+                "in = folded a tighter global bound into a search)"
+                ).inc(direction="in")
+            tracelog.event("incumbent.fold", key=self.key, value=g,
+                           local_best=self._last_best,
+                           source=self.source or None)
+            self._last_best = g
+        self._last_cap = g
+        return g

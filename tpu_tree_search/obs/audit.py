@@ -60,7 +60,7 @@ from . import metrics, tracelog
 __all__ = ["AuditError", "Finding", "enabled", "hard", "roundtrip_enabled",
            "record", "findings", "recent_failures", "clear_findings",
            "check_result", "check_state", "state_sums", "check_reshard",
-           "check_checkpoint_roundtrip"]
+           "check_checkpoint_roundtrip", "check_incumbent_fold"]
 
 
 class AuditError(RuntimeError):
@@ -277,9 +277,15 @@ def check_reshard(before: dict, after_state, edge: str = "reshard"
 def check_checkpoint_roundtrip(path, state) -> list[Finding]:
     """Re-read a just-written checkpoint and require bit-identical
     counters. A load failure (torn write, CRC mismatch) is itself a
-    failed finding — the write was supposed to be durable."""
+    failed finding — the write was supposed to be durable.
+
+    `state` may be a SearchState OR a precomputed `state_sums()` dict —
+    the async checkpoint writer (engine/checkpoint.AsyncCheckpointWriter)
+    computes the sums on the dispatch thread while the arrays are still
+    in hand and audits the on-disk bytes from its own thread, so the
+    conservation check spans the async edge, not just the sync one."""
     from ..engine import checkpoint
-    expect = state_sums(state)
+    expect = state if isinstance(state, dict) else state_sums(state)
     try:
         loaded, meta = checkpoint.load(path)
     except Exception as e:  # noqa: BLE001 — the finding carries it
@@ -288,6 +294,19 @@ def check_checkpoint_roundtrip(path, state) -> list[Finding]:
     got = state_sums(loaded)
     return [record("checkpoint_roundtrip", got == expect,
                    path=str(path), expect=expect, got=got)]
+
+
+def check_incumbent_fold(key: str, prev_cap, new_cap) -> Finding:
+    """Monotonicity of the cross-request incumbent exchange
+    (engine/incumbent.BoardClient calls this on every fold the board
+    hands a search): a pruning ceiling must never LOOSEN — the board is
+    a min-fold by construction, so ``new_cap > prev_cap`` means the
+    exchange itself is broken (a stale read, a clobbered entry) and a
+    search could prune less than it already safely did."""
+    ok = prev_cap is None or int(new_cap) <= int(prev_cap)
+    return record("incumbent_monotone", ok, key=str(key),
+                  prev_cap=(None if prev_cap is None else int(prev_cap)),
+                  new_cap=int(new_cap))
 
 
 def check_state(state, edge: str = "segment") -> list[Finding]:

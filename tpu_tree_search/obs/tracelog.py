@@ -50,7 +50,8 @@ import os
 import threading
 import time
 
-__all__ = ["TraceLog", "get", "install", "span", "event", "context"]
+__all__ = ["TraceLog", "get", "install", "span", "event", "context",
+           "span_at", "current_context"]
 
 
 def _json_safe(v):
@@ -191,6 +192,12 @@ class TraceLog:
             out.update(layer)
         return out
 
+    def current_context(self) -> dict:
+        """This thread's merged ambient attributes — the hand-off for
+        work delegated to ANOTHER thread (the async checkpoint writer
+        re-installs it so its records keep the request identity)."""
+        return dict(self._ambient())
+
     # ------------------------------------------------------------ write
 
     def _emit(self, rec: dict) -> None:
@@ -246,6 +253,23 @@ class TraceLog:
                         "pid": os.getpid(),
                         "thread": threading.current_thread().name,
                         **ambient, **sp.attrs})
+
+    def span_at(self, name: str, t_start: float, t_end: float,
+                **attrs) -> None:
+        """Emit a completed span with EXPLICIT monotonic timestamps
+        (``time.monotonic()`` values). The overlapped segment driver
+        needs this: its ``segment`` spans cover [dispatch, results
+        ready] — an interval that straddles other host work and the
+        NEXT segment's dispatch, so no ``with`` block can bracket it.
+        Consecutive spans emitted this way may overlap in wall time;
+        gap analyses (tools/search_report.py) clamp negatives to 0."""
+        self._emit({"kind": "span", "name": name,
+                    "ts": round(t_start - self.t0, 6),
+                    "dur": round(max(t_end - t_start, 0.0), 6),
+                    "pid": os.getpid(),
+                    "thread": threading.current_thread().name,
+                    **self._ambient(),
+                    **{k: _json_safe(v) for k, v in attrs.items()}})
 
     # ------------------------------------------------------------- read
 
@@ -310,3 +334,13 @@ def event(name: str, **attrs) -> dict:
 def context(**attrs):
     """`get().context(...)` — ambient attributes for this thread."""
     return get().context(**attrs)
+
+
+def span_at(name: str, t_start: float, t_end: float, **attrs) -> None:
+    """`get().span_at(...)` — explicit-timestamp span emission."""
+    get().span_at(name, t_start, t_end, **attrs)
+
+
+def current_context() -> dict:
+    """`get().current_context()` — this thread's ambient attributes."""
+    return get().current_context()

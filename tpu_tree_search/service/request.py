@@ -81,6 +81,12 @@ class SearchRequest:
     # campaign driver stamps inst/lb/chunk/ub_mode so the legacy
     # supervisor's config screen accepts serve-mode checkpoints)
     checkpoint_meta: dict | None = None
+    # incumbent-sharing namespace (server-side TTS_SHARE_INCUMBENT /
+    # share_incumbent must be on): by default every request solving the
+    # SAME instance shares best-makespan bounds (engine/incumbent's
+    # content-hash key); a share_group narrows that to requests naming
+    # the same group — the tenant/tag-family isolation knob
+    share_group: str | None = None
 
     def validate(self) -> str | None:
         """Admission-side validation; returns a rejection reason or None."""
@@ -157,6 +163,7 @@ class RequestRecord:
             # flight-recorder cross-reference: filter the JSONL event
             # log / Chrome trace by these to see this request's story
             "tag": self.request.tag or self.id,
+            "share_group": self.request.share_group,
             "stop_reason": self.stop_reason,
             "hold": self.hold,
             # liveness for the health layer's stall rule / dashboard:

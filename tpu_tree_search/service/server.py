@@ -128,7 +128,9 @@ class SearchServer:
                  autostart: bool = True,
                  phase_profile=None,
                  resource_sample_s: float | None = None,
-                 health_interval_s: float | None = None):
+                 health_interval_s: float | None = None,
+                 overlap: bool | None = None,
+                 share_incumbent: bool | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -201,6 +203,22 @@ class SearchServer:
                 self.resources.sample()
             except Exception:  # noqa: BLE001 — observability extra
                 pass
+        # Raw-speed knobs (None = the TTS_OVERLAP / TTS_SHARE_INCUMBENT
+        # env flags). `overlap` pipelines every served request's
+        # segments (async counter fetch + writer-thread checkpoints —
+        # engine/checkpoint's overlapped driver); `share_incumbent`
+        # builds the process-wide best-bound board so concurrent
+        # same-instance requests tighten each other's pruning
+        # (engine/incumbent.py — the reference's MPI best-makespan
+        # exchange, served-form).
+        self.overlap = (cfg.env_flag(cfg.OVERLAP_FLAG)
+                        if overlap is None else bool(overlap))
+        if share_incumbent is None:
+            share_incumbent = cfg.env_flag(cfg.SHARE_INCUMBENT_FLAG)
+        self.incumbents = None
+        if share_incumbent:
+            from ..engine.incumbent import IncumbentBoard
+            self.incumbents = IncumbentBoard()
         self.segment_iters = segment_iters
         self.checkpoint_every = checkpoint_every
         self.poll_s = poll_s
@@ -230,7 +248,9 @@ class SearchServer:
             interval_s=health_interval_s)
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
-                       workdir=str(self.workdir))
+                       workdir=str(self.workdir),
+                       overlap=self.overlap,
+                       share_incumbent=self.incumbents is not None)
         if autostart:
             self.start()
 
@@ -450,6 +470,8 @@ class SearchServer:
                     for s in self.slots],
                 "executor_cache": self.cache.snapshot(),
                 "compile_ledger": self.cache.ledger_snapshot(),
+                "incumbents": (self.incumbents.snapshot()
+                               if self.incumbents is not None else None),
                 "counters": self.counters,
                 "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()
@@ -669,6 +691,11 @@ class SearchServer:
                         "request.execute", dispatch=rec.dispatches,
                         jobs=jobs, machines=machines,
                         lb_kind=req.lb_kind) as ex_span:
+                    inc_key = None
+                    if self.incumbents is not None:
+                        from ..engine import incumbent as inc_mod
+                        inc_key = inc_mod.instance_key(
+                            p, group=req.share_group)
                     res = distributed.search(
                         p, lb_kind=req.lb_kind, init_ub=req.init_ub,
                         mesh=slot.mesh, chunk=req.chunk,
@@ -682,6 +709,9 @@ class SearchServer:
                                           or self.checkpoint_every),
                         heartbeat=hb, stop_event=evt,
                         loop_cache=self.cache,
+                        overlap=self.overlap,
+                        incumbent_board=self.incumbents,
+                        incumbent_key=inc_key,
                         # cumulative execution clock rides every
                         # checkpoint (the legacy campaign worker's
                         # spent_s key), so budgets survive preemption,

@@ -71,6 +71,8 @@ def request_from_payload(payload: dict) -> SearchRequest:
         kwargs["capacity"] = int(payload["capacity"])
     if payload.get("deadline_s") is not None:
         kwargs["deadline_s"] = float(payload["deadline_s"])
+    if payload.get("share_group") is not None:
+        kwargs["share_group"] = str(payload["share_group"])
     return SearchRequest(
         p_times=p, lb_kind=int(payload.get("lb", 1)),
         init_ub=None if ub is None else int(ub),

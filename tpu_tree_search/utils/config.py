@@ -10,6 +10,19 @@ defaults (PFSP_lib.c:175-185); TPU-specific knobs are documented inline.
 from __future__ import annotations
 
 import dataclasses
+import os
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse a boolean TTS_* env knob ('1'/'true'/'on'/'yes' = on;
+    '0'/'false'/'off'/'no'/'' = off). One parser for every static
+    feature flag so the accepted spellings cannot drift per call site."""
+    raw = os.environ.get(name, "").strip().lower()
+    if not raw:
+        return default
+    return raw in _TRUTHY
 
 # Resilience defaults — THE single source for engine/checkpoint.
 # run_segmented's env fallbacks (TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S /
@@ -99,6 +112,34 @@ HEALTH_PRUNING_MIN_NODES_DEFAULT = 100_000  # ...only judged past this
 HEALTH_AUDIT_WINDOW_S_DEFAULT = 300.0     # TTS_HEALTH_AUDIT_WINDOW_S —
                                           # how long an audit failure
                                           # keeps the `audit` rule firing
+
+# Raw-speed flags (both STATIC: read once per search/server, bit-
+# identical node accounting on or off — see README's Performance
+# section and tests/test_overlap.py's parity suite):
+# TTS_OVERLAP=1 pipelines segmented execution — the next segment is
+# dispatched (with donated pool carries) before the previous segment's
+# counters are fetched, and checkpoint serialization+fsync moves to a
+# bounded-queue writer thread — so the device never idles on the host
+# between segments (tts_segment_gap_seconds -> ~0).
+# TTS_SHARE_INCUMBENT=1 makes the search SERVICE share best-makespan
+# incumbents across concurrent same-instance requests through a
+# process-wide board (engine/incumbent.py): each segment boundary
+# publishes the submesh's best and folds the global best in as the next
+# segment's pruning ceiling (monotone-only, audited).
+OVERLAP_FLAG = "TTS_OVERLAP"                  # default off
+SHARE_INCUMBENT_FLAG = "TTS_SHARE_INCUMBENT"  # default off
+ASYNC_CKPT_QUEUE_DEPTH = 2    # writer-thread back-pressure bound: a
+                              # dispatch thread outrunning the disk
+                              # BLOCKS here instead of buffering
+                              # unbounded snapshots (never drops one)
+INCUMBENT_MAX_KEYS_DEFAULT = 4096  # TTS_INCUMBENT_MAX_KEYS — bound on
+                                   # the board's distinct instance
+                                   # keys; least-recently-updated
+                                   # entries evict first (dropping an
+                                   # entry only loses warm-start
+                                   # tightening, never correctness) —
+                                   # same bounded-observability stance
+                                   # as TTS_METRIC_MAX_SERIES
 
 
 @dataclasses.dataclass
