@@ -16,6 +16,12 @@ a table from either
 The same numbers feed the ``tts_compile_seconds`` histogram on
 ``/metrics``; this is the per-entry view (WHICH shapes paid WHAT),
 the histogram is the aggregate.
+
+Since the disk AOT tier (service/aot_cache.py) each row also carries
+``source`` — ``disk`` (deserialized, zero compiles) vs ``compile``
+(fresh trace+compile) — and the deserialize seconds; the snapshot's
+``aot_cache`` stats render as a footer. The CI restart-replay leg
+asserts ``source=disk`` on every replayed key from exactly this view.
 """
 
 import argparse
@@ -38,19 +44,27 @@ def _fmt_num(v, scale: float = 1.0, suffix: str = "") -> str:
     return f"{float(v) / scale:.2f}{suffix}"
 
 
-def render(ledger: list[dict], cache: dict | None = None) -> str:
-    hdr = (f"{'#':>2} {'build_s':>8} {'trace_s':>8} {'compile_s':>9} "
-           f"{'gflops':>9} {'MB_acc':>8} {'method':>10}  key")
+def render(ledger: list[dict], cache: dict | None = None,
+           aot: dict | None = None) -> str:
+    hdr = (f"{'#':>2} {'source':>7} {'build_s':>8} {'trace_s':>8} "
+           f"{'compile_s':>9} {'deser_s':>8} {'gflops':>9} "
+           f"{'MB_acc':>8} {'method':>10}  key")
     lines = ["compile-cost ledger (one row per cached executable)",
              hdr, "-" * len(hdr)]
-    total = 0.0
+    total = deser_total = 0.0
+    n_disk = 0
     for i, e in enumerate(ledger):
         tc = (e.get("trace_s") or 0.0) + (e.get("compile_s") or 0.0)
         total += tc
+        deser_total += e.get("deserialize_s") or 0.0
+        if e.get("source") == "disk":
+            n_disk += 1
         lines.append(
-            f"{i:>2} {_fmt_num(e.get('build_s')):>8} "
+            f"{i:>2} {e.get('source') or '-':>7} "
+            f"{_fmt_num(e.get('build_s')):>8} "
             f"{_fmt_num(e.get('trace_s')):>8} "
             f"{_fmt_num(e.get('compile_s')):>9} "
+            f"{_fmt_num(e.get('deserialize_s')):>8} "
             f"{_fmt_num(e.get('flops'), 1e9):>9} "
             f"{_fmt_num(e.get('bytes_accessed'), 2**20):>8} "
             f"{e.get('method') or 'pending':>10}  "
@@ -58,6 +72,9 @@ def render(ledger: list[dict], cache: dict | None = None) -> str:
     lines.append("")
     summary = (f"{len(ledger)} executable(s), "
                f"{total:.2f} s total trace+compile")
+    if n_disk:
+        summary += (f"; {n_disk} replayed from disk in "
+                    f"{deser_total:.2f} s (zero compiles)")
     if cache:
         hits, misses = cache.get("hits", 0), cache.get("misses", 0)
         served = hits + misses
@@ -65,6 +82,14 @@ def render(ledger: list[dict], cache: dict | None = None) -> str:
                     + (f" — {hits / served:.0%} of lookups reused a "
                        "paid compile" if served else ""))
     lines.append(summary)
+    if aot:
+        lines.append(
+            f"aot disk cache [{aot.get('dir')}]: "
+            f"{aot.get('entries')} entr(y/ies), {aot.get('hits')} "
+            f"hit(s) / {aot.get('misses')} miss(es), "
+            f"{aot.get('mismatches')} fingerprint mismatch(es), "
+            f"{aot.get('quarantined')} quarantined, "
+            f"{aot.get('writes')} write(s)")
     return "\n".join(lines)
 
 
@@ -86,7 +111,8 @@ def main(argv=None) -> int:
               "status_snapshot() from a server that has served at "
               "least one request?", file=sys.stderr)
         return 1
-    print(render(ledger, snap.get("executor_cache")))
+    print(render(ledger, snap.get("executor_cache"),
+                 snap.get("aot_cache")))
     return 0
 
 

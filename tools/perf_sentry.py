@@ -52,10 +52,11 @@ THRESHOLDS = {
 }
 
 # metric-name substrings whose values regress UPWARD (latencies, idle
-# gaps): the reference best is the MINIMUM prior value and a value
-# above it by more than the threshold FAILs. Everything else is a rate
-# (higher is better). First matching substring wins.
-LOWER_IS_BETTER = ("segment_gap", "_seconds", "latency")
+# gaps, cold-start executor-ready time): the reference best is the
+# MINIMUM prior value and a value above it by more than the threshold
+# FAILs. Everything else is a rate (higher is better). First matching
+# substring wins.
+LOWER_IS_BETTER = ("segment_gap", "cold_start", "_seconds", "latency")
 
 PASS, FAIL, NEW, SKIP = "PASS", "FAIL", "NEW", "SKIP"
 
@@ -71,6 +72,20 @@ def direction_for(metric: str) -> int:
     """+1 = higher is better (rates, the default); -1 = lower is
     better (the segment-gap / latency family)."""
     return -1 if any(s in metric for s in LOWER_IS_BETTER) else 1
+
+
+def row_mode(row: dict):
+    """The comparison-mode a metric row was measured under, as a
+    (channel, value) pair — TTS_OVERLAP for the segment-gap family,
+    cache_mode (cold|warm) for the cold-start family — or None.
+    Rows of different modes are never judged against each other: a
+    cold trace+compile latency 'regressing' from a warm disk-replay
+    reference is not a finding, it is the cache doing its job."""
+    if row.get("overlap") is not None:
+        return ("overlap", row["overlap"])
+    if row.get("cache_mode") is not None:
+        return ("cache", row["cache_mode"])
+    return None
 
 
 def _round_of(path: str) -> int:
@@ -124,24 +139,37 @@ def load_source(path: str) -> dict:
 
 
 def load_history(directory: str, before_round: int,
-                 baseline_path: str | None) -> dict:
-    """Best prior value per metric: earlier BENCH_r*.json rounds in
-    `directory` plus BASELINE.json's published numbers."""
+                 baseline_path: str | None,
+                 exclude: set | None = None) -> dict:
+    """Best prior value per (metric, mode): earlier BENCH_r*.json
+    rounds in `directory` plus BASELINE.json's published numbers.
+    Keying by mode keeps each measurement family's OWN reference —
+    a cold-cache executor-ready row regresses against the best prior
+    COLD value, never against the warm disk-replay minimum (which
+    would otherwise permanently own a metric-keyed slot and turn
+    every later cold row into a SKIP). `exclude` holds the abspaths of
+    the files under judgment: explicit-file mode has no round cutoff,
+    and a row that can find ITSELF in its mode slot would always PASS
+    at +0.0% instead of being judged against real priors."""
     best: dict = {}
+    exclude = exclude or set()
 
     def offer(metric, value, src, platform=None, mode=None):
         if value is None:
             return
-        better = (value > best[metric][0] if direction_for(metric) > 0
-                  else value < best[metric][0]) \
-            if metric in best else True
+        key = (metric, mode)
+        better = (value > best[key][0] if direction_for(metric) > 0
+                  else value < best[key][0]) \
+            if key in best else True
         if better:
-            best[metric] = (float(value), src, platform, mode)
+            best[key] = (float(value), src, platform, mode)
 
     for path in sorted(glob.glob(os.path.join(directory,
                                               "BENCH_*.json"))):
         rnd = _round_of(path)
         if before_round >= 0 and rnd >= before_round:
+            continue
+        if os.path.abspath(path) in exclude:
             continue
         src = load_source(path)
         if src["rc"] != 0:
@@ -150,7 +178,7 @@ def load_history(directory: str, before_round: int,
             if row.get("degraded"):
                 continue            # fallback-platform rate: not a bar
             offer(row.get("metric"), row.get("value"), src["source"],
-                  row.get("platform"), row.get("overlap"))
+                  row.get("platform"), row_mode(row))
     if baseline_path and os.path.exists(baseline_path):
         try:
             with open(baseline_path) as f:
@@ -196,27 +224,43 @@ def judge(sources: list[dict], history: dict,
             v = {"source": name, "metric": metric, "value": value,
                  "platform": row.get("platform"),
                  "degraded": bool(row.get("degraded"))}
-            ref = history.get(metric)
+            # rows carry their measurement mode precisely so an
+            # overlap-off gap is never judged against an overlap-on
+            # ~0.0 reference, and a cold-cache executor-ready latency
+            # never against a warm disk-replay one: the same-mode
+            # reference is the bar; when only an OTHER mode has
+            # history, the row is SKIPped (not FAILed, not NEW — the
+            # cross-mode value is stated for context)
+            mode = row_mode(row)
+            ref = history.get((metric, mode))
+            if ref is None and mode is not None:
+                ref = next((history[k] for k in sorted(
+                    history, key=repr) if k[0] == metric), None)
             refplat = ref[2] if ref is not None else None
             refmode = (ref[3] if ref is not None and len(ref) > 3
                        else None)
             plat_mismatch = (ref is not None and refplat
                              and row.get("platform")
                              and refplat != row["platform"])
-            # rows carry their TTS_OVERLAP mode precisely so an
-            # overlap-off gap is never judged against an overlap-on
-            # ~0.0 reference (or vice versa) — different mode, no bar
-            mode_mismatch = (ref is not None and refmode is not None
-                             and row.get("overlap") is not None
-                             and refmode != row["overlap"])
+            # a MODELESS reference (a BASELINE.json number) counts as
+            # a mismatch for a mode-carrying row too: the baseline's
+            # measurement mode is unknown, and rate-judging a cold
+            # compile against a possibly-warm published number is the
+            # exact false-FAIL this machinery exists to prevent
+            mode_mismatch = (ref is not None and mode is not None
+                             and refmode != mode)
             if ref is not None and (v["degraded"] or plat_mismatch
                                     or mode_mismatch):
                 # a fallback-platform (or different-platform, or
-                # different-overlap-mode) value compared against the
-                # reference best would always "regress" — a CPU rate
-                # is not a TPU finding, a sync gap not a pipelined one
-                why = (f"overlap mode {row.get('overlap')!r} vs "
-                       f"reference mode {refmode!r}" if mode_mismatch
+                # different-mode) value compared against the reference
+                # best would always "regress" — a CPU rate is not a
+                # TPU finding, a sync gap not a pipelined one, a cold
+                # compile not a warm replay
+                ref_mode_desc = (repr(refmode[1]) if refmode
+                                 else "unknown (modeless baseline)")
+                why = (f"{mode[0]} mode {mode[1]!r} vs "
+                       f"reference mode {ref_mode_desc}"
+                       if mode_mismatch
                        else f"platform {row.get('platform')!r}"
                        + (" (degraded)" if v["degraded"] else "")
                        + f" vs reference platform {refplat!r}")
@@ -369,7 +413,8 @@ def main(argv=None) -> int:
 
     sources = [load_source(p) for p in paths]
     baseline = args.baseline or os.path.join(args.dir, "BASELINE.json")
-    history = load_history(args.dir, latest_round, baseline)
+    history = load_history(args.dir, latest_round, baseline,
+                           exclude={os.path.abspath(p) for p in paths})
     verdicts = judge(sources, history, overrides)
 
     md = render_markdown(verdicts)

@@ -205,6 +205,28 @@ def _serve_parser(sub):
                         "global best in as the next pruning ceiling "
                         "(monotone-only, audited; "
                         "tts_incumbent_folds_total)")
+    p.add_argument("--aot-cache", type=str, default=None,
+                   help="disk directory for persisted AOT executables "
+                        "(also via TTS_AOT_CACHE): a restarted server "
+                        "deserializes previously-compiled loops from "
+                        "it (~0.2 s, ledger source=disk) instead of "
+                        "re-tracing+compiling; entries are CRC-"
+                        "stamped, fingerprinted against the runtime, "
+                        "corrupt ones quarantined (service/"
+                        "aot_cache.py). Default: off (in-memory "
+                        "executor cache only)")
+    p.add_argument("--prewarm", type=str, nargs="?", const="",
+                   default=None, metavar="SPEC",
+                   help="boot pre-warm: ready compiled loops BEFORE "
+                        "the first request (also via TTS_PREWARM). "
+                        "SPEC is comma-separated 'taillard' (the "
+                        "standard shape families), 'spool' (shapes in "
+                        "the backlog) and/or explicit JxM entries; "
+                        "bare --prewarm means 'spool,taillard' "
+                        "(backlog shapes first). With "
+                        "--aot-cache, a warm dir makes this a burst "
+                        "of disk loads and a cold dir pays each "
+                        "compile exactly once across lifetimes")
 
 
 def _client_parser(sub):
@@ -234,6 +256,7 @@ def _client_parser(sub):
 def run_serve(args) -> int:
     from .obs import tracelog
     from .service import SearchServer, spool
+    from .utils import config as _cfg
 
     if args.search_telemetry:
         # static compile-in flag, read at each request's state init
@@ -259,9 +282,19 @@ def run_serve(args) -> int:
                           health_interval_s=args.health_interval_s,
                           overlap=(True if args.overlap else None),
                           share_incumbent=(True if args.share_incumbent
-                                           else None)
+                                           else None),
+                          aot_cache_dir=args.aot_cache
                           ) as srv:
+            if srv.aot is not None:
+                print(f"aot cache: {srv.aot.root} "
+                      f"({srv.aot.entries()} entr(y/ies))", flush=True)
             if args.http_port is not None:
+                # BEFORE pre-warm: a cold-dir warm of the full shape
+                # family list is minutes of compiles at production
+                # shapes, and a readiness probe (or the doctor) that
+                # cannot reach /healthz during it would restart the
+                # server into the same warm — the crash-loop the
+                # feature exists to prevent
                 from .obs.httpd import start_http_server
                 httpd = start_http_server(srv, host=args.http_host,
                                           port=args.http_port,
@@ -270,6 +303,37 @@ def run_serve(args) -> int:
                       "/status /trace /alerts /dashboard; "
                       "POST /submit /cancel /profile?duration_s=N",
                       flush=True)
+            env_spec = os.environ.get(_cfg.PREWARM_ENV) or None
+            prewarm_spec = (args.prewarm if args.prewarm is not None
+                            else env_spec)
+            if env_spec is not None and env_spec.strip().lower() in (
+                    "0", "off", "no"):
+                # the env kill-switch wins even over the CLI flag: an
+                # operator must be able to disable a unit file's
+                # --prewarm during an incident without editing it
+                prewarm_spec = None
+            if prewarm_spec is not None \
+                    and prewarm_spec.strip().lower() not in ("0", "off",
+                                                             "no"):
+                try:
+                    summary = srv.prewarm_boot(prewarm_spec,
+                                               spool_dir=args.spool)
+                except Exception as e:  # noqa: BLE001 — pre-warm is
+                    # an optimization: a typo'd TTS_PREWARM spec in a
+                    # fleet unit file must degrade to a cold boot, not
+                    # crash-loop every server (the first request pays
+                    # its compile as before)
+                    print(f"prewarm SKIPPED: {e}", flush=True)
+                else:
+                    print(f"prewarm: {summary['warms']} "
+                          f"executable(s) for "
+                          f"{summary['shapes']} shape(s) in "
+                          f"{summary['seconds']}s "
+                          f"(disk={summary['by']['disk']} "
+                          f"compile={summary['by']['compile']} "
+                          f"warm={summary['by']['warm']} "
+                          f"skipped={summary['by']['skipped']} "
+                          f"errors={summary['errors']})", flush=True)
             print(f"serving: {args.submeshes} submesh(es) x "
                   f"{srv.slots[0].mesh.devices.size} device(s), "
                   f"spool {args.spool}", flush=True)
@@ -430,11 +494,14 @@ def run_doctor(args) -> int:
         for s in merged["servers"]:
             mark = ("ok" if s["ok"] and s["healthz"] == "ok"
                     and not s.get("firing") else "UNHEALTHY")
+            aot = s.get("aot_cache")
+            aot_col = (f" aot={aot['hits']}h/{aot['misses']}m"
+                       f"/{aot['entries']}e" if aot else "")
             print(f"{s['origin']:<24} {mark:<10} "
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
-                  f"requests={s.get('requests')}")
+                  f"requests={s.get('requests')}{aot_col}")
         print("healthy" if healthy else
               "UNHEALTHY:\n  " + "\n  ".join(reasons))
     return 0 if healthy else 1

@@ -587,6 +587,141 @@ class _DistDriver:
                                 limit=self.limit(capacity))
         return self.commit(SearchState(*state))
 
+    # -------------------------------------------------- AOT pre-warm
+
+    def abstract_state(self, jobs: int, aux_rows: int, aux_dtype,
+                       capacity: int) -> SearchState:
+        """The loop's state signature as jax.ShapeDtypeStructs — the
+        serializable lowering inputs the boot pre-warm compiles from
+        (no pool allocation, no search). Shardings are pinned to the
+        worker axis explicitly: abstract lowering would otherwise pick
+        a replicated sharding for zero-sized leaves (the telemetry
+        block when the flag is off) and the executable would then
+        reject the real, axis-sharded calls."""
+        from jax.sharding import NamedSharding
+        n_dev = self.mesh.devices.size
+        shard = NamedSharding(self.mesh, P(AX))
+
+        def sds(shape, dt):
+            return jax.ShapeDtypeStruct(shape, jnp.dtype(dt),
+                                        sharding=shard)
+
+        # honor the x64 config the same way the real zeros do
+        i64 = jnp.zeros((), jnp.int64).dtype
+        counters = {f: sds((n_dev,), i64)
+                    for f in ("tree", "sol", "iters", "evals", "sent",
+                              "recv", "steals")}
+        return SearchState(
+            prmu=sds((n_dev, jobs, capacity), jnp.int16),
+            depth=sds((n_dev, capacity), jnp.int16),
+            aux=sds((n_dev, aux_rows, capacity), aux_dtype),
+            size=sds((n_dev,), jnp.int32),
+            best=sds((n_dev,), jnp.int32),
+            overflow=sds((n_dev,), jnp.bool_),
+            telemetry=sds((n_dev, tele.enabled_width()), i64),
+            **counters)
+
+    def warm(self, capacity: int, jobs: int, aux_rows: int, aux_dtype,
+             donate: bool = False) -> str:
+        """Ready the compiled loop for `capacity` WITHOUT running a
+        search: disk-deserialize when the AOT cache holds the key, else
+        compile from abstract shapes (and persist). Returns the
+        executor entry's warm verdict ("warm"/"disk"/"compile"/
+        "skipped"); "skipped" when no executor cache is injected (a
+        plain jit build has nothing to pre-ready) or the AOT path
+        rejects the program."""
+        entry = self._loop(capacity, donate=donate)
+        warm_fn = getattr(entry, "warm", None)
+        if warm_fn is None:
+            return "skipped"
+        from jax.sharding import NamedSharding
+        repl = NamedSharding(self.mesh, P())
+        abs_tables = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x),
+                                           jnp.asarray(x).dtype,
+                                           sharding=repl),
+            self.tables)
+        max_iters = jax.ShapeDtypeStruct(
+            (), jnp.zeros((), jnp.int64).dtype, sharding=repl)
+        bound_cap = jax.ShapeDtypeStruct((), jnp.dtype(jnp.int32),
+                                         sharding=repl)
+        state = self.abstract_state(jobs, aux_rows, aux_dtype, capacity)
+        return warm_fn(abs_tables, max_iters, bound_cap, *state)
+
+
+def _pfsp_driver(mesh, tables, p_times, lb_kind: int, chunk: int,
+                 balance_period: int, transfer_cap: int,
+                 min_transfer: int, adt, loop_cache) -> "_DistDriver":
+    """ONE construction shared by the serving path (search) and the
+    boot pre-warm (prewarm): the loop key and every trace-specializing
+    knob come from here, so a pre-warmed executable is key-identical to
+    the one a real request at the same knobs builds — a warm that
+    readied a different key would be pure waste."""
+    jobs = p_times.shape[1]
+
+    def make_local_step(t, limit):
+        return functools.partial(step, t, lb_kind, chunk, limit=limit)
+
+    return _DistDriver(
+        mesh, tables, make_local_step, balance_period, transfer_cap,
+        min_transfer,
+        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs),
+        loop_cache=loop_cache,
+        loop_key=("pfsp", jobs, p_times.shape[0], lb_kind, chunk,
+                  str(adt)))
+
+
+def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
+            capacity: int | None = None, balance_period: int = 4,
+            min_seed: int = 32, n_devices: int | None = None,
+            mesh=None, transfer_cap: int | None = None,
+            min_transfer: int | None = None, loop_cache=None,
+            donate: bool = False) -> str:
+    """Ready the distributed loop's executable for this shape WITHOUT
+    running a search — the serve-boot pre-warm entry (cli `serve
+    --prewarm` / SearchServer.prewarm_boot drive it per submesh and
+    shape family). Only the SHAPE and dtypes of `p_times` matter (the
+    tables are runtime arguments of the compiled loop): a synthetic
+    table in the Taillard value range warms the executable every real
+    instance of the class will reuse.
+
+    Returns the warm verdict: "disk" (deserialized from the AOT cache,
+    zero compiles), "compile" (fresh compile, persisted when an AOT
+    cache rides the executor cache), "warm" (already ready —
+    idempotent), or "skipped" (no executor cache / AOT path rejected /
+    multi-controller)."""
+    if jax.process_count() > 1:
+        return "skipped"   # multi-controller warm needs rank
+        # coordination (the pod-scale arc, ROADMAP item 1)
+    if mesh is None:
+        mesh = worker_mesh(n_devices)
+    from .device import aux_dtype as _aux_dtype, default_capacity
+    jobs, machines = p_times.shape[1], p_times.shape[0]
+    if capacity is None:
+        capacity = default_capacity(jobs, machines)
+    tables = batched.make_tables(p_times)
+    adt = _aux_dtype(p_times)
+    if transfer_cap is None:
+        transfer_cap = default_transfer_cap(chunk, jobs, machines,
+                                            mesh.devices.size,
+                                            aux_itemsize=adt.itemsize)
+    min_transfer = min_transfer or 2 * chunk
+    driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
+                          balance_period, transfer_cap, min_transfer,
+                          adt, loop_cache)
+    # mirror seed()'s capacity pre-grow rule with the warm-up target as
+    # the stripe estimate: at production capacities the loop never
+    # fires (limit >> min_seed); at toy capacities it keeps the warmed
+    # key aligned with what a fresh request would actually build
+    while driver.limit(capacity) < max(min_seed, 1):
+        capacity *= 2
+    with tracelog.span("executor.prewarm", jobs=jobs,
+                       machines=machines, lb_kind=lb_kind, chunk=chunk,
+                       capacity=capacity, donate=donate) as sp:
+        how = driver.warm(capacity, jobs, machines, adt, donate=donate)
+        sp.set(how=how)
+    return how
+
 
 def run_with_retry(mesh, tables, make_local_step, frontier: Frontier,
                    capacity: int, jobs: int, init_best: int,
@@ -714,16 +849,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                                             aux_itemsize=adt.itemsize)
     min_transfer = min_transfer or 2 * chunk
 
-    def make_local_step(t, limit):
-        return functools.partial(step, t, lb_kind, chunk, limit=limit)
-
-    driver = _DistDriver(
-        mesh, tables, make_local_step, balance_period, transfer_cap,
-        min_transfer,
-        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs),
-        loop_cache=loop_cache,
-        loop_key=("pfsp", jobs, p_times.shape[0], lb_kind, chunk,
-                  str(adt)))
+    driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
+                          balance_period, transfer_cap, min_transfer,
+                          adt, loop_cache)
 
     session = None
     h_prmu = np.zeros((0, jobs), np.int16)

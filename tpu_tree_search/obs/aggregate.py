@@ -137,7 +137,8 @@ def merge(fleet: dict) -> dict:
         row = {"origin": origin, "ok": s["ok"], "error": s["error"],
                "healthz": (s["healthz"] or {}).get("status"),
                "firing": None, "queue_depth": None, "submeshes": None,
-               "submeshes_busy": None, "requests": 0, "uptime_s": None}
+               "submeshes_busy": None, "requests": 0, "uptime_s": None,
+               "aot_cache": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -146,6 +147,10 @@ def merge(fleet: dict) -> dict:
             row["submeshes"] = len(subs)
             row["submeshes_busy"] = sum(
                 1 for m in subs if m.get("running"))
+            # the zero-compile cold-start tier's stats (None when the
+            # server runs without a disk AOT cache) — the doctor
+            # surfaces them per server
+            row["aot_cache"] = st.get("aot_cache")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             for rid, snap in reqs.items():

@@ -29,9 +29,13 @@ env-overridable ``TTS_HEALTH_*`` knob, defaults in utils/config.py):
                     the search is brute-forcing, the bound is broken;
 ``mem_headroom``    ``tts_device_bytes_in_use / _limit`` above the
                     fraction — the next pool growth will OOM;
-``compile_storm``   executor-cache misses per evaluation interval over
-                    the limit — executable reuse has stopped working
-                    (shape churn, cache-key regression);
+``compile_storm``   fresh unplanned XLA compiles per evaluation
+                    interval over the limit — executable reuse has
+                    stopped working (shape churn, cache-key
+                    regression). Disk-AOT-cache replays and boot
+                    pre-warm compiles do NOT count: a restarted server
+                    mass-loading its cache is the cold-start fix
+                    working, not a storm;
 ``audit``           obs/audit recorded a failed node-conservation
                     invariant inside the window (severity critical);
 ``perf``            a ``perf_sentry --json`` verdict file says FAIL
@@ -280,14 +284,33 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
         cache = getattr(ctx.server, "cache", None)
         if cache is None:
             return False, {}
-        misses = cache.snapshot().get("misses", 0)
-        prev, state["misses_prev"] = state["misses_prev"], misses
+        # count TRUE unplanned fresh compiles (ExecutorCache.
+        # storm_signal: disk-AOT-cache replays and operator-requested
+        # pre-warm compiles excluded) — a restarted server mass-
+        # replaying its executable cache from disk at boot is the
+        # cold-start FIX working, not a storm. Duck-typed caches
+        # without the signal fall back to the pre-PR-8 miss delta.
+        signal_fn = getattr(cache, "storm_signal", None)
+        if signal_fn is not None:
+            compiles = int(signal_fn())
+            kind = "compiles"
+        else:
+            compiles = cache.snapshot().get("misses", 0)
+            kind = "misses"
+        prev, state["misses_prev"] = state["misses_prev"], compiles
         if prev is None:
             return False, {}
-        delta = misses - prev
-        return delta >= th.compile_storm, {
-            "misses_in_interval": delta, "misses_total": misses,
-            "threshold": th.compile_storm}
+        delta = compiles - prev
+        detail = {f"{kind}_in_interval": delta,
+                  f"{kind}_total": compiles,
+                  "threshold": th.compile_storm}
+        aot = getattr(ctx.server, "aot", None)
+        if aot is not None:
+            # the plain counter, NOT snapshot(): snapshot lists the
+            # cache directory, which can be slow on fleet storage —
+            # too heavy for every health-evaluation interval
+            detail["aot_cache_hits"] = aot.hits
+        return delta >= th.compile_storm, detail
 
     def audit_rule(ctx):
         fails = audit.recent_failures(th.audit_window_s)
@@ -325,8 +348,9 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
         Rule("mem_headroom", mem_headroom, severity="critical",
              description="device memory in-use/limit over the fraction"),
         Rule("compile_storm", compile_storm, severity="warn",
-             description="executor-cache misses per interval over the "
-                         "limit (executable reuse broken)"),
+             description="fresh unplanned compiles per interval over "
+                         "the limit (executable reuse broken; disk-"
+                         "cache replays and pre-warm excluded)"),
         Rule("audit", audit_rule, severity="critical",
              description="a node-conservation invariant failed "
                          "(obs/audit.py)"),

@@ -8,10 +8,14 @@ Public surface:
 - `AdmissionError` — bounded-queue rejection (queueing.py)
 - `ExecutorCache` — serve-many-compile-once executable cache
   (executors.py)
+- `AOTCache` — disk-persistent AOT executable tier under it: a
+  restarted server replays compiled loops from disk instead of
+  recompiling (aot_cache.py)
 - `spool` — file-based front-end used by the `serve`/`client` CLI
   (spool.py)
 """
 
+from .aot_cache import AOTCache
 from .executors import ExecutorCache
 from .queueing import AdmissionError, RequestQueue
 from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
@@ -19,7 +23,8 @@ from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
 from .server import SearchServer
 
 __all__ = [
-    "AdmissionError", "ExecutorCache", "RequestQueue", "RequestRecord",
+    "AdmissionError", "AOTCache", "ExecutorCache", "RequestQueue",
+    "RequestRecord",
     "SearchRequest", "SearchServer",
     "QUEUED", "RUNNING", "PREEMPTED", "DONE", "CANCELLED", "DEADLINE",
     "FAILED", "TERMINAL_STATES",

@@ -1,5 +1,5 @@
 """Compiled-executable cache for the search service, with a
-compile-cost ledger.
+compile-cost ledger and an optional disk-persistent AOT tier.
 
 The distributed loop costs seconds to minutes to trace + compile (the
 one-off cost utils/compile_cache amortizes ACROSS processes via XLA's
@@ -30,10 +30,24 @@ on ``/metrics``, and renders as a table via
 program, the entry falls back to timing the first call (compile
 dominated) and says so in its ``method`` field.
 
-Between this cache (same process) and compile_cache.enable() (XLA's
-persistent disk cache, same program shape across processes), a restarted
-server re-serves a warm traffic mix with ~1 s loads instead of ~45 s
-compiles.
+The AOT tier (service/aot_cache.AOTCache, injected by the server when
+``probe()`` passes) makes the compile a once-per-KEY cost across
+server LIFETIMES: a miss first tries a disk deserialize (~0.2 s on the
+CPU test mesh, zero ``lower()``/``compile()`` calls) and only compiles
+— then persists, off the hot path — when no loadable entry exists.
+Each ledger entry records where its executable came from
+(``source=disk|compile``) and the deserialize seconds, so the
+restart-replay contract ("a redeploy does zero fresh compiles for
+previously-served shapes") is assertable from the ledger alone.
+:meth:`_Entry.warm` is the boot pre-warm hook: it readies the
+executable from disk or an abstract-shape compile WITHOUT executing it
+(engine/distributed._DistDriver.warm drives it with ShapeDtypeStruct
+arguments).
+
+Between this cache (same process), the AOT tier (same key across
+processes) and compile_cache.enable() (XLA's persistent HLO cache), a
+restarted server re-serves a warm traffic mix with sub-second loads
+instead of ~45 s compiles.
 """
 
 from __future__ import annotations
@@ -46,19 +60,25 @@ from ..obs import tracelog
 
 class _Entry:
     """One cached loop: the built callable plus its cost record. The
-    trace/compile measurement happens on the FIRST invocation (jit is
-    lazy — at build() time there is nothing to measure yet)."""
+    trace/compile (or disk-load) measurement happens on the FIRST
+    invocation — or at :meth:`warm` time for pre-warmed entries (jit is
+    lazy; at build() time there is nothing to measure yet)."""
 
     __slots__ = ("fn", "compiled", "record", "_lock", "_measured",
-                 "_on_measured")
+                 "_on_measured", "_on_fallback", "_aot", "_key")
 
-    def __init__(self, fn, record: dict, on_measured):
+    def __init__(self, fn, record: dict, on_measured, aot=None,
+                 key: tuple = (), on_fallback=None):
         self.fn = fn
         self.compiled = None
         self.record = record
-        self._lock = threading.Lock()
+        # reentrant: _first_call runs under it and may book a fallback
+        self._lock = threading.RLock()
         self._measured = False
         self._on_measured = on_measured
+        self._on_fallback = on_fallback
+        self._aot = aot
+        self._key = key
 
     def __call__(self, *args):
         if not self._measured:
@@ -68,29 +88,119 @@ class _Entry:
         if self.compiled is not None:
             try:
                 return self.compiled(*args)
-            except (TypeError, ValueError):
+            except (TypeError, ValueError) as e:
                 # AOT executables are stricter about argument layout
                 # than jit; if a later call stops matching, fall back
                 # to the jitted fn permanently (same trace -> the jit
-                # cache compiles once more, correctness unaffected)
-                self.compiled = None
+                # cache compiles once more, correctness unaffected).
+                # The downgrade is BOOKED: a disk/warm-sourced entry
+                # that silently recompiled via jit would leave the
+                # ledger claiming source=disk and the compile
+                # invisible to the storm signal and the restart-replay
+                # assertions.
+                self._book_fallback(e)
         return self.fn(*args)
+
+    def _book_fallback(self, error: Exception) -> None:
+        with self._lock:
+            if self.compiled is None:
+                return                       # a racing call booked it
+            self.compiled = None
+            rec = self.record
+            rec.update(fallback_from=rec.get("source"),
+                       source="compile", method="jit_fallback")
+            tracelog.event("executor.aot_fallback", key=rec["key"],
+                           fallback_from=rec.get("fallback_from"),
+                           error=repr(error))
+            if self._on_fallback is not None:
+                self._on_fallback(rec)
+
+    def _load_from_disk(self) -> bool:
+        """Try the disk AOT tier (caller holds the lock). A hit readies
+        `self.compiled` with ZERO lower()/compile() calls and books the
+        entry as source=disk."""
+        if self._aot is None:
+            return False
+        got = self._aot.load(self._key)
+        if got is None:
+            return False
+        compiled, dt = got
+        self.record.update(trace_s=0.0, compile_s=0.0, method="aot",
+                           source="disk", deserialize_s=round(dt, 6))
+        self._cost_analysis(compiled, self.record)
+        self.compiled = compiled
+        self._measured = True
+        self._record_measured()
+        return True
+
+    def _compile_fresh(self, *args):
+        """The jit AOT path — the ONLY place in the entry that traces
+        or compiles (tests monkeypatch it to pin the zero-compile
+        restart-replay contract). Returns (compiled, trace_s,
+        compile_s); raises when the AOT path cannot handle the
+        program/backend."""
+        t0 = time.perf_counter()
+        lowered = self.fn.lower(*args)
+        t1 = time.perf_counter()
+        compiled = lowered.compile()
+        t2 = time.perf_counter()
+        return compiled, t1 - t0, t2 - t1
+
+    def warm(self, *abstract_args) -> str:
+        """Ready the executable WITHOUT executing it (the boot
+        pre-warm hook; `abstract_args` are jax.ShapeDtypeStructs).
+        Returns how: "warm" (already measured — idempotent), "disk"
+        (deserialized), "compile" (fresh compile, persisted), or
+        "skipped" (the AOT path failed; the first real call takes the
+        normal path and nothing is booked)."""
+        with self._lock:
+            if self._measured:
+                return "warm"
+            if self._load_from_disk():
+                return "disk"
+            rec = self.record
+            try:
+                compiled, trace_s, compile_s = self._compile_fresh(
+                    *abstract_args)
+            except Exception as e:  # noqa: BLE001 — warming is an
+                # optimization; a program the AOT path rejects still
+                # serves (and measures) through the first-call path
+                tracelog.event("executor.warm_skipped", key=rec["key"],
+                               error=repr(e))
+                return "skipped"
+            rec.update(trace_s=round(trace_s, 6),
+                       compile_s=round(compile_s, 6),
+                       method="aot", source="compile", via="prewarm")
+            self._cost_analysis(compiled, rec)
+            self.compiled = compiled
+            self._measured = True
+            self._record_measured()
+            if self._aot is not None:
+                self._aot.store(self._key, compiled,
+                                key_repr=rec["key"])
+            return "compile"
 
     def _first_call(self, *args):
         rec = self.record
+        if self._load_from_disk():
+            try:
+                return self.compiled(*args)
+            except (TypeError, ValueError) as e:
+                # same AOT-strictness net as __call__: a replayed
+                # entry whose layout drifted in a way the fingerprint
+                # missed must degrade to jit (booked), not fail the
+                # request on its very first post-restart invocation
+                self._book_fallback(e)
+                return self.fn(*args)
         # ONLY lower/compile inside the try: a runtime failure of the
         # compiled loop itself must propagate to the service retry tier
         # (re-running it here would be a hidden second execution outside
         # the retry accounting) and must not be booked as compile cost
         try:
-            t0 = time.perf_counter()
-            lowered = self.fn.lower(*args)
-            t1 = time.perf_counter()
-            compiled = lowered.compile()
-            t2 = time.perf_counter()
-            rec.update(trace_s=round(t1 - t0, 6),
-                       compile_s=round(t2 - t1, 6),
-                       method="aot")
+            compiled, trace_s, compile_s = self._compile_fresh(*args)
+            rec.update(trace_s=round(trace_s, 6),
+                       compile_s=round(compile_s, 6),
+                       method="aot", source="compile")
             self._cost_analysis(compiled, rec)
             self.compiled = compiled
         except Exception:  # noqa: BLE001 — a backend/program that the
@@ -99,13 +209,16 @@ class _Entry:
         if compiled is not None:
             self._measured = True
             self._record_measured()
+            if self._aot is not None:
+                self._aot.store(self._key, compiled,
+                                key_repr=rec["key"])
             return compiled(*args)
         # fallback: the first jit call IS trace+compile (+ one execute)
         t0 = time.perf_counter()
         out = self.fn(*args)
         rec.update(trace_s=0.0,
                    compile_s=round(time.perf_counter() - t0, 6),
-                   method="first_call")
+                   method="first_call", source="compile")
         self._measured = True
         self._record_measured()
         return out
@@ -115,7 +228,9 @@ class _Entry:
         tracelog.event("executor.compile", key=rec["key"],
                        trace_s=rec["trace_s"],
                        compile_s=rec["compile_s"],
-                       method=rec["method"], flops=rec.get("flops"))
+                       method=rec["method"], source=rec.get("source"),
+                       deserialize_s=rec.get("deserialize_s"),
+                       flops=rec.get("flops"))
         if self._on_measured is not None:
             self._on_measured(rec)
 
@@ -150,13 +265,24 @@ class ExecutorCache:
     the SAME key must not trace twice — and distinct keys are distinct
     submeshes or shapes, whose builds are cheap closures anyway (jit is
     lazy; XLA compilation happens at first call, outside the lock).
+
+    `aot` (service/aot_cache.AOTCache, optional) is the disk tier:
+    entries first try a deserialize and persist fresh compiles, so a
+    restarted process replays this cache from disk. `compiles` /
+    `planned_compiles` count TRUE fresh XLA compiles (total / initiated
+    by pre-warm) — the health layer's compile_storm rule reads their
+    difference so a boot-time cache replay or an operator-requested
+    pre-warm never reads as a storm (see `storm_signal`).
     """
 
-    def __init__(self, registry=None):
+    def __init__(self, registry=None, aot=None):
         self._lock = threading.Lock()
         self._fns: dict[tuple, _Entry] = {}
         self.hits = 0
         self.misses = 0
+        self.aot = aot
+        self.compiles = 0            # fresh XLA compiles, any origin
+        self.planned_compiles = 0    # ...of which pre-warm initiated
         # optional metrics mirror (obs/metrics.Registry): the server
         # passes its per-server registry so /metrics exposes the same
         # hit/miss counts the JSON snapshot reports, plus the
@@ -179,9 +305,32 @@ class ExecutorCache:
                 "trace+compile wall seconds per new executable")
 
     def _measured(self, record: dict) -> None:
+        # disk-sourced entries paid a deserialize, not a compile: they
+        # must feed neither the compile histogram nor the storm signal
+        if record.get("source") != "compile":
+            return
+        with self._lock:
+            self.compiles += 1
+            if record.get("via") == "prewarm":
+                self.planned_compiles += 1
         if self._compile_h is not None:
             self._compile_h.observe(record["trace_s"]
                                     + record["compile_s"])
+
+    def _fallback(self, record: dict) -> None:
+        """An AOT executable was downgraded to plain jit mid-lifetime
+        (argument mismatch): the jit cache compiles once more, so the
+        storm signal must count it — but there is no fresh AOT
+        measurement to feed the compile histogram."""
+        with self._lock:
+            self.compiles += 1
+
+    def storm_signal(self) -> int:
+        """Fresh UNPLANNED compiles so far — the compile_storm rule's
+        input (obs/health). Disk-cache replays and pre-warm compiles
+        are excluded: a mass boot replay must not fire the alert."""
+        with self._lock:
+            return self.compiles - self.planned_compiles
 
     def get_or_build(self, key: tuple, build):
         with self._lock:
@@ -199,11 +348,15 @@ class ExecutorCache:
             record = {
                 "key": _key_repr(key),
                 "build_s": round(time.perf_counter() - t0, 6),
-                # filled in on the entry's first invocation
+                # filled in on the entry's first invocation (or warm):
+                # source records disk-deserialize vs fresh compile
                 "trace_s": None, "compile_s": None, "method": None,
+                "source": None, "deserialize_s": None,
                 "created_unix": time.time(),
             }
-            entry = self._fns[key] = _Entry(fn, record, self._measured)
+            entry = self._fns[key] = _Entry(fn, record, self._measured,
+                                            aot=self.aot, key=key,
+                                            on_fallback=self._fallback)
             return entry
 
     def __len__(self) -> int:
@@ -213,7 +366,8 @@ class ExecutorCache:
     def snapshot(self) -> dict:
         """JSON-safe stats for the status API. (Schema frozen — the
         ledger rides status_snapshot()'s own `compile_ledger` key, see
-        ledger_snapshot().)"""
+        ledger_snapshot(); the disk tier's stats ride its `aot_cache`
+        key.)"""
         with self._lock:
             return {"entries": len(self._fns), "hits": self.hits,
                     "misses": self.misses}
@@ -221,7 +375,7 @@ class ExecutorCache:
     def ledger_snapshot(self) -> list[dict]:
         """Per-entry compile-cost records, oldest first. `trace_s` /
         `compile_s` are None until the entry's first invocation has
-        measured them."""
+        measured them; `source` says disk|compile once it has."""
         with self._lock:
             entries = list(self._fns.values())
         return sorted((dict(e.record) for e in entries),

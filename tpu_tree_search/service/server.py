@@ -130,7 +130,8 @@ class SearchServer:
                  resource_sample_s: float | None = None,
                  health_interval_s: float | None = None,
                  overlap: bool | None = None,
-                 share_incumbent: bool | None = None):
+                 share_incumbent: bool | None = None,
+                 aot_cache_dir: str | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -185,7 +186,38 @@ class SearchServer:
             ).set_fn(lambda: sum(1 for s in self.slots
                                  if s.record is not None))
         self.queue = RequestQueue(max_queue_depth)
-        self.cache = ExecutorCache(registry=self.metrics)
+        # disk-persistent AOT executable tier (service/aot_cache): a
+        # restarted server replays previously-compiled loops from disk
+        # instead of re-tracing+compiling. None -> the TTS_AOT_CACHE
+        # env path; unset/empty -> in-memory executor cache only. The
+        # capability probe gates construction: a pin that cannot
+        # round-trip a program degrades to the pre-cache behavior, it
+        # never serves maybe-wrong bytes.
+        if aot_cache_dir is None:
+            aot_cache_dir = os.environ.get(cfg.AOT_CACHE_ENV) or None
+        self.aot = None
+        if aot_cache_dir:
+            from . import aot_cache as aot_mod
+            if aot_mod.probe():
+                try:
+                    self.aot = aot_mod.AOTCache(aot_cache_dir,
+                                                registry=self.metrics)
+                except OSError as e:
+                    # an uncreatable/unwritable cache dir (read-only
+                    # mount, fleet misconfig) degrades to in-memory-
+                    # only like every other documented failure mode —
+                    # it must not take the server down
+                    tracelog.event(
+                        "aot_cache.disabled", dir=str(aot_cache_dir),
+                        reason=f"cache dir unusable: {e!r}; executor "
+                               "cache stays in-memory-only")
+            else:
+                tracelog.event(
+                    "aot_cache.disabled", dir=str(aot_cache_dir),
+                    reason="probe failed: this jax/backend pin cannot "
+                           "round-trip a serialized executable; "
+                           "executor cache stays in-memory-only")
+        self.cache = ExecutorCache(registry=self.metrics, aot=self.aot)
         # resource observability: per-device bytes-in-use/peak + host
         # RSS gauges on THIS server's registry (so /metrics carries
         # them) plus memory counter lanes in the trace log; the daemon
@@ -310,6 +342,12 @@ class SearchServer:
         self.resources.close()
         # same valve for the health daemon and its tts_alerts series
         self.health.close()
+        # flush the AOT-cache writer so every compile paid this
+        # lifetime is on disk for the next one (store() after this
+        # point is a silent no-op — late executor threads on
+        # wait=False close paths lose only the persistence)
+        if self.aot is not None:
+            self.aot.close()
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -382,6 +420,160 @@ class SearchServer:
     def status(self, request_id: str) -> dict:
         """JSON-safe lifecycle/progress snapshot of one request."""
         return self._rec(request_id).snapshot()
+
+    # --------------------------------------------------------- pre-warm
+
+    def prewarm_boot(self, spec: str | None = None,
+                     spool_dir: str | None = None,
+                     concurrency: int | None = None) -> dict:
+        """Boot pre-warm: ready compiled loops for the expected traffic
+        BEFORE the first request, so warm capacity exists from second
+        zero (with a warm AOT cache dir this is a burst of disk
+        deserializes; on a cold dir it pays the compiles once and
+        persists them for every later boot).
+
+        `spec` is a comma-separated list of tokens: ``taillard`` (the
+        standard Taillard shape families, config.
+        PREWARM_TAILLARD_FAMILIES), ``spool`` (every shape found in the
+        spool backlog — requests already waiting get their executables
+        first), and/or explicit ``JxM`` (jobs x machines) entries.
+        None/empty resolves to ``"spool,taillard"`` — the backlog's
+        shapes are warmed FIRST (that traffic is already committed;
+        an aborted mid-warm boot must not have spent its time on
+        speculative families while waiting requests got nothing).
+        Each shape is
+        warmed per SUBMESH (distinct device sets are distinct executor
+        keys) in the server's overlap mode (donated-pool variant when
+        the pipelined driver will run). Bounded concurrency
+        (TTS_PREWARM_CONCURRENCY) and idempotent — an already-warm key
+        reports "warm" and costs a dict lookup.
+
+        Returns a JSON-safe summary {shapes, warms, by: {disk, compile,
+        warm, skipped}, seconds, errors}."""
+        import concurrent.futures as cf
+
+        from ..engine import distributed
+        from ..problems.pfsp import PFSPInstance
+        from .request import SearchRequest
+
+        spec = (spec or "").strip() or "spool,taillard"
+        chunk_default = SearchRequest.__dataclass_fields__[
+            "chunk"].default
+        shapes: list[dict] = []
+        seen: set[tuple] = set()
+
+        def add(jobs, machines, lb=1, chunk=chunk_default,
+                capacity=None, p_times=None, balance_period=4,
+                min_seed=32):
+            k = (jobs, machines, lb, chunk, capacity, balance_period)
+            if k in seen:
+                return
+            seen.add(k)
+            shapes.append({"jobs": jobs, "machines": machines,
+                           "lb": lb, "chunk": chunk,
+                           "capacity": capacity, "p_times": p_times,
+                           "balance_period": balance_period,
+                           "min_seed": min_seed})
+
+        for token in (t.strip().lower() for t in spec.split(",")):
+            if not token:
+                continue
+            if token == "taillard":
+                for jobs, machines in cfg.PREWARM_TAILLARD_FAMILIES:
+                    add(jobs, machines)
+            elif token == "spool":
+                for req in self._spool_backlog(spool_dir):
+                    p = np.asarray(req.p_times)
+                    add(p.shape[1], p.shape[0], lb=req.lb_kind,
+                        chunk=req.chunk, capacity=req.capacity,
+                        p_times=p, balance_period=req.balance_period,
+                        min_seed=req.min_seed)
+            elif "x" in token:
+                jobs, _, machines = token.partition("x")
+                add(int(jobs), int(machines))
+            else:
+                raise ValueError(
+                    f"unknown prewarm token {token!r} (want 'taillard',"
+                    " 'spool' or 'JxM')")
+
+        if concurrency is None:
+            try:
+                concurrency = int(os.environ.get(
+                    "TTS_PREWARM_CONCURRENCY", "")
+                    or cfg.PREWARM_CONCURRENCY_DEFAULT)
+            except ValueError:
+                concurrency = cfg.PREWARM_CONCURRENCY_DEFAULT
+        concurrency = max(1, concurrency)
+
+        def warm_one(shape, mesh):
+            p = shape["p_times"]
+            if p is None:
+                # only the SHAPE and value range matter (the tables are
+                # runtime args): a synthetic Taillard-range instance
+                # warms the executable every real instance of the
+                # class reuses
+                p = PFSPInstance.synthetic(shape["jobs"],
+                                           shape["machines"],
+                                           seed=0).p_times
+            return distributed.prewarm(
+                p, lb_kind=shape["lb"], chunk=shape["chunk"],
+                capacity=shape["capacity"],
+                balance_period=shape["balance_period"],
+                min_seed=shape["min_seed"], mesh=mesh,
+                loop_cache=self.cache,
+                # the pipelined driver dispatches the donated-pool
+                # variant; warm the one this server will actually run
+                donate=self.overlap)
+
+        t0 = time.monotonic()
+        by = {"disk": 0, "compile": 0, "warm": 0, "skipped": 0}
+        errors = 0
+        with cf.ThreadPoolExecutor(
+                max_workers=concurrency,
+                thread_name_prefix="tts-prewarm") as pool:
+            futs = [pool.submit(warm_one, shape, slot.mesh)
+                    for shape in shapes for slot in self.slots]
+            for fut in cf.as_completed(futs):
+                try:
+                    by[fut.result()] += 1
+                except Exception as e:  # noqa: BLE001 — warming is an
+                    # optimization: one failed shape must not abort the
+                    # boot (the first real request pays its compile)
+                    errors += 1
+                    tracelog.event("aot_cache.prewarm_failed",
+                                   error=repr(e))
+        if self.aot is not None:
+            self.aot.drain()    # warm capacity AND a warm disk for the
+            # next lifetime — the prewarm promise is both
+        summary = {"shapes": len(shapes), "warms": len(shapes)
+                   * len(self.slots), "by": by, "errors": errors,
+                   "seconds": round(time.monotonic() - t0, 3)}
+        tracelog.event("server.prewarm", shapes=summary["shapes"],
+                       warms=summary["warms"], errors=errors,
+                       seconds=summary["seconds"],
+                       **{f"n_{k}": v for k, v in by.items()})
+        return summary
+
+    def _spool_backlog(self, spool_dir: str | None) -> list:
+        """Parse the unserved request files waiting in the spool (their
+        shapes are the most certain pre-warm targets: that traffic is
+        already committed). The which-requests-are-waiting rule is
+        spool.unserved_requests — shared with the serve loop so the
+        two can never drift."""
+        import json as _json
+
+        from . import spool as spool_mod
+        if not spool_dir:
+            return []
+        out = []
+        for _sid, req_file in spool_mod.unserved_requests(spool_dir):
+            try:
+                out.append(spool_mod.request_from_payload(
+                    _json.loads(req_file.read_text())))
+            except Exception:  # noqa: BLE001 — a malformed backlog file
+                continue       # is the serve loop's problem (it writes
+                #                the REJECTED result), not warm's
+        return out
 
     def result(self, request_id: str,
                timeout: float | None = None) -> RequestRecord:
@@ -469,6 +661,8 @@ class SearchServer:
                      "running": s.record.id if s.record else None}
                     for s in self.slots],
                 "executor_cache": self.cache.snapshot(),
+                "aot_cache": (self.aot.snapshot()
+                              if self.aot is not None else None),
                 "compile_ledger": self.cache.ledger_snapshot(),
                 "incumbents": (self.incumbents.snapshot()
                                if self.incumbents is not None else None),

@@ -104,6 +104,29 @@ def wait_result(spool: str | pathlib.Path, spool_id: str,
         time.sleep(poll_s)
 
 
+def unserved_requests(spool: str | pathlib.Path, skip=None):
+    """Yield ``(spool_id, request_file_path)`` for every request file
+    with no result file yet — THE definition of the backlog, shared by
+    the serve loop and the server's boot pre-warm so the two can never
+    drift on which requests count as waiting. `skip` is an optional set
+    of already-handled spool ids; ids discovered to be already SERVED
+    are added to it, so a long-polling caller (the serve loop) stats
+    each historical result file once, not once per poll tick."""
+    spool = pathlib.Path(spool)
+    for req_file in sorted(spool.glob(f"*{REQ_SUFFIX}")):
+        sid = req_file.name[:-len(REQ_SUFFIX)]
+        if skip is not None and sid in skip:
+            continue
+        if (spool / f"{sid}{RES_SUFFIX}").exists():
+            # already served (by this process or a previous server
+            # lifetime): a restart must not re-execute history or
+            # clobber a result file a client may be reading
+            if skip is not None:
+                skip.add(sid)
+            continue
+        yield sid, req_file
+
+
 def serve_spool(server, spool: str | pathlib.Path,
                 idle_exit_s: float | None = None,
                 status_every_s: float | None = None,
@@ -130,16 +153,8 @@ def serve_spool(server, spool: str | pathlib.Path,
     last_work = time.monotonic()
     last_status = 0.0
     while True:
-        for req_file in sorted(spool.glob(f"*{REQ_SUFFIX}")):
-            sid = req_file.name[:-len(REQ_SUFFIX)]
-            if sid in seen:
-                continue
+        for sid, req_file in unserved_requests(spool, skip=seen):
             seen.add(sid)
-            if (spool / f"{sid}{RES_SUFFIX}").exists():
-                # already served (by this process or a previous server
-                # lifetime): a restart must not re-execute history or
-                # clobber a result file a client may be reading
-                continue
             try:
                 payload = json.loads(req_file.read_text())
                 rid = server.submit(request_from_payload(payload))

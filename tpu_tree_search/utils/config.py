@@ -128,6 +128,36 @@ HEALTH_AUDIT_WINDOW_S_DEFAULT = 300.0     # TTS_HEALTH_AUDIT_WINDOW_S —
 # segment's pruning ceiling (monotone-only, audited).
 OVERLAP_FLAG = "TTS_OVERLAP"                  # default off
 SHARE_INCUMBENT_FLAG = "TTS_SHARE_INCUMBENT"  # default off
+
+# Zero-compile cold start (service/aot_cache.py + serve --aot-cache /
+# --prewarm). TTS_AOT_CACHE names the disk directory persisted AOT
+# executables live in (empty/unset = in-memory executor cache only);
+# a restarted server deserializes previously-compiled loops from it
+# instead of re-tracing+compiling (ledger `source=disk`). TTS_PREWARM
+# is the boot pre-warm spec ("taillard,spool", explicit "JxM" tokens,
+# or "0"/"off"/"no" as a kill-switch that disables pre-warm even when
+# the --prewarm CLI flag is set) — executables for
+# the standard shape families and the spool backlog are readied before
+# the first request arrives.
+AOT_CACHE_ENV = "TTS_AOT_CACHE"
+PREWARM_ENV = "TTS_PREWARM"
+AOT_WRITER_QUEUE_DEPTH = 2    # AOT-cache writer-thread back-pressure
+                              # bound (the AsyncCheckpointWriter
+                              # discipline: block, never drop/unbound)
+PREWARM_CONCURRENCY_DEFAULT = 2   # TTS_PREWARM_CONCURRENCY — parallel
+                                  # warm workers at boot; compiles are
+                                  # CPU-heavy, so a small bound keeps
+                                  # the boot window predictable
+# the standard Taillard shape families (jobs, machines) — ta001-ta120;
+# `serve --prewarm taillard` readies one executable per family per
+# submesh (the instance VALUES are runtime args, so one warm per shape
+# covers all ten instances of the class)
+PREWARM_TAILLARD_FAMILIES = (
+    (20, 5), (20, 10), (20, 20),
+    (50, 5), (50, 10), (50, 20),
+    (100, 5), (100, 10), (100, 20),
+    (200, 10), (200, 20), (500, 20),
+)
 ASYNC_CKPT_QUEUE_DEPTH = 2    # writer-thread back-pressure bound: a
                               # dispatch thread outrunning the disk
                               # BLOCKS here instead of buffering
