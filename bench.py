@@ -51,6 +51,7 @@ compile_cache.enable()
 from tpu_tree_search.engine import device  # noqa: E402
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
+from tpu_tree_search.tune import defaults as tune_defaults  # noqa: E402
 
 # north-star: 1e9 node-evals/s on a v5p-32 pod (BASELINE.json), so the
 # single-chip bar is its 1/32 share
@@ -195,14 +196,111 @@ def bench_cold_start(p, inst: int):
         shutil.rmtree(root, ignore_errors=True)
 
 
+def bench_ramp_drain(inst: int):
+    """Ramp/drain phase cost of a segmented distributed solve: the
+    wall seconds spent below 50% chunk occupancy at the START (ramp —
+    the warm-up frontier has not yet filled the pools) and at the END
+    (drain — the exhausting pools pop underfilled chunks) of one full
+    solve. These are exactly the phases the fixed tuned chunk
+    over-pays and the chunk ladder (TTS_LADDER=1) shrinks — every row
+    carries its ``ladder`` mode so tools/perf_sentry.py never judges a
+    laddered phase time against a fixed-chunk reference (cross-mode =
+    SKIP, the overlap/cache_mode rule).
+
+    The solve is the bench instance TRUNCATED to its first
+    TTS_BENCH_RAMP_JOBS jobs (full solves of real Taillard instances
+    are hours; ramp/drain need a complete solve to exist) at a
+    deliberately ramp/drain-heavy fixed chunk (TTS_BENCH_RAMP_CHUNK) —
+    the truncation is stamped in the metric name. Run TWICE per
+    process with a shared executor cache; only the second (compile-
+    free) pass is measured, so a cold XLA compile cannot read as ramp
+    time. TTS_BENCH_RAMPDRAIN=0 skips."""
+    import jax
+
+    from tpu_tree_search.engine import distributed
+    from tpu_tree_search.service.executors import ExecutorCache
+    from tpu_tree_search.utils import config as cfg
+
+    ladder_on = cfg.env_flag(cfg.LADDER_FLAG)
+    jobs = int(os.environ.get("TTS_BENCH_RAMP_JOBS", "10"))
+    chunk = int(os.environ.get("TTS_BENCH_RAMP_CHUNK", "1024"))
+    p = taillard.processing_times(inst)[:, :jobs]
+    n_dev = len(jax.devices())
+    cache = ExecutorCache()
+    segs = []
+
+    def hb(rep):
+        segs.append((rep.elapsed, rep.pool_size))
+
+    def solve():
+        segs.clear()
+        t0 = time.perf_counter()
+        # short segments: the phase attribution is per-boundary, and
+        # an 8-iteration segment can swallow the whole ramp at a big
+        # chunk (the first boundary already reports a filled pool)
+        res = distributed.search(p, lb_kind=1, chunk=chunk,
+                                 capacity=1 << 16, min_seed=32,
+                                 segment_iters=4, heartbeat=hb,
+                                 loop_cache=cache)
+        return time.perf_counter() - t0, res
+
+    solve()                       # compile pass (cache absorbs it)
+    wall, res = solve()           # the measured, compile-free pass
+    if not res.complete or len(segs) < 2:
+        print("# ramp/drain bench SKIPPED: solve incomplete or too "
+              f"few segments ({len(segs)})", file=sys.stderr)
+        return
+    half = 0.5 * n_dev * chunk
+    dts = [(e - (segs[i - 1][0] if i else 0.0), pool)
+           for i, (e, pool) in enumerate(segs)]
+    filled = [i for i, (_, pool) in enumerate(dts) if pool >= half]
+    if filled:
+        # ramp = before the FIRST filled boundary, drain = after the
+        # LAST one — disjoint by construction (the naive two-scan
+        # version double-counted every segment into both phases when
+        # the pool never filled)
+        ramp = sum(dt for dt, _ in dts[:filled[0]])
+        drain = sum(dt for dt, _ in dts[filled[-1] + 1:])
+        never_filled = False
+    else:
+        # the pool never covered half the chunk: the WHOLE solve is
+        # one underfilled phase — book it as ramp, zero drain, and
+        # stamp the row so a reader knows the split is degenerate
+        ramp, drain = wall, 0.0
+        never_filled = True
+    base = {
+        "unit": "seconds_below_half_chunk_occupancy",
+        "direction": "lower", "ladder": int(ladder_on),
+        "chunk": chunk, "segments": len(segs),
+        "wall_s": round(wall, 4), "platform": PLATFORM,
+    }
+    if never_filled:
+        base["never_filled"] = True
+    if DEGRADED:
+        base["degraded"] = True
+    name = f"pfsp_ta{inst:03d}j{jobs}"
+    for phase, value in (("ramp", ramp), ("drain", drain)):
+        print(json.dumps({"metric": f"{name}_{phase}_s",
+                          "value": round(value, 4), **base}))
+    print(json.dumps({"metric": f"{name}_rampdrain_wall_s",
+                      "value": round(wall, 4),
+                      **{**base,
+                         "unit": "seconds_end_to_end_solve"}}))
+    print(f"# ramp_drain ladder={int(ladder_on)} chunk={chunk} "
+          f"ramp={ramp:.3f}s drain={drain:.3f}s wall={wall:.3f}s "
+          f"segments={len(segs)}", file=sys.stderr)
+
+
 def main():
     inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
-    # 65536 parents/step measured best on v5e after the bf16 act matmul
-    # made the pair sweeps ~4x cheaper (r5: 73.5M vs 67.8M at 32768 —
-    # the r2-r4 optimum; per-step fixed costs now dominate, so wider
-    # amortizes further; 81920/98304/131072 regress — the pow2 chunk
-    # keeps every ladder rung lane-aligned)
-    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "65536"))
+    p = taillard.processing_times(inst)
+    jobs, machines = p.shape[1], p.shape[0]
+    # measured single-chip default from the per-shape-class table
+    # (tune/defaults.py — the r5 65536 retune lives THERE now, beside
+    # its provenance, instead of being hardcoded here)
+    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "")
+                or tune_defaults.params_for("bench", jobs,
+                                            machines).chunk)
     # long window: a single dispatch through the runtime costs O(100 ms)
     # host-side; the compiled loop itself is ~0.6 ms/iteration, so short
     # windows under-report the sustained rate real runs see
@@ -211,11 +309,31 @@ def main():
     lbs = [int(x) for x in
            os.environ.get("TTS_BENCH_LB", "1,2").split(",")]
 
-    p = taillard.processing_times(inst)
     ub = taillard.optimal_makespan(inst)
     tables = batched.make_tables(p)
 
+    # tuned mode (TTS_BENCH_TUNED=1): resolve the chunk through the
+    # Autotuner instead of the fixed default — cache replay when
+    # TTS_TUNE_CACHE is warm, else a probe sweep. Rows then carry a
+    # "tuned" mode column (stamped ONLY in tuned mode, so untuned rows
+    # keep matching the modeless history) and perf_sentry never judges
+    # a tuned rate against fixed-chunk history (row-mode SKIP).
+    tuner = None
+    if os.environ.get("TTS_BENCH_TUNED", "0").lower() not in (
+            "0", "", "off", "no"):
+        from tpu_tree_search.tune import Autotuner
+        tuner = Autotuner(
+            cache_dir=os.environ.get("TTS_TUNE_CACHE") or None)
+
     for lb_kind in lbs:
+        tuned_row = {}
+        if tuner is not None:
+            params = tuner.resolve(jobs, machines, lb_kind,
+                                   allow_probe=True, context="bench")
+            chunk = params.chunk
+            tuned_row = {"tuned": 1, "tuner_source": params.source}
+            print(f"# lb={lb_kind} tuned chunk={chunk} "
+                  f"(source={params.source})", file=sys.stderr)
         # LB2 steps are ~4x slower: shorten its window so the total
         # bench stays a few minutes — but only to HALF the LB1 window
         # (a quarter made the fixed ~0.5 s dispatch+fetch cost read as a
@@ -250,6 +368,7 @@ def main():
             "vs_baseline": round(rate / PER_CHIP_TARGET, 4),
             "baseline": BASELINE_LABEL,
             "platform": PLATFORM,
+            **tuned_row,
         }
         if DEGRADED:
             row["degraded"] = True
@@ -280,6 +399,8 @@ def main():
         bench_segment_gap(p, ub, inst)
     if os.environ.get("TTS_BENCH_COLDSTART", "1") != "0":
         bench_cold_start(p, inst)
+    if os.environ.get("TTS_BENCH_RAMPDRAIN", "1") != "0":
+        bench_ramp_drain(inst)
 
 
 if __name__ == "__main__":

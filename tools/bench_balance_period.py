@@ -6,38 +6,32 @@ wall-clock preference for sparse periods (16 beat 4 by 1.7x) is an
 artifact of that backend, and the default was never defended.
 
 This tool prices the period where it matters: the per-iteration cost of
-the FULL SPMD program (build_dist_loop on a 1-chip mesh) at each
-period, on IDENTICAL warmed state and windows (the same-state method of
-tools/bench_spmd_tax.py — both prior methodologies documented there
-gave garbage). The spread side of the tradeoff (per-worker tree CV vs
+the FULL SPMD program at each period, on IDENTICAL warmed state and
+windows. The measurement harness itself now lives in
+tpu_tree_search/tune/probe.py (ProbeHarness / measure_balance_periods)
+— the SAME warmed same-state method the offline Autotuner's probes
+run, so this sweep and the tuner can never measure different things;
+this file is the thin CLI that survives for operators who want the
+hand-run sweep. The spread side of the tradeoff (per-worker tree CV vs
 period) is backend-independent and comes from the round-3 CPU-mesh
-table; this measurement supplies the missing cost side.
+table (BENCHMARKS.md); this measurement supplies the cost side.
 
     python tools/bench_balance_period.py [--inst 21] [--lb 2]
 """
 
 import argparse
-import functools
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np  # noqa: E402
 
 from tpu_tree_search.utils import compile_cache  # noqa: E402
 
 compile_cache.enable()
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-
-from tpu_tree_search.engine import device, distributed  # noqa: E402
-from tpu_tree_search.ops import batched  # noqa: E402
-from tpu_tree_search.parallel.mesh import worker_mesh  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
+from tpu_tree_search.tune.probe import measure_balance_periods  # noqa: E402
 
 
 def main():
@@ -55,51 +49,18 @@ def main():
 
     p = taillard.processing_times(args.inst)
     ub = taillard.optimal_makespan(args.inst)
-    tables = batched.make_tables(p)
-    jobs, machines = p.shape[1], p.shape[0]
-    chunk, lb = args.chunk, args.lb
-
-    state = device.init_state(jobs, args.capacity, ub, p_times=p)
-    state = device.run(tables, state, lb, chunk, max_iters=args.warm)
-    state.size.block_until_ready()
-    assert not bool(state.overflow) and int(state.size) > 0
-    target = int(state.iters) + args.iters
-    stacked = tuple(x[None] for x in state)
-
-    adt = device.aux_dtype(p)
-    tc = distributed.default_transfer_cap(chunk, jobs, machines, 1,
-                                          aux_itemsize=adt.itemsize)
-    limit = min(device.row_limit(args.capacity, chunk, jobs),
-                args.capacity - tc)
-
-    def mls(t, lim):
-        return functools.partial(device.step, t, lb, chunk, limit=lim)
-
-    rows = []
-    for period in args.periods:
-        loop = distributed.build_dist_loop(worker_mesh(1), tables, mls,
-                                           period, tc, 2 * chunk, limit)
-
-        def call():
-            out = loop(tables, jnp.int64(target),
-                       jnp.int32(distributed.I32_MAX), *stacked)
-            jax.block_until_ready(out)
-
-        call()  # compile+warm at the final signature
-        best = float("inf")
-        for _ in range(args.repeats):
-            t0 = time.perf_counter()
-            call()
-            best = min(best, time.perf_counter() - t0)
-        ms = best / args.iters * 1e3
-        rows.append({"balance_period": period,
-                     "ms_per_iter": round(ms, 4)})
-        print(json.dumps(rows[-1]), flush=True)
-
-    print(json.dumps({"inst": args.inst, "lb": lb, "chunk": chunk,
+    rows = measure_balance_periods(
+        p, args.lb, args.chunk, args.periods, capacity=args.capacity,
+        warm_iters=args.warm, window_iters=args.iters,
+        repeats=args.repeats, init_ub=ub)
+    for row in rows:
+        print(json.dumps(row), flush=True)
+    print(json.dumps({"inst": args.inst, "lb": args.lb,
+                      "chunk": args.chunk,
                       "window_iters": args.iters,
                       "rows": rows,
-                      "note": "identical warmed state across periods"}))
+                      "note": "identical warmed state across periods "
+                              "(tune/probe.ProbeHarness)"}))
 
 
 if __name__ == "__main__":

@@ -52,11 +52,13 @@ THRESHOLDS = {
 }
 
 # metric-name substrings whose values regress UPWARD (latencies, idle
-# gaps, cold-start executor-ready time): the reference best is the
-# MINIMUM prior value and a value above it by more than the threshold
-# FAILs. Everything else is a rate (higher is better). First matching
+# gaps, cold-start executor-ready time, ramp/drain phase seconds and
+# the ramp/drain solve wall): the reference best is the MINIMUM prior
+# value and a value above it by more than the threshold FAILs.
+# Everything else is a rate (higher is better). First matching
 # substring wins.
-LOWER_IS_BETTER = ("segment_gap", "cold_start", "_seconds", "latency")
+LOWER_IS_BETTER = ("segment_gap", "cold_start", "_seconds", "latency",
+                   "_ramp_s", "_drain_s", "_wall_s")
 
 PASS, FAIL, NEW, SKIP = "PASS", "FAIL", "NEW", "SKIP"
 
@@ -77,14 +79,23 @@ def direction_for(metric: str) -> int:
 def row_mode(row: dict):
     """The comparison-mode a metric row was measured under, as a
     (channel, value) pair — TTS_OVERLAP for the segment-gap family,
-    cache_mode (cold|warm) for the cold-start family — or None.
+    cache_mode (cold|warm) for the cold-start family, TTS_LADDER for
+    the ramp/drain family, and the bench's tuned-chunk mode — or None.
     Rows of different modes are never judged against each other: a
     cold trace+compile latency 'regressing' from a warm disk-replay
-    reference is not a finding, it is the cache doing its job."""
+    reference is not a finding, it is the cache doing its job; a
+    fixed-chunk ramp judged against a laddered ~0 one (or a tuned-
+    chunk rate against fixed-chunk history) is the same non-finding.
+    The bench stamps "tuned" ONLY on tuned rows, so untuned throughput
+    rows stay modeless and keep comparing against their history."""
     if row.get("overlap") is not None:
         return ("overlap", row["overlap"])
     if row.get("cache_mode") is not None:
         return ("cache", row["cache_mode"])
+    if row.get("ladder") is not None:
+        return ("ladder", row["ladder"])
+    if row.get("tuned") is not None:
+        return ("tuned", row["tuned"])
     return None
 
 

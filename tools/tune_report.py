@@ -1,0 +1,102 @@
+"""Render a tuning-cache directory (tune/cache.TuningCache) as a table.
+
+One row per persisted entry: the tuning key, the winning
+chunk/balance_period, the measured node-evals/s, the probe count and
+sweep cost, and the fingerprint the entry is pinned to. Quarantined
+``*.corrupt`` siblings are listed so an operator sees damage at a
+glance. The CI tuner-smoke leg uploads this rendering beside the cache
+listing.
+
+    python tools/tune_report.py <cache-dir>
+    python tools/tune_report.py <cache-dir> --json
+"""
+
+import argparse
+import json
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_HDR_LEN = struct.Struct("<Q")
+MAGIC = b"TTSTUNE1\n"
+
+
+def read_entry(path: str) -> dict:
+    """Parse one cache entry WITHOUT the package (no fingerprint
+    check — this is a report, not a consumer): header + payload, or an
+    {"error": ...} row for damaged files."""
+    try:
+        with open(path, "rb") as f:
+            blob = f.read()
+        if blob[:len(MAGIC)] != MAGIC:
+            raise ValueError("bad magic")
+        off = len(MAGIC)
+        (hdr_len,) = _HDR_LEN.unpack_from(blob, off)
+        off += _HDR_LEN.size
+        header = json.loads(blob[off:off + hdr_len].decode())
+        payload = json.loads(blob[off + hdr_len:].decode())
+        return {"file": os.path.basename(path), "header": header,
+                "payload": payload}
+    except Exception as e:  # noqa: BLE001 — a torn entry is a row,
+        return {"file": os.path.basename(path), "error": repr(e)}
+
+
+def render(entries: list[dict], corrupt: list[str]) -> str:
+    lines = ["# Tuning cache", "",
+             f"{len(entries)} entr(y/ies), {len(corrupt)} quarantined",
+             "",
+             "| key | chunk | balance_period | evals/s | probes | "
+             "sweep_s | platform | devices |",
+             "|---|---|---|---|---|---|---|---|"]
+    for e in entries:
+        if "error" in e:
+            lines.append(f"| {e['file']} | - | - | - | - | - | "
+                         f"UNREADABLE: {e['error']} | - |")
+            continue
+        hdr, pay = e["header"], e["payload"]
+        fp = hdr.get("fingerprint") or {}
+        rate = pay.get("evals_per_s")
+        rate_s = (f"{rate:.4g}" if isinstance(rate, (int, float))
+                  else "-")
+        lines.append(
+            f"| {hdr.get('key') or e['file']} | {pay.get('chunk')} "
+            f"| {pay.get('balance_period')} | {rate_s} "
+            f"| {len(pay.get('probes') or [])} "
+            f"| {pay.get('sweep_seconds', '-')} "
+            f"| {fp.get('platform', '-')} "
+            f"| {fp.get('device_count', '-')}x"
+            f"{'/'.join(fp.get('device_kinds') or ['-'])} |")
+    if corrupt:
+        lines += ["", "Quarantined (never loaded):"]
+        lines += [f"- {c}" for c in corrupt]
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="render a tune/cache.TuningCache directory")
+    ap.add_argument("cache_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="dump the parsed entries as JSON")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.cache_dir):
+        print(f"error: {args.cache_dir} is not a directory",
+              file=sys.stderr)
+        return 2
+    names = sorted(os.listdir(args.cache_dir))
+    entries = [read_entry(os.path.join(args.cache_dir, n))
+               for n in names if n.endswith(".tune")]
+    corrupt = [n for n in names if n.endswith(".corrupt")]
+    if args.json:
+        print(json.dumps({"entries": entries, "quarantined": corrupt},
+                         indent=1))
+    else:
+        print(render(entries, corrupt))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

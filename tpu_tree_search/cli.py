@@ -215,6 +215,28 @@ def _serve_parser(sub):
                         "corrupt ones quarantined (service/"
                         "aot_cache.py). Default: off (in-memory "
                         "executor cache only)")
+    p.add_argument("--tune-cache", type=str, default=None,
+                   help="persistent tuning-cache directory (also via "
+                        "TTS_TUNE_CACHE): requests submitted with open "
+                        "knobs ({'tuned': true} spool payloads / "
+                        "chunk=None) resolve chunk/balance_period from "
+                        "probed optima instead of the defaults table "
+                        "(tune/: fingerprint-checked, CRC-stamped, "
+                        "corrupt entries quarantined). Default: off")
+    p.add_argument("--tune", action="store_true",
+                   help="with --prewarm: PROBE cold shapes at boot "
+                        "(short warmed measurement sweeps, winners "
+                        "persisted to --tune-cache; also via "
+                        "TTS_TUNE=1). A warm cache replays with zero "
+                        "probe executions either way")
+    p.add_argument("--ladder", action="store_true",
+                   help="chunk-ladder execution (also via "
+                        "TTS_LADDER=1): pre-build 2-3 chunk rungs per "
+                        "served shape and switch at segment "
+                        "boundaries from the pool-occupancy signal, "
+                        "so ramp/drain run small-chunk steps "
+                        "(engine/ladder.py; off-mode is bit-identical "
+                        "to the fixed-chunk driver)")
     p.add_argument("--prewarm", type=str, nargs="?", const="",
                    default=None, metavar="SPEC",
                    help="boot pre-warm: ready compiled loops BEFORE "
@@ -267,6 +289,10 @@ def run_serve(args) -> int:
         os.environ["TTS_OVERLAP"] = "1"
     if args.share_incumbent:
         os.environ["TTS_SHARE_INCUMBENT"] = "1"
+    if args.ladder:
+        # static flag: every engine entry (serve dispatches, prewarm's
+        # rung warms, in-process tools) must see the same ladder mode
+        os.environ[_cfg.LADDER_FLAG] = "1"
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -283,11 +309,17 @@ def run_serve(args) -> int:
                           overlap=(True if args.overlap else None),
                           share_incumbent=(True if args.share_incumbent
                                            else None),
-                          aot_cache_dir=args.aot_cache
+                          aot_cache_dir=args.aot_cache,
+                          tune_cache_dir=args.tune_cache,
+                          tune_at_boot=(True if args.tune else None)
                           ) as srv:
             if srv.aot is not None:
                 print(f"aot cache: {srv.aot.root} "
                       f"({srv.aot.entries()} entr(y/ies))", flush=True)
+            if srv.tuner is not None and srv.tuner.cache is not None:
+                print(f"tune cache: {srv.tuner.cache.root} "
+                      f"({srv.tuner.cache.entries()} entr(y/ies), "
+                      f"probe-at-boot={srv.tune_at_boot})", flush=True)
             if args.http_port is not None:
                 # BEFORE pre-warm: a cold-dir warm of the full shape
                 # family list is minutes of compiles at production

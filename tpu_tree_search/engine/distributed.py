@@ -622,14 +622,16 @@ class _DistDriver:
             **counters)
 
     def warm(self, capacity: int, jobs: int, aux_rows: int, aux_dtype,
-             donate: bool = False) -> str:
+             donate: bool = False, via: str = "prewarm") -> str:
         """Ready the compiled loop for `capacity` WITHOUT running a
         search: disk-deserialize when the AOT cache holds the key, else
         compile from abstract shapes (and persist). Returns the
         executor entry's warm verdict ("warm"/"disk"/"compile"/
         "skipped"); "skipped" when no executor cache is injected (a
         plain jit build has nothing to pre-ready) or the AOT path
-        rejects the program."""
+        rejects the program. `via` labels the ledger record ("prewarm"
+        boot warms, "ladder" rung pre-readies) — both are PLANNED
+        compiles the health layer's compile_storm must not count."""
         entry = self._loop(capacity, donate=donate)
         warm_fn = getattr(entry, "warm", None)
         if warm_fn is None:
@@ -646,17 +648,20 @@ class _DistDriver:
         bound_cap = jax.ShapeDtypeStruct((), jnp.dtype(jnp.int32),
                                          sharding=repl)
         state = self.abstract_state(jobs, aux_rows, aux_dtype, capacity)
-        return warm_fn(abs_tables, max_iters, bound_cap, *state)
+        return warm_fn(abs_tables, max_iters, bound_cap, *state, via=via)
 
 
 def _pfsp_driver(mesh, tables, p_times, lb_kind: int, chunk: int,
                  balance_period: int, transfer_cap: int,
-                 min_transfer: int, adt, loop_cache) -> "_DistDriver":
+                 min_transfer: int, adt, loop_cache,
+                 limit_fn=None) -> "_DistDriver":
     """ONE construction shared by the serving path (search) and the
     boot pre-warm (prewarm): the loop key and every trace-specializing
     knob come from here, so a pre-warmed executable is key-identical to
     the one a real request at the same knobs builds — a warm that
-    readied a different key would be pure waste."""
+    readied a different key would be pure waste. `limit_fn` overrides
+    the usable-row bound (the chunk-ladder passes the unified
+    across-rung limit; None = this chunk's own row_limit)."""
     jobs = p_times.shape[1]
 
     def make_local_step(t, limit):
@@ -665,10 +670,56 @@ def _pfsp_driver(mesh, tables, p_times, lb_kind: int, chunk: int,
     return _DistDriver(
         mesh, tables, make_local_step, balance_period, transfer_cap,
         min_transfer,
-        limit_fn=lambda cap: device_row_limit(cap, chunk, jobs),
+        limit_fn=limit_fn or (lambda cap: device_row_limit(cap, chunk,
+                                                           jobs)),
         loop_cache=loop_cache,
         loop_key=("pfsp", jobs, p_times.shape[0], lb_kind, chunk,
                   str(adt)))
+
+
+def _ladder_plan(mesh, tables, p_times, lb_kind: int, chunk: int,
+                 balance_period: int, transfer_cap: int | None,
+                 min_transfer: int | None, adt, loop_cache
+                 ) -> tuple[tuple, dict]:
+    """One _DistDriver per chunk-ladder rung (engine/ladder.rungs_for),
+    all built against a UNIFIED usable-row limit: the minimum over
+    rungs of each rung's own scratch-margin + balance-headroom bound.
+    A state committed by ANY rung is then in-bounds for every other
+    rung, so the controller may switch in either direction at a
+    segment boundary without an out-of-bounds block write ever being
+    possible (the clamp of a dynamic_update_slice would corrupt live
+    rows silently — this invariant is what makes switching safe, see
+    engine/ladder.py).
+
+    `transfer_cap` / `min_transfer` are the CALLER's explicit values
+    (applied to every rung when given — a cap sized for the tuned
+    chunk over-reserves for the small rungs, which is safe); None
+    derives each rung's own (the byte-budget rule / 2*chunk).
+
+    Shared by search() and prewarm() so a boot-warmed rung executable
+    is key-identical to the one a ladder search builds."""
+    from .ladder import min_rung_for, rungs_for
+
+    jobs, machines = p_times.shape[1], p_times.shape[0]
+    n_dev = mesh.devices.size
+    cfgs = []
+    for c in rungs_for(chunk, min_chunk=min_rung_for(lb_kind)):
+        tc = (transfer_cap if transfer_cap is not None
+              else default_transfer_cap(c, jobs, machines, n_dev,
+                                        aux_itemsize=adt.itemsize))
+        mt = min_transfer if min_transfer is not None else 2 * c
+        cfgs.append((c, tc, mt))
+
+    def unified_limit(cap: int) -> int:
+        return min(min(device_row_limit(cap, c, jobs), cap - n_dev * tc)
+                   for c, tc, _ in cfgs)
+
+    drivers = {
+        c: _pfsp_driver(mesh, tables, p_times, lb_kind, c,
+                        balance_period, tc, mt, adt, loop_cache,
+                        limit_fn=unified_limit)
+        for c, tc, mt in cfgs}
+    return tuple(sorted(drivers)), drivers
 
 
 def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
@@ -676,7 +727,7 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
             min_seed: int = 32, n_devices: int | None = None,
             mesh=None, transfer_cap: int | None = None,
             min_transfer: int | None = None, loop_cache=None,
-            donate: bool = False) -> str:
+            donate: bool = False, ladder: bool | None = None) -> str:
     """Ready the distributed loop's executable for this shape WITHOUT
     running a search — the serve-boot pre-warm entry (cli `serve
     --prewarm` / SearchServer.prewarm_boot drive it per submesh and
@@ -689,7 +740,15 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
     zero compiles), "compile" (fresh compile, persisted when an AOT
     cache rides the executor cache), "warm" (already ready —
     idempotent), or "skipped" (no executor cache / AOT path rejected /
-    multi-controller)."""
+    multi-controller).
+
+    `ladder` (None = the TTS_LADDER env flag): when the chunk ladder is
+    on, every rung's executable is warmed — key-identically to what a
+    ladder search builds (_ladder_plan is shared) — so a served
+    request's mid-search rung switch never stalls on a compile. The
+    returned verdict is the tuned (top) rung's."""
+    from ..utils import config as _cfg
+
     if jax.process_count() > 1:
         return "skipped"   # multi-controller warm needs rank
         # coordination (the pod-scale arc, ROADMAP item 1)
@@ -701,14 +760,26 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
         capacity = default_capacity(jobs, machines)
     tables = batched.make_tables(p_times)
     adt = _aux_dtype(p_times)
-    if transfer_cap is None:
-        transfer_cap = default_transfer_cap(chunk, jobs, machines,
-                                            mesh.devices.size,
-                                            aux_itemsize=adt.itemsize)
-    min_transfer = min_transfer or 2 * chunk
-    driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
-                          balance_period, transfer_cap, min_transfer,
-                          adt, loop_cache)
+    if ladder is None:
+        ladder = _cfg.env_flag(_cfg.LADDER_FLAG)
+    drivers = None
+    if ladder:
+        rungs, drivers = _ladder_plan(
+            mesh, tables, p_times, lb_kind, chunk, balance_period,
+            transfer_cap, min_transfer, adt, loop_cache)
+        if len(rungs) < 2:
+            drivers = None             # single rung: plain path
+    if drivers is not None:
+        driver = drivers[max(drivers)]
+    else:
+        if transfer_cap is None:
+            transfer_cap = default_transfer_cap(
+                chunk, jobs, machines, mesh.devices.size,
+                aux_itemsize=adt.itemsize)
+        min_transfer = min_transfer or 2 * chunk
+        driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
+                              balance_period, transfer_cap,
+                              min_transfer, adt, loop_cache)
     # mirror seed()'s capacity pre-grow rule with the warm-up target as
     # the stripe estimate: at production capacities the loop never
     # fires (limit >> min_seed); at toy capacities it keeps the warmed
@@ -717,8 +788,14 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
         capacity *= 2
     with tracelog.span("executor.prewarm", jobs=jobs,
                        machines=machines, lb_kind=lb_kind, chunk=chunk,
-                       capacity=capacity, donate=donate) as sp:
+                       capacity=capacity, donate=donate,
+                       ladder=bool(drivers)) as sp:
         how = driver.warm(capacity, jobs, machines, adt, donate=donate)
+        if drivers is not None:
+            for c, d in drivers.items():
+                if d is not driver:
+                    d.warm(capacity, jobs, machines, adt,
+                           donate=donate, via="ladder")
         sp.set(how=how)
     return how
 
@@ -741,8 +818,8 @@ def run_with_retry(mesh, tables, make_local_step, frontier: Frontier,
 
 
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
-           n_devices: int | None = None, chunk: int = 64,
-           capacity: int = 1 << 17, balance_period: int = 4,
+           n_devices: int | None = None, chunk: int | None = 64,
+           capacity: int = 1 << 17, balance_period: int | None = 4,
            transfer_cap: int | None = None, min_transfer: int | None = None,
            min_seed: int = 32, max_rounds: int | None = None,
            tables: BoundTables | None = None, mesh=None,
@@ -754,7 +831,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            stop_event=None, should_stop=None,
            loop_cache=None, checkpoint_meta_extra=None,
            overlap: bool | None = None,
-           incumbent_board=None, incumbent_key=None) -> DistResult:
+           incumbent_board=None, incumbent_key=None,
+           ladder: bool | None = None, tuner=None) -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
@@ -821,7 +899,32 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     best and folds the board's global best in as the next segment's
     pruning ceiling — a traced input, never a retrace, monotone-only
     by construction (and audited). `incumbent_key` defaults to the
-    instance's content hash."""
+    instance's content hash.
+
+    `chunk=None` / `balance_period=None` defers the knob to ADAPTIVE
+    resolution: a persisted tuned entry when a `tuner`
+    (tune/tuner.Autotuner) is supplied, else the measured-defaults
+    table (tune/defaults.py) — never a probe on this path (the tuner's
+    request-time tier is cache-or-defaults; probing happens at
+    boot/bench time).
+
+    `ladder` (None = the TTS_LADDER env flag; default off) enables
+    CHUNK-LADDER execution on the segmented path: 2-3 pre-built chunk
+    rungs (engine/ladder.rungs_for — each its own ExecutorCache/AOT
+    entry, no retrace at runtime) with the rung switched only at
+    segment boundaries, driven by the per-segment pool-occupancy
+    signal, so ramp-up and drain run small-chunk steps instead of
+    underfilled tuned-chunk ones. Off is bit-identical to the
+    pre-ladder driver (the flag never reaches this path); on, a
+    fixed-incumbent run explores the identical node set and every
+    audit invariant holds across switches (tests pin TTS_AUDIT_HARD).
+    The live rung rides checkpoint meta (``ladder_rung``) so resume
+    replays on the recorded rung. Ladder yields to a `-C` host tier
+    and to multi-controller meshes (like overlap), and engages only
+    when segmented execution runs — it switches at segment
+    boundaries, and a one-shot exhaustion run has none. A rung's loop
+    grown past its pre-warmed capacity (overflow recovery) recompiles
+    lazily on its next use, booked as a normal unplanned compile."""
     from ..utils import config as _cfg
     from . import checkpoint, hybrid, incumbent as inc_mod
 
@@ -829,6 +932,27 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         mesh = worker_mesh(n_devices)
     n_dev = mesh.devices.size
     jobs = p_times.shape[1]
+    if chunk is None or balance_period is None:
+        # adaptive-dispatch resolution for the knobs the caller left
+        # open: tuned cache entry (zero probes — the hot path must
+        # never probe) else the measured-defaults table
+        from ..tune import defaults as tune_defaults
+        if tuner is not None:
+            params = tuner.resolve(jobs, p_times.shape[0], lb_kind,
+                                   n_workers=n_dev, allow_probe=False)
+        else:
+            params = tune_defaults.params_for("serving", jobs,
+                                              p_times.shape[0])
+        if chunk is None:
+            chunk = params.chunk
+            if transfer_cap is None and params.transfer_cap:
+                transfer_cap = params.transfer_cap
+        if balance_period is None:
+            balance_period = params.balance_period
+        tracelog.event("tuner.resolve", chunk=chunk,
+                       balance_period=balance_period,
+                       source=params.source,
+                       evals_per_s=params.evals_per_s)
     if tables is None:
         tables = batched.make_tables(p_times)
     from .device import aux_dtype as _aux_dtype
@@ -843,21 +967,53 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         resumed = checkpoint.load_resilient(checkpoint_path,
                                             p_times=p_times)[:2]
         adt = np.asarray(resumed[0].aux).dtype
+    if ladder is None:
+        ladder = _cfg.env_flag(_cfg.LADDER_FLAG)
+    # the ladder switches at segment boundaries, so it engages only
+    # when segmented execution will run; a host tier keeps the single
+    # driver (its per-segment merge is enough moving parts) and
+    # multi-controller stays on the one-loop path, like overlap
+    use_ladder = (bool(ladder)
+                  and (segment_iters is not None
+                       or checkpoint_path is not None
+                       or stop_event is not None
+                       or should_stop is not None)
+                  and host_fraction == 0
+                  and jax.process_count() == 1)
+    ladder_drivers = None
+    if use_ladder:
+        # rung drivers get the caller's EXPLICIT transfer knobs (None
+        # derives per rung) and one unified limit — see _ladder_plan
+        rungs, ladder_drivers = _ladder_plan(
+            mesh, tables, p_times, lb_kind, chunk, balance_period,
+            transfer_cap, min_transfer, adt, loop_cache)
+        if len(rungs) < 2:
+            ladder_drivers = None      # chunk too small to ladder:
+            #                            plain single-driver path
     if transfer_cap is None:
         transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
                                             mesh.devices.size,
                                             aux_itemsize=adt.itemsize)
     min_transfer = min_transfer or 2 * chunk
 
-    driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
-                          balance_period, transfer_cap, min_transfer,
-                          adt, loop_cache)
+    if ladder_drivers is not None:
+        driver = ladder_drivers[chunk]   # the tuned top rung — also
+        #   the seed/resume/commit driver (all rungs share its limit)
+    else:
+        driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
+                              balance_period, transfer_cap, min_transfer,
+                              adt, loop_cache)
 
     session = None
+    meta_rung = None          # the checkpoint's recorded ladder rung
     h_prmu = np.zeros((0, jobs), np.int16)
     h_depth = np.zeros(0, np.int16)
     if resumed is not None:
         host_state, meta = resumed
+        if "ladder_rung" in meta:
+            # resume replays on the rung the checkpoint recorded: the
+            # pool snapshot alone would misread a mid-ramp save
+            meta_rung = int(np.asarray(meta["ladder_rung"]))
         shape = np.asarray(host_state.prmu).shape
         if len(shape) != 3 or shape[0] != n_dev:
             # elastic resume: re-split the snapshot's pools across THIS
@@ -942,6 +1098,31 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     use_overlap = (bool(overlap) and session is None
                    and jax.process_count() == 1)
 
+    ladder_ctl = None
+    if ladder_drivers is not None:
+        from .ladder import RungController
+        ladder_ctl = RungController(ladder_drivers, n_dev)
+        ladder_ctl.start(int(np.atleast_1d(_fetch(state.size)).sum()),
+                         meta_rung=meta_rung)
+        # Pre-ready EVERY rung — the current one included — from
+        # abstract shapes, so a mid-search switch never stalls on a
+        # fresh trace+compile and all rung compiles are booked as
+        # PLANNED (via="ladder": the compile_storm rule must not read
+        # a ladder boot as executable-reuse breaking). Warming all
+        # rungs is also a CORRECTNESS requirement on the AOT path, not
+        # just a latency one: abstract warms pin every input/output to
+        # the explicit worker-axis sharding (_DistDriver.
+        # abstract_state), so any rung's output state feeds any other
+        # rung's strict AOT executable; an entry compiled from REAL
+        # first-call args instead infers a replicated sharding for the
+        # zero-width telemetry leaf and then REJECTS the cross-rung
+        # handoff ("input sharding does not match") — a booked jit
+        # fallback, correct but a silent perf and accounting loss.
+        cap_now = int(state.prmu.shape[-1])
+        for c, d in ladder_drivers.items():
+            d.warm(cap_now, jobs, p_times.shape[0], adt,
+                   donate=use_overlap, via="ladder")
+
     client = None
     if incumbent_board is not None:
         client = inc_mod.BoardClient(
@@ -986,12 +1167,26 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                          else checkpoint_meta_extra)
                 return {**base_meta, **extra}
 
+        if ladder_ctl is not None:
+            # the rung for the NEXT segment was chosen at the last
+            # boundary (hb's observe below); every rung driver shares
+            # the unified limit, so switching never invalidates the
+            # carried state
+            base_meta0 = ckpt_meta
+
+            def ckpt_meta():
+                base = (base_meta0() if callable(base_meta0)
+                        else dict(base_meta0))
+                return {**base, "ladder_rung": ladder_ctl.current_chunk}
+
         grow_fn = stop_pending = None
         if use_overlap:
             # async dispatch with donated pool carries; overflow
             # recovery and exit draining live in the overlapped driver
             def run_fn(s, target):
-                return driver.run_async(
+                drv = (ladder_ctl.driver() if ladder_ctl is not None
+                       else driver)
+                return drv.run_async(
                     s, target, bound_cap=client.cap() if client else None)
 
             def grow_fn(s):
@@ -1002,11 +1197,19 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 stop_pending = stop_event.is_set
         else:
             def run_fn(s, target):
-                return driver.run(
+                drv = (ladder_ctl.driver() if ladder_ctl is not None
+                       else driver)
+                return drv.run(
                     s, max_iters=target,
                     bound_cap=client.cap() if client else None)
 
         def hb(rep):
+            if ladder_ctl is not None:
+                # rung selection for the NEXT dispatch: this boundary's
+                # pool-occupancy signal (under overlap the next segment
+                # is already in flight, so the switch lands one
+                # boundary later — accounting is exact either way)
+                ladder_ctl.observe(rep.pool_size, segment=rep.segment)
             # resource-observability heartbeat hook: one device-memory
             # / host-RSS sweep per segment (obs/resource publishes the
             # tts_device_bytes_* gauges and a resource.sample trace

@@ -32,10 +32,12 @@ env-overridable ``TTS_HEALTH_*`` knob, defaults in utils/config.py):
 ``compile_storm``   fresh unplanned XLA compiles per evaluation
                     interval over the limit — executable reuse has
                     stopped working (shape churn, cache-key
-                    regression). Disk-AOT-cache replays and boot
-                    pre-warm compiles do NOT count: a restarted server
-                    mass-loading its cache is the cold-start fix
-                    working, not a storm;
+                    regression). Disk-AOT-cache replays, boot pre-warm
+                    compiles and chunk-ladder rung pre-readies
+                    (``via="ladder"``) do NOT count: a restarted
+                    server mass-loading its cache — or a ladder search
+                    readying its 2-3 rungs — is the cold-start/
+                    adaptive-dispatch machinery working, not a storm;
 ``audit``           obs/audit recorded a failed node-conservation
                     invariant inside the window (severity critical);
 ``perf``            a ``perf_sentry --json`` verdict file says FAIL
@@ -350,7 +352,8 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
         Rule("compile_storm", compile_storm, severity="warn",
              description="fresh unplanned compiles per interval over "
                          "the limit (executable reuse broken; disk-"
-                         "cache replays and pre-warm excluded)"),
+                         "cache replays, pre-warm and ladder-rung "
+                         "warms excluded)"),
         Rule("audit", audit_rule, severity="critical",
              description="a node-conservation invariant failed "
                          "(obs/audit.py)"),

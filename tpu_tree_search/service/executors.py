@@ -146,13 +146,15 @@ class _Entry:
         t2 = time.perf_counter()
         return compiled, t1 - t0, t2 - t1
 
-    def warm(self, *abstract_args) -> str:
+    def warm(self, *abstract_args, via: str = "prewarm") -> str:
         """Ready the executable WITHOUT executing it (the boot
         pre-warm hook; `abstract_args` are jax.ShapeDtypeStructs).
         Returns how: "warm" (already measured — idempotent), "disk"
         (deserialized), "compile" (fresh compile, persisted), or
         "skipped" (the AOT path failed; the first real call takes the
-        normal path and nothing is booked)."""
+        normal path and nothing is booked). `via` labels the ledger
+        record ("prewarm" / "ladder" — any warm-initiated compile is
+        PLANNED and excluded from the compile_storm signal)."""
         with self._lock:
             if self._measured:
                 return "warm"
@@ -170,7 +172,7 @@ class _Entry:
                 return "skipped"
             rec.update(trace_s=round(trace_s, 6),
                        compile_s=round(compile_s, 6),
-                       method="aot", source="compile", via="prewarm")
+                       method="aot", source="compile", via=via)
             self._cost_analysis(compiled, rec)
             self.compiled = compiled
             self._measured = True
@@ -311,7 +313,9 @@ class ExecutorCache:
             return
         with self._lock:
             self.compiles += 1
-            if record.get("via") == "prewarm":
+            # any warm-initiated compile is planned: boot pre-warm
+            # ("prewarm") and chunk-ladder rung pre-readies ("ladder")
+            if record.get("via"):
                 self.planned_compiles += 1
         if self._compile_h is not None:
             self._compile_h.observe(record["trace_s"]

@@ -29,6 +29,8 @@ import time
 
 import numpy as np
 
+from ..tune import defaults as tune_defaults
+
 # request states
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
@@ -69,10 +71,15 @@ class SearchRequest:
     priority: int = 0            # higher preempts lower
     deadline_s: float | None = None
     tag: str | None = None
-    # engine knobs (None = server/engine default)
-    chunk: int = 64
+    # engine knobs. Defaults single-sourced in tune/defaults.py (the
+    # measured table config and bench read too). chunk=None /
+    # balance_period=None opts into ADAPTIVE resolution: the server's
+    # tuning cache when one is configured, else the defaults table
+    # (tune/tuner.Autotuner.resolve — never a probe on the request
+    # path). Spool payloads say {"tuned": true} for the same.
+    chunk: int | None = tune_defaults.SERVING_CHUNK_DEFAULT
     capacity: int | None = None
-    balance_period: int = 4
+    balance_period: int | None = tune_defaults.BALANCE_PERIOD_DEFAULT
     min_seed: int = 32
     segment_iters: int | None = None
     checkpoint_every: int | None = None
@@ -98,8 +105,8 @@ class SearchRequest:
             return f"lb_kind must be 0, 1 or 2, got {self.lb_kind}"
         if self.deadline_s is not None and self.deadline_s <= 0:
             return f"deadline_s must be positive, got {self.deadline_s}"
-        if self.chunk < 1:
-            return f"chunk must be >= 1, got {self.chunk}"
+        if self.chunk is not None and self.chunk < 1:
+            return f"chunk must be >= 1 (or None = tuned), got {self.chunk}"
         return None
 
 

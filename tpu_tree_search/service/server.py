@@ -131,7 +131,9 @@ class SearchServer:
                  health_interval_s: float | None = None,
                  overlap: bool | None = None,
                  share_incumbent: bool | None = None,
-                 aot_cache_dir: str | None = None):
+                 aot_cache_dir: str | None = None,
+                 tune_cache_dir: str | None = None,
+                 tune_at_boot: bool | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -218,6 +220,40 @@ class SearchServer:
                            "round-trip a serialized executable; "
                            "executor cache stays in-memory-only")
         self.cache = ExecutorCache(registry=self.metrics, aot=self.aot)
+        # adaptive dispatch (tune/): the Autotuner resolves a request's
+        # OPEN knobs (chunk=None / balance_period=None) from the
+        # persistent tuning cache, falling back to the measured-
+        # defaults table — never probing on the request path. Probing
+        # happens at boot (prewarm_boot with tune_at_boot / TTS_TUNE);
+        # a warm cache dir replays with zero probes.
+        if tune_cache_dir is None:
+            tune_cache_dir = os.environ.get(cfg.TUNE_CACHE_ENV) or None
+        self.tune_at_boot = (cfg.env_flag(cfg.TUNE_ENV)
+                             if tune_at_boot is None
+                             else bool(tune_at_boot))
+        self.tuner = None
+        if tune_cache_dir or self.tune_at_boot:
+            from ..tune import Autotuner
+            try:
+                self.tuner = Autotuner(cache_dir=tune_cache_dir,
+                                       registry=self.metrics)
+            except OSError as e:
+                # an unusable cache dir degrades to an IN-MEMORY tuner
+                # (boot probes still work, they just don't persist) —
+                # the AOT cache's degrade-don't-die stance
+                tracelog.event(
+                    "tuner.cache_disabled", dir=str(tune_cache_dir),
+                    reason=f"tune cache dir unusable: {e!r}; tuned "
+                           "optima live in-process only this lifetime")
+                self.tuner = Autotuner(registry=self.metrics)
+            if not tune_cache_dir:
+                # --tune without --tune-cache must still probe at boot
+                # (in-process memo only) — a documented flag that
+                # silently did nothing would be a dead kill-switch
+                tracelog.event(
+                    "tuner.memory_only",
+                    reason="tune_at_boot without a tune cache dir: "
+                           "probed optima are not persisted")
         # resource observability: per-device bytes-in-use/peak + host
         # RSS gauges on THIS server's registry (so /metrics carries
         # them) plus memory counter lanes in the trace log; the daemon
@@ -480,13 +516,32 @@ class SearchServer:
                 continue
             if token == "taillard":
                 for jobs, machines in cfg.PREWARM_TAILLARD_FAMILIES:
-                    add(jobs, machines)
+                    add(jobs, machines, **self._tuned_kwargs(jobs,
+                                                             machines))
             elif token == "spool":
+                from ..tune import defaults as tune_defaults
                 for req in self._spool_backlog(spool_dir):
                     p = np.asarray(req.p_times)
+                    bchunk, bperiod = req.chunk, req.balance_period
+                    if bchunk is None or bperiod is None:
+                        # a {"tuned": true} backlog request leaves its
+                        # knobs open; warm the values DISPATCH will
+                        # resolve to — the tuner (probing now when
+                        # tune_at_boot, so the dispatch-time cache
+                        # lookup replays this boot's winner) else the
+                        # serving defaults tier
+                        tk = self._tuned_kwargs(p.shape[1], p.shape[0],
+                                                lb=req.lb_kind)
+                        dflt = tune_defaults.params_for(
+                            "serving", p.shape[1], p.shape[0])
+                        if bchunk is None:
+                            bchunk = tk.get("chunk", dflt.chunk)
+                        if bperiod is None:
+                            bperiod = tk.get("balance_period",
+                                             dflt.balance_period)
                     add(p.shape[1], p.shape[0], lb=req.lb_kind,
-                        chunk=req.chunk, capacity=req.capacity,
-                        p_times=p, balance_period=req.balance_period,
+                        chunk=bchunk, capacity=req.capacity,
+                        p_times=p, balance_period=bperiod,
                         min_seed=req.min_seed)
             elif "x" in token:
                 jobs, _, machines = token.partition("x")
@@ -553,6 +608,30 @@ class SearchServer:
                        seconds=summary["seconds"],
                        **{f"n_{k}": v for k, v in by.items()})
         return summary
+
+    def _tuned_kwargs(self, jobs: int, machines: int,
+                      lb: int = 1) -> dict:
+        """Tuned dispatch knobs for a pre-warm family shape: the
+        tuning cache when warm, a PROBE at boot when `tune_at_boot`
+        (persisted — the next boot replays it with zero probes), else
+        nothing (the family keeps the serving default). Never raises —
+        a failed probe must not abort the boot."""
+        if self.tuner is None:
+            return {}
+        try:
+            n_workers = self.slots[0].mesh.devices.size
+            params = self.tuner.resolve(jobs, machines, lb,
+                                        n_workers=n_workers,
+                                        allow_probe=self.tune_at_boot)
+        except Exception as e:  # noqa: BLE001 — tuning is an
+            # optimization; the default-knob warm still happens
+            tracelog.event("tuner.boot_failed", jobs=jobs,
+                           machines=machines, error=repr(e))
+            return {}
+        if params.source == "default":
+            return {}
+        return {"chunk": params.chunk,
+                "balance_period": params.balance_period}
 
     def _spool_backlog(self, spool_dir: str | None) -> list:
         """Parse the unserved request files waiting in the spool (their
@@ -666,6 +745,8 @@ class SearchServer:
                 "compile_ledger": self.cache.ledger_snapshot(),
                 "incumbents": (self.incumbents.snapshot()
                                if self.incumbents is not None else None),
+                "tuner": (self.tuner.snapshot()
+                          if self.tuner is not None else None),
                 "counters": self.counters,
                 "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()
@@ -904,6 +985,10 @@ class SearchServer:
                         heartbeat=hb, stop_event=evt,
                         loop_cache=self.cache,
                         overlap=self.overlap,
+                        # adaptive dispatch: open knobs (chunk=None /
+                        # balance_period=None) resolve via the tuning
+                        # cache or the defaults table inside search()
+                        tuner=self.tuner,
                         incumbent_board=self.incumbents,
                         incumbent_key=inc_key,
                         # cumulative execution clock rides every
@@ -926,11 +1011,16 @@ class SearchServer:
         """Resolve the phase-attribution unit costs for `req` (see the
         `phase_profile` constructor knob): a shared dict is used as-is;
         True measures utils/phase_timing.profile_phases once per
-        (shape, lb, chunk) and caches it for every later request."""
+        (shape, lb, chunk) and caches it for every later request.
+        Open-knob (tuned) requests profile at the chunk dispatch will
+        actually resolve — never at None."""
         if isinstance(self.phase_profile, dict):
             return self.phase_profile
         p = np.asarray(req.p_times)
-        key = (p.shape, req.lb_kind, req.chunk)
+        chunk = req.chunk
+        if chunk is None:
+            chunk = self._resolved_chunk(p, req.lb_kind)
+        key = (p.shape, req.lb_kind, chunk)
         with self._lock:
             prof = self._prof_cache.get(key)
         if prof is not None:
@@ -940,13 +1030,13 @@ class SearchServer:
         from ..utils import phase_timing
         try:
             with tracelog.span("phase_profile", jobs=p.shape[1],
-                               lb_kind=req.lb_kind, chunk=req.chunk):
+                               lb_kind=req.lb_kind, chunk=chunk):
                 tables = batched.make_tables(p)
                 state = device.init_state(
-                    p.shape[1], max(1 << 12, 4 * req.chunk * p.shape[1]),
+                    p.shape[1], max(1 << 12, 4 * chunk * p.shape[1]),
                     req.init_ub, p_times=p)
                 prof = phase_timing.profile_phases(
-                    tables, state, req.lb_kind, req.chunk, warm_iters=4)
+                    tables, state, req.lb_kind, chunk, warm_iters=4)
         except Exception as e:  # noqa: BLE001 — attribution is an
             # observability extra; its failure must never fail a request
             tracelog.event("phase_profile.failed", error=repr(e))
@@ -954,6 +1044,22 @@ class SearchServer:
         with self._lock:
             self._prof_cache[key] = prof
         return prof
+
+    def _resolved_chunk(self, p: np.ndarray, lb_kind: int) -> int:
+        """The chunk an open-knob request resolves to at dispatch —
+        the tuner's cache-or-defaults tier, mirrored here so anything
+        that needs the concrete value BEFORE dispatch (phase
+        profiling) sees the same number the engine will run."""
+        if self.tuner is not None:
+            try:
+                return self.tuner.resolve(
+                    p.shape[1], p.shape[0], lb_kind,
+                    n_workers=self.slots[0].mesh.devices.size).chunk
+            except Exception:  # noqa: BLE001 — fall to the table
+                pass
+        from ..tune import defaults as tune_defaults
+        return tune_defaults.params_for("serving", p.shape[1],
+                                        p.shape[0]).chunk
 
     def _publish_phases(self, rec: RequestRecord, rep, prof: dict) -> None:
         """Heartbeat hook: attribute the request's CUMULATIVE execution

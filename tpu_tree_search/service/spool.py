@@ -13,7 +13,9 @@ Request JSON::
     {"inst": 21,                 # Taillard id — OR "p_times": [[...]]
      "lb": 1, "ub": "opt",       # ub: "opt" | integer | null
      "priority": 0, "deadline_s": null,
-     "chunk": 64, "capacity": null, "tag": null}
+     "chunk": 64, "capacity": null, "tag": null,
+     "tuned": false}             # true: leave chunk/balance_period to
+                                 # the server's tuner (tune/tuner.py)
 
 Result JSON: the request's final `RequestRecord.snapshot()` plus the
 spool id. Writes on both sides are atomic (tmp + rename) so a reader
@@ -73,6 +75,13 @@ def request_from_payload(payload: dict) -> SearchRequest:
         kwargs["deadline_s"] = float(payload["deadline_s"])
     if payload.get("share_group") is not None:
         kwargs["share_group"] = str(payload["share_group"])
+    if payload.get("tuned"):
+        # adaptive dispatch: leave the knobs OPEN (chunk=None /
+        # balance_period=None) so the server resolves them from its
+        # tuning cache / defaults table; explicit chunk/balance_period
+        # keys in the same payload win (they were set above)
+        kwargs.setdefault("chunk", None)
+        kwargs.setdefault("balance_period", None)
     return SearchRequest(
         p_times=p, lb_kind=int(payload.get("lb", 1)),
         init_ub=None if ub is None else int(ub),

@@ -1,0 +1,104 @@
+"""Measured dispatch defaults — the autotuner's fallback tier.
+
+Before this table existed, the engine's dispatch knobs lived in three
+places that drifted independently: `utils/config.PFSPConfig` shipped
+`chunk=256 / balance_period=4` (the round-1 CLI defaults), bench.py
+hardcoded `chunk=65536` (the round-5 single-chip retune after the bf16
+one-hot matmul changed the cost structure), and the serving layer's
+`SearchRequest` defaulted to `chunk=64` (sized for preemption latency
+on shared submeshes). This module is the ONE table all three consume —
+and the tier the Autotuner (tune/tuner.py) falls back to when no
+probed entry exists for a shape.
+
+Provenance of the measured rows (do not "clean up" these numbers
+without a measurement — each was a perf round):
+
+- ``bench`` 20x20 chunk 65536: ROUND5_NOTES.md — 73.5M evals/s at
+  65536 vs 67.8M at 32768 on v5e after the bf16 act matmul made the
+  pair sweeps ~4x cheaper (81920/98304/131072 regress; pow2 keeps the
+  lanes aligned).
+- ``balance_period=4`` everywhere: tools/bench_balance_period.py
+  on-chip — 6.40 ms/iter at period 4 vs 6.64 at 1 and 6.53 at 16 on
+  identical ta021 state (±2% noise), so the period is chosen for
+  SPREAD (per-worker tree CV 0.16 at 4 vs 0.20 at 16, BENCHMARKS.md).
+  The CPU mesh's preference for sparse periods is a host-serialized-
+  collectives artifact; never retune this knob on the virtual mesh.
+- ``serving`` chunk 64: the service's preemption/deadline reaction
+  granularity — stop flags land at segment boundaries, and a
+  65536-wide chunk on a small submesh makes every boundary (and every
+  ramp/drain step) pay for parents that are not there.
+- ``cli`` chunk 256: the reference-parity default
+  (PFSP_lib.c:175-185's -M family), kept for command-line
+  compatibility.
+
+This module must stay import-light (stdlib only): utils/config imports
+it at module load.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# the knob every context shares — measured on-chip, see provenance above
+BALANCE_PERIOD_DEFAULT = 4
+
+# per-context chunk defaults (the fallback row of the table below)
+CLI_CHUNK_DEFAULT = 256
+SERVING_CHUNK_DEFAULT = 64
+BENCH_CHUNK_DEFAULT = 65536
+
+
+@dataclasses.dataclass(frozen=True)
+class Params:
+    """One resolved dispatch configuration. ``transfer_cap`` None means
+    "derive from chunk via distributed.default_transfer_cap" (the byte-
+    budgeted rule); ``source`` records which tier produced it:
+    ``default`` (this table), ``cache`` (a persisted tuned entry) or
+    ``probe`` (freshly measured)."""
+
+    chunk: int
+    balance_period: int = BALANCE_PERIOD_DEFAULT
+    transfer_cap: int | None = None
+    source: str = "default"
+    evals_per_s: float | None = None   # the winning probe's rate, when
+    #                                    source is cache/probe
+
+
+def shape_class(jobs: int, machines: int) -> str:
+    """The Taillard-style shape-class label table rows key on."""
+    return f"{int(jobs)}x{int(machines)}"
+
+
+# (context, shape_class) -> Params. Contexts: "bench" (single-chip
+# throughput bench), "serving" (SearchServer request default), "cli"
+# (reference-parity one-shot runs). Only MEASURED rows belong here;
+# everything else resolves through _FALLBACK.
+MEASURED: dict[tuple[str, str], Params] = {
+    # ROUND5: the bf16-matmul retune, measured on ta021 (20x20) — the
+    # whole 20-job family shares the cost structure (the pair sweep is
+    # machine-count-bound, not job-count-bound)
+    ("bench", "20x5"): Params(chunk=BENCH_CHUNK_DEFAULT),
+    ("bench", "20x10"): Params(chunk=BENCH_CHUNK_DEFAULT),
+    ("bench", "20x20"): Params(chunk=BENCH_CHUNK_DEFAULT),
+}
+
+_FALLBACK: dict[str, Params] = {
+    "bench": Params(chunk=BENCH_CHUNK_DEFAULT),
+    "serving": Params(chunk=SERVING_CHUNK_DEFAULT),
+    "cli": Params(chunk=CLI_CHUNK_DEFAULT),
+}
+
+
+def params_for(context: str, jobs: int | None = None,
+               machines: int | None = None) -> Params:
+    """Resolve the default dispatch params for a context and shape —
+    the tuner's fallback tier and the single source config/bench/serve
+    read their chunk/balance_period defaults from."""
+    if context not in _FALLBACK:
+        raise ValueError(f"unknown defaults context {context!r} "
+                         f"(want one of {sorted(_FALLBACK)})")
+    if jobs is not None and machines is not None:
+        row = MEASURED.get((context, shape_class(jobs, machines)))
+        if row is not None:
+            return row
+    return _FALLBACK[context]

@@ -12,6 +12,8 @@ from __future__ import annotations
 import dataclasses
 import os
 
+from ..tune import defaults as tune_defaults
+
 _TRUTHY = ("1", "true", "on", "yes")
 
 
@@ -171,6 +173,22 @@ INCUMBENT_MAX_KEYS_DEFAULT = 4096  # TTS_INCUMBENT_MAX_KEYS — bound on
                                    # same bounded-observability stance
                                    # as TTS_METRIC_MAX_SERIES
 
+# Adaptive dispatch (tpu_tree_search/tune + engine/ladder):
+# TTS_LADDER=1 (STATIC, default off — off is bit-identical to the
+# pre-ladder driver) enables chunk-ladder execution in the segmented
+# distributed driver: 2-3 pre-built chunk rungs switched only at
+# segment boundaries from the pool-occupancy signal, so ramp/drain run
+# small-chunk steps instead of underfilled tuned-chunk ones.
+# TTS_TUNE_CACHE names the persistent tuning-cache directory
+# (tune/cache.TuningCache — fingerprint-checked, CRC-stamped, corrupt
+# entries quarantined); TTS_TUNE=1 lets `serve --prewarm` PROBE cold
+# shapes at boot (a warm cache replays with zero probes either way).
+# Probe knobs for CI/small hosts: TTS_TUNE_CHUNKS / TTS_TUNE_PERIODS
+# (comma lists), TTS_TUNE_WINDOW / TTS_TUNE_WARM (iterations).
+LADDER_FLAG = "TTS_LADDER"
+TUNE_CACHE_ENV = "TTS_TUNE_CACHE"
+TUNE_ENV = "TTS_TUNE"
+
 
 @dataclasses.dataclass
 class PFSPConfig:
@@ -191,10 +209,14 @@ class PFSPConfig:
     L: int = 1            # -L inter-node balancing on/off (same collective
                           #    tier on TPU; ws==0 and L==0 disable balance)
     perc: float = 0.5     # -p steal fraction (steal-half = 0.5)
-    # --- TPU engine knobs
-    chunk: int = 256          # parents popped per compiled step
+    # --- TPU engine knobs (defaults single-sourced in
+    # tune/defaults.py — the measured table bench and serve also read;
+    # the Autotuner's fallback tier)
+    chunk: int = tune_defaults.CLI_CHUNK_DEFAULT
+    #                         # parents popped per compiled step
     capacity: int = 1 << 20   # per-device pool rows
-    balance_period: int = 4   # steps between collective balance rounds
+    balance_period: int = tune_defaults.BALANCE_PERIOD_DEFAULT
+    #                         # steps between collective balance rounds
     csv: str | None = None    # append a reference-schema CSV row here
     # Resilience knobs deliberately do NOT live on this dataclass: the
     # override channel is env vars (TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S
@@ -213,6 +235,6 @@ class NQueensConfig:
     N: int = 14           # -N board size
     g: int = 1            # -g safety-check repetitions (work scaling)
     D: int = 0            # devices (0 = all)
-    chunk: int = 256
+    chunk: int = tune_defaults.CLI_CHUNK_DEFAULT
     capacity: int = 1 << 20
-    balance_period: int = 4
+    balance_period: int = tune_defaults.BALANCE_PERIOD_DEFAULT
