@@ -222,8 +222,8 @@ def bench_ramp_drain(inst: int):
     from tpu_tree_search.utils import config as cfg
 
     ladder_on = cfg.env_flag(cfg.LADDER_FLAG)
-    jobs = int(os.environ.get("TTS_BENCH_RAMP_JOBS", "10"))
-    chunk = int(os.environ.get("TTS_BENCH_RAMP_CHUNK", "1024"))
+    jobs = cfg.env_int("TTS_BENCH_RAMP_JOBS")
+    chunk = cfg.env_int("TTS_BENCH_RAMP_CHUNK")
     p = taillard.processing_times(inst)[:, :jobs]
     n_dev = len(jax.devices())
     cache = ExecutorCache()
@@ -292,22 +292,22 @@ def bench_ramp_drain(inst: int):
 
 
 def main():
-    inst = int(os.environ.get("TTS_BENCH_INSTANCE", "21"))
+    from tpu_tree_search.utils import config as cfg
+    inst = cfg.env_int("TTS_BENCH_INSTANCE")
     p = taillard.processing_times(inst)
     jobs, machines = p.shape[1], p.shape[0]
     # measured single-chip default from the per-shape-class table
     # (tune/defaults.py — the r5 65536 retune lives THERE now, beside
     # its provenance, instead of being hardcoded here)
-    chunk = int(os.environ.get("TTS_BENCH_CHUNK", "")
-                or tune_defaults.params_for("bench", jobs,
-                                            machines).chunk)
+    chunk = (cfg.env_int("TTS_BENCH_CHUNK")
+             or tune_defaults.params_for("bench", jobs,
+                                         machines).chunk)
     # long window: a single dispatch through the runtime costs O(100 ms)
     # host-side; the compiled loop itself is ~0.6 ms/iteration, so short
     # windows under-report the sustained rate real runs see
-    iters = int(os.environ.get("TTS_BENCH_ITERS", "2000"))
+    iters = cfg.env_int("TTS_BENCH_ITERS")
     capacity = 1 << 22
-    lbs = [int(x) for x in
-           os.environ.get("TTS_BENCH_LB", "1,2").split(",")]
+    lbs = [int(x) for x in cfg.env_str("TTS_BENCH_LB").split(",")]
 
     ub = taillard.optimal_makespan(inst)
     tables = batched.make_tables(p)
@@ -319,11 +319,9 @@ def main():
     # keep matching the modeless history) and perf_sentry never judges
     # a tuned rate against fixed-chunk history (row-mode SKIP).
     tuner = None
-    if os.environ.get("TTS_BENCH_TUNED", "0").lower() not in (
-            "0", "", "off", "no"):
+    if cfg.env_flag("TTS_BENCH_TUNED"):
         from tpu_tree_search.tune import Autotuner
-        tuner = Autotuner(
-            cache_dir=os.environ.get("TTS_TUNE_CACHE") or None)
+        tuner = Autotuner(cache_dir=cfg.env_str("TTS_TUNE_CACHE"))
 
     for lb_kind in lbs:
         tuned_row = {}
@@ -345,7 +343,10 @@ def main():
         # warm-up directly.
         it = iters if lb_kind != 2 else max(200, iters // 2)
         warm = 50 if lb_kind != 2 else min(1000, max(50, iters // 2))
-        warm = int(os.environ.get("TTS_BENCH_WARM", warm))
+        # `is None`, not `or`: TTS_BENCH_WARM=0 legitimately disables
+        # warm-up (cold-rate measurement) and must not fall through
+        env_warm = cfg.env_int("TTS_BENCH_WARM")
+        warm = warm if env_warm is None else env_warm
         evals, dt, state, tele0 = bench_one(tables, p, ub, lb_kind,
                                             chunk, it, capacity,
                                             warm=warm)
@@ -395,11 +396,11 @@ def main():
               f"chunk={chunk} pool={int(state.size)} "
               f"best={int(state.best)}", file=sys.stderr)
 
-    if os.environ.get("TTS_BENCH_SEGGAP", "1") != "0":
+    if cfg.env_flag("TTS_BENCH_SEGGAP"):
         bench_segment_gap(p, ub, inst)
-    if os.environ.get("TTS_BENCH_COLDSTART", "1") != "0":
+    if cfg.env_flag("TTS_BENCH_COLDSTART"):
         bench_cold_start(p, inst)
-    if os.environ.get("TTS_BENCH_RAMPDRAIN", "1") != "0":
+    if cfg.env_flag("TTS_BENCH_RAMPDRAIN"):
         bench_ramp_drain(inst)
 
 

@@ -336,7 +336,8 @@ def test_serve_session_flight_recorder_end_to_end(fresh_obs, baselines,
         assert reqs[victim]["dispatches"] >= 2
         assert trace_summary.main([artifact]) == 0
     # CI artifact hand-off: the workflow uploads this directory
-    art = os.environ.get("TTS_OBS_ARTIFACT_DIR")
+    from tpu_tree_search.utils import config as _cfg
+    art = _cfg.env_str("TTS_OBS_ARTIFACT_DIR")
     if art:
         os.makedirs(art, exist_ok=True)
         shutil.copy(jsonl, os.path.join(art, "serve_trace.jsonl"))

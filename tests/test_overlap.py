@@ -221,6 +221,56 @@ def test_writer_preserves_rotation_order(tmp_path):
     assert int(np.asarray(prevst.iters)) == iters_seen[-2]
 
 
+def test_async_writer_saturated_error_path_stays_live(tmp_path,
+                                                      monkeypatch):
+    """Liveness under the worst pairing: a FULL bounded queue and a
+    writer stuck in its error path. A producer blocked in the queue's
+    put() while the writer's error store waits on a lock the producer
+    holds is an ABBA deadlock between the lock and the queue capacity —
+    the reason the writer keeps TWO locks (_close_lock the writer never
+    takes, _err_lock for the error hand-off). Every enqueue/drain here
+    must finish within the watchdog, with the write failure surfaced."""
+    import threading
+
+    from tpu_tree_search.engine import device
+    from tpu_tree_search.ops import batched
+
+    inst, opt = _setup()
+    tables = batched.make_tables(inst.p_times)
+    state = device.init_state(inst.jobs, 1 << 10, opt,
+                              p_times=inst.p_times)
+    state = device.run(tables, state, 1, 8, max_iters=2)
+
+    def boom(path, arrays):
+        raise OSError("disk on fire")
+
+    monkeypatch.setattr(checkpoint, "_write_snapshot", boom)
+    writer = checkpoint.AsyncCheckpointWriter(retry_attempts=1,
+                                              retry_base_s=0.0,
+                                              max_pending=1)
+    errors = []
+
+    def producer():
+        for k in range(6):     # 6 tasks through a 1-deep queue
+            try:
+                writer.submit(str(tmp_path / "w.npz"), state,
+                              segment=k)
+            except OSError as e:
+                errors.append(e)
+        try:
+            writer.drain()
+        except OSError as e:
+            errors.append(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    t.join(timeout=60)
+    alive = t.is_alive()
+    writer.close(raise_pending=False)
+    assert not alive, "writer/producer wedged (queue-capacity deadlock)"
+    assert errors and all("disk on fire" in str(e) for e in errors)
+
+
 def test_overlap_gap_metric_zero(fresh_registry):
     """The measured device-idle half of the acceptance criterion: with
     overlap on (and no checkpoint sync points) every recorded gap is

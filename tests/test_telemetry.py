@@ -100,11 +100,12 @@ def test_counts_bit_identical_on_off(lb, machines):
     assert on.telemetry.shape == (tele.WIDTH,)
 
 
-def test_distributed_bit_identical_and_steal_flow(telemetry_on):
+def test_distributed_bit_identical_and_steal_flow(telemetry_on,
+                                                   monkeypatch):
     inst = PFSPInstance.synthetic(jobs=8, machines=3, seed=5)
     on = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
                             n_devices=4, **KW)
-    os.environ.pop(tele.ENV_FLAG)
+    monkeypatch.delenv(tele.ENV_FLAG)
     off = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
                              n_devices=4, **KW)
     assert (on.explored_tree, on.explored_sol, on.best) == \
@@ -332,8 +333,9 @@ def test_serve_session_labels_and_search_report(fresh_obs, telemetry_on,
     assert search_report.main([str(jsonl)]) == 0
 
     # CI artifact hand-off (the telemetry leg uploads these)
-    art = os.environ.get("TTS_OBS_ARTIFACT_DIR")
-    if art and os.environ.get(tele.ENV_FLAG):
+    from tpu_tree_search.utils import config as _cfg
+    art = _cfg.env_str("TTS_OBS_ARTIFACT_DIR")
+    if art and _cfg.env_flag(tele.ENV_FLAG):
         os.makedirs(art, exist_ok=True)
         shutil.copy(jsonl, os.path.join(art, "telemetry_trace.jsonl"))
         with open(os.path.join(art, "search_report.txt"), "w") as f:

@@ -40,9 +40,10 @@ from jax.sharding import PartitionSpec as P  # noqa: E402
 
 
 def main():
-    chunk = int(os.environ.get("TTS_BAL_CHUNK", 32768))
-    capacity = int(os.environ.get("TTS_BAL_CAP", 1 << 21))
-    rounds = int(os.environ.get("TTS_BAL_ROUNDS", 20))
+    from tpu_tree_search.utils import config as _cfg
+    chunk = _cfg.env_int("TTS_BAL_CHUNK")
+    capacity = _cfg.env_int("TTS_BAL_CAP")
+    rounds = _cfg.env_int("TTS_BAL_ROUNDS")
     p = taillard.processing_times(21)
     jobs, machines = p.shape[1], p.shape[0]
     mesh = worker_mesh(8)

@@ -93,16 +93,22 @@ import zlib
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-OUT = os.environ.get("TTS_CAMPAIGN_OUT", "/tmp/campaign.jsonl")
-WORKDIR = os.environ.get("TTS_WORKDIR", "/tmp")
-LB = int(os.environ.get("TTS_LB", "2"))
-CHUNK = int(os.environ.get("TTS_CHUNK", "32768"))
-BUDGET_S = float(os.environ.get("TTS_BUDGET_S", "7200"))
-SEG = int(os.environ.get("TTS_SEG", "2000"))
-CKPT_EVERY = int(os.environ.get("TTS_CKPT_EVERY", "8"))
-UB_MODE = os.environ.get("TTS_UB", "opt")
-STALL_GRACE = float(os.environ.get("TTS_STALL_GRACE", "900"))
-STALL_FACTOR = float(os.environ.get("TTS_STALL_FACTOR", "4"))
+# knob reads go through the lint-checked registry accessors
+# (utils/config.KNOBS — defaults live there, tts_lint enforces the
+# single-sourcing); apply_platform_override() still runs before any
+# device use, so the early package import does not pin the backend
+from tpu_tree_search.utils import config as _cfg  # noqa: E402
+
+OUT = _cfg.env_str("TTS_CAMPAIGN_OUT")
+WORKDIR = _cfg.env_str("TTS_WORKDIR")
+LB = _cfg.env_int("TTS_LB")
+CHUNK = _cfg.env_int("TTS_CHUNK")
+BUDGET_S = _cfg.env_float("TTS_BUDGET_S")
+SEG = _cfg.env_int("TTS_SEG")
+CKPT_EVERY = _cfg.env_int("TTS_CKPT_EVERY")
+UB_MODE = _cfg.env_str("TTS_UB")
+STALL_GRACE = _cfg.env_float("TTS_STALL_GRACE")
+STALL_FACTOR = _cfg.env_float("TTS_STALL_FACTOR")
 # the floor sits ABOVE the documented ~633 s self-clearing tunnel
 # stalls (BENCHMARKS.md): killing a merely-stalled dispatch crashes the
 # remote TPU worker, and every process that attaches afterwards hangs
@@ -111,12 +117,12 @@ STALL_FACTOR = float(os.environ.get("TTS_STALL_FACTOR", "4"))
 # crashed worker + reconnect hang + lost unsaved segments). The
 # supervisor exists for PERMANENT hangs; ~12 min detection latency is
 # noise on the multi-hour runs it protects.
-STALL_MIN = float(os.environ.get("TTS_STALL_MIN", "720"))
-MAX_RESTARTS = int(os.environ.get("TTS_MAX_RESTARTS", "50"))
+STALL_MIN = _cfg.env_float("TTS_STALL_MIN")
+MAX_RESTARTS = _cfg.env_int("TTS_MAX_RESTARTS")
 # consecutive worker deaths with no iteration progress before giving
 # up: 5, not fewer — after a remote-worker crash the first several
 # respawns can each burn the full init grace just reconnecting
-DEAD_LIMIT = int(os.environ.get("TTS_DEAD_LIMIT", "5"))
+DEAD_LIMIT = _cfg.env_int("TTS_DEAD_LIMIT")
 
 
 def paths(inst: int, lb: int):
@@ -195,7 +201,7 @@ def worker_main(inst: int) -> None:
 
     lb = LB
     status_path, ckpt_path = paths(inst, lb)
-    stall_at = int(os.environ.get("TTS_TEST_STALL_AT_SEG", "0"))
+    stall_at = _cfg.env_int("TTS_TEST_STALL_AT_SEG")
 
     def emit(rec: dict) -> None:
         rec["t"] = time.time()
@@ -206,7 +212,7 @@ def worker_main(inst: int) -> None:
     ub = taillard.optimal_makespan(inst) if UB_MODE == "opt" else None
     m, jobs = p.shape
     tables = batched.make_tables(p)
-    capacity = int(os.environ.get("TTS_CAPACITY", "0")) or \
+    capacity = _cfg.env_int("TTS_CAPACITY") or \
         max(device.default_capacity(jobs, m), 4 * CHUNK * jobs)
     grows = 0
     spent_before = 0.0
@@ -585,7 +591,7 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
     # event log that shows its requests' dispatches, preemptions,
     # checkpoints and retries (tools/trace_summary.py renders it;
     # obs/chrome_trace converts it for Perfetto)
-    trace_file = os.environ.get("TTS_TRACE_FILE") or \
+    trace_file = _cfg.env_str("TTS_TRACE_FILE") or \
         os.path.join(WORKDIR, "campaign_trace.jsonl")
     tracelog.get().set_sink(trace_file)
     print(f"flight recorder: {trace_file}", flush=True)
@@ -604,7 +610,7 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
             # above the class default); the distributed driver still
             # grows losslessly on overflow, this just avoids paying the
             # grow+recompile on instances the floor was tuned for
-            capacity = int(os.environ.get("TTS_CAPACITY", "0")) or \
+            capacity = _cfg.env_int("TTS_CAPACITY") or \
                 max(device.default_capacity(p.shape[1], p.shape[0]),
                     4 * CHUNK * p.shape[1])
             rids[inst] = srv.submit(SearchRequest(
@@ -708,7 +714,7 @@ def main(argv=None):
                          "killed at the process level; it will be "
                          "removed — migrate to the default serve mode.")
     ap.add_argument("--submeshes", type=int,
-                    default=int(os.environ.get("TTS_SUBMESHES", "1")),
+                    default=_cfg.env_int("TTS_SUBMESHES"),
                     help="serve mode: partition the device mesh into "
                          "this many equal submeshes and solve that many "
                          "instances concurrently (default 1)")

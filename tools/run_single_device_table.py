@@ -29,7 +29,9 @@ from tpu_tree_search.engine import checkpoint, device  # noqa: E402
 from tpu_tree_search.ops import batched  # noqa: E402
 from tpu_tree_search.problems import taillard  # noqa: E402
 
-OUT = os.environ.get("TTS_TABLE_OUT", "/tmp/single_device_table.jsonl")
+from tpu_tree_search.utils import config as _cfg  # noqa: E402
+
+OUT = _cfg.env_str("TTS_TABLE_OUT")
 CHUNK = 32768
 CAPACITY = 1 << 22
 SEG = 2000

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""tts-lint CLI: run the repo's static invariant analyzers.
+
+    python tools/tts_lint.py                  # human report, exit != 0
+                                              # on any unwaived finding
+    python tools/tts_lint.py --json out.json  # machine-readable report
+    python tools/tts_lint.py --checkers knobs,metrics
+    python tools/tts_lint.py --write-docs     # regenerate the README
+                                              # knob/metric registry
+                                              # tables, then lint
+
+Checkers: trace_safety (host-sync/nondeterminism hazards reachable from
+jit entry points), locks (guarded-by annotation discipline + lock-order
+cycles), knobs (TTS_* single-sourcing in utils/config.py), metrics
+(tts_* name registry reconciliation). See
+tpu_tree_search/analysis/__init__.py and README.md "Static analysis".
+
+Waivers: .tts-lint-waivers.json at the repo root maps a finding's
+stable fingerprint to a WRITTEN reason. The CI lint leg runs this
+script blocking — an unwaived finding fails the build.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from tpu_tree_search import analysis  # noqa: E402
+from tpu_tree_search.analysis import docs  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tts_lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--root", default=None,
+                    help="tree to lint (default: this checkout)")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="also write the JSON findings report here "
+                         "('-' for stdout)")
+    ap.add_argument("--checkers", default=None,
+                    help="comma list: " + ",".join(analysis.CHECKERS))
+    ap.add_argument("--write-docs", action="store_true",
+                    help="regenerate the README generated registry "
+                         "blocks before linting")
+    args = ap.parse_args(argv)
+
+    checkers = None
+    if args.checkers:
+        checkers = [c.strip() for c in args.checkers.split(",")
+                    if c.strip()]
+        unknown = set(checkers) - set(analysis.CHECKERS)
+        if unknown:
+            ap.error(f"unknown checker(s): {sorted(unknown)}")
+
+    if args.write_docs:
+        changed = docs.write_docs(args.root)
+        print("regenerated README block(s): "
+              + (", ".join(changed) if changed else "none (up to date)"))
+
+    report = analysis.run_all(args.root, checkers=checkers)
+    if args.json:
+        payload = json.dumps(report.to_json(), indent=2, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            pathlib.Path(args.json).write_text(payload + "\n")
+            print(f"json report: {args.json}")
+    print(report.render())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,7 +103,8 @@ def main():
         # wall slack that the two-trip differencing cannot cancel
         # (device scheduling bubbles, loop-carry overhead) amortizes
         # only with a long window — K=64 read +38.6% on LB1 (r4)
-        K = int(os.environ.get("TTS_BRACKET_REPS", "256"))
+        from tpu_tree_search.utils import config as _cfg
+        K = _cfg.env_int("TTS_BRACKET_REPS")
 
         def make_loop(reps):
             @jax.jit

@@ -1,0 +1,69 @@
+"""tts-lint: repo-native static invariant analysis.
+
+The runtime stack's correctness rests on conventions that nine PRs of
+review passes kept re-teaching by hand: static flags stay OUT of traced
+code (bit-identical off-modes, no silent retraces), shared state is
+touched only under its documented lock (the AOTCache / ExecutorCache /
+IncumbentBoard / HealthMonitor race fixes), every ``TTS_*`` knob is
+single-sourced in ``utils/config.py``, and every ``tts_*`` metric name
+matches one checked-in registry. This package turns those conventions
+into machine-checked invariants at COMMIT time — the same move
+``obs/audit.py`` made for node conservation at runtime.
+
+Four checkers (one module each):
+
+- :mod:`trace_safety` — walks functions reachable from jit / shard_map /
+  ``lax.{cond,switch,scan,while_loop}`` entry points in ``engine/`` and
+  ``ops/`` and flags host-sync + nondeterminism hazards inside traced
+  code (``.item()``, ``np.asarray`` on traced values, ``time.time()``,
+  env reads — a static flag read inside a traced function is a silent
+  retrace hazard);
+- :mod:`locks` — a ``# guarded-by: self._lock`` annotation grammar on
+  shared attributes of the threaded classes, verifying every mutation
+  site sits inside the matching ``with`` block, plus a
+  lock-acquisition-order graph that reports cycles;
+- :mod:`knobs` — ``TTS_*`` env reads outside ``utils/config.py`` are
+  findings; every knob needs a ``config.KNOBS`` row and a README
+  mention;
+- :mod:`metric_registry` — every ``tts_*`` metric name at an emit or
+  reference site must appear in ``obs/metric_names.REGISTRY`` (and
+  vice versa), catching name drift between emit sites, README tables,
+  health rules and dashboards.
+
+Findings are :class:`core.Finding` records with stable fingerprints; a
+checked-in waiver file (``.tts-lint-waivers.json``: fingerprint +
+written reason) triages pre-existing true-but-deferred violations
+explicitly. ``tools/tts_lint.py`` is the CLI; the CI ``lint`` leg runs
+it blocking — any unwaived finding fails the build.
+"""
+
+from __future__ import annotations
+
+from . import docs, knobs, locks, metric_registry, trace_safety
+from .core import (Finding, LintReport, Waivers, load_waivers, repo_files,
+                   repo_root)
+
+__all__ = ["Finding", "LintReport", "Waivers", "run_all", "repo_root",
+           "repo_files", "load_waivers", "docs", "knobs", "locks",
+           "metric_registry", "trace_safety"]
+
+CHECKERS = {
+    "trace_safety": trace_safety.check,
+    "locks": locks.check,
+    "knobs": knobs.check,
+    "metrics": metric_registry.check,
+}
+
+
+def run_all(root=None, checkers=None, waivers: Waivers | None = None
+            ) -> LintReport:
+    """Run the requested checkers (all by default) over the repo at
+    `root` and fold in the waiver file. Returns a :class:`LintReport`
+    whose ``ok`` is True iff no unwaived finding survived."""
+    root = repo_root(root)
+    findings: list[Finding] = []
+    for name in (checkers or CHECKERS):
+        findings.extend(CHECKERS[name](root))
+    if waivers is None:
+        waivers = load_waivers(root)
+    return LintReport.build(findings, waivers)

@@ -1,0 +1,262 @@
+"""Shared machinery for the tts-lint checkers: findings, fingerprints,
+parsed-source caching, the waiver file, and report assembly.
+
+Design rules the four checkers follow:
+
+- **Stable fingerprints.** A finding's fingerprint hashes its checker,
+  rule, repo-relative path and the SYMBOL it anchors to (class.attr,
+  function qualname, knob/metric name) — never the line number — so a
+  waiver survives unrelated edits to the file but dies with the symbol
+  it excused.
+- **Parse with stdlib.** The checkers themselves use only ``ast`` +
+  ``tokenize``. Loading the registries (``utils/config.KNOBS``,
+  ``obs/metric_names.REGISTRY``) does import the package — and the
+  package ``__init__`` imports jax — so running the linter needs the
+  repo installed, accelerator stack included (the CI lint leg
+  ``pip install -e .`` first). Fixture trees without a registry module
+  exercise the site-side rules with no registry import at all.
+- **Never crash on bad input.** A file that fails to parse becomes a
+  ``parse_error`` finding, not a traceback — the linter is a gate, and
+  a gate that dies open is not a gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import pathlib
+import tokenize
+
+__all__ = ["Finding", "Waivers", "LintReport", "SourceFile", "parse_file",
+           "repo_root", "repo_files", "load_waivers", "WAIVER_FILE"]
+
+WAIVER_FILE = ".tts-lint-waivers.json"
+
+# directories never scanned (vendored/derived/VCS trees)
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+@dataclasses.dataclass
+class Finding:
+    """One invariant violation.
+
+    `symbol` is the stable anchor (see the fingerprint rule above);
+    `message` is the human sentence; `line` is advisory (it moves with
+    edits and is deliberately NOT part of the fingerprint)."""
+
+    checker: str
+    rule: str
+    path: str           # repo-relative, POSIX separators
+    line: int
+    symbol: str
+    message: str
+
+    def fingerprint(self) -> str:
+        raw = f"{self.checker}:{self.rule}:{self.path}:{self.symbol}"
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def to_json(self) -> dict:
+        return {"checker": self.checker, "rule": self.rule,
+                "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "fingerprint": self.fingerprint()}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}/{self.rule}] "
+                f"{self.message} (fingerprint {self.fingerprint()})")
+
+
+@dataclasses.dataclass
+class Waivers:
+    """The checked-in triage file: fingerprint -> written reason. A
+    waiver without a reason is refused at load time — the file exists
+    to make deferrals EXPLICIT, and an empty reason is not a triage."""
+
+    by_fingerprint: dict
+    path: str | None = None
+
+    def reason_for(self, finding: Finding) -> str | None:
+        return self.by_fingerprint.get(finding.fingerprint())
+
+    @classmethod
+    def empty(cls) -> "Waivers":
+        return cls(by_fingerprint={})
+
+
+def load_waivers(root) -> Waivers:
+    path = pathlib.Path(repo_root(root)) / WAIVER_FILE
+    if not path.exists():
+        return Waivers.empty()
+    data = json.loads(path.read_text())
+    table = {}
+    for entry in data.get("waivers", []):
+        fp = entry.get("fingerprint", "")
+        reason = (entry.get("reason") or "").strip()
+        if not fp:
+            raise ValueError(f"{path}: waiver entry missing fingerprint: "
+                             f"{entry}")
+        if not reason:
+            raise ValueError(f"{path}: waiver {fp} has no reason — a "
+                             "waiver is a written triage, not a mute")
+        table[fp] = reason
+    return Waivers(by_fingerprint=table, path=str(path))
+
+
+@dataclasses.dataclass
+class LintReport:
+    """The run's outcome: surviving findings, waived findings (with
+    their reasons) and waivers that matched nothing (stale triage —
+    reported so the file stays honest, but not failing)."""
+
+    findings: list          # unwaived, the gate input
+    waived: list            # (Finding, reason)
+    unused_waivers: list    # fingerprints with no matching finding
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    @classmethod
+    def build(cls, findings: list, waivers: Waivers) -> "LintReport":
+        live, waived, used = [], [], set()
+        for f in findings:
+            reason = waivers.reason_for(f)
+            if reason is None:
+                live.append(f)
+            else:
+                waived.append((f, reason))
+                used.add(f.fingerprint())
+        unused = sorted(set(waivers.by_fingerprint) - used)
+        order = {"trace_safety": 0, "locks": 1, "knobs": 2, "metrics": 3}
+        live.sort(key=lambda f: (order.get(f.checker, 9), f.path, f.line))
+        return cls(findings=live, waived=waived, unused_waivers=unused)
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "counts": {"findings": len(self.findings),
+                       "waived": len(self.waived),
+                       "unused_waivers": len(self.unused_waivers)},
+            "findings": [f.to_json() for f in self.findings],
+            "waived": [{**f.to_json(), "reason": r}
+                       for f, r in self.waived],
+            "unused_waivers": self.unused_waivers,
+        }
+
+    def render(self) -> str:
+        lines = []
+        if self.findings:
+            lines.append(f"{len(self.findings)} unwaived finding(s):")
+            lines.extend("  " + f.render() for f in self.findings)
+        else:
+            lines.append("no unwaived findings")
+        if self.waived:
+            lines.append(f"{len(self.waived)} waived:")
+            lines.extend(f"  {f.path}: [{f.checker}/{f.rule}] "
+                         f"{f.symbol} — {r}" for f, r in self.waived)
+        if self.unused_waivers:
+            lines.append(f"{len(self.unused_waivers)} stale waiver(s) "
+                         "matched nothing (prune them):")
+            lines.extend(f"  {fp}" for fp in self.unused_waivers)
+        return "\n".join(lines)
+
+
+# ------------------------------------------------------------ source files
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """A parsed module plus the comment map the annotation grammars
+    need (``# guarded-by:`` / ``# holds:`` live in comments, which ast
+    drops — tokenize recovers them per line)."""
+
+    path: pathlib.Path       # absolute
+    rel: str                 # repo-relative POSIX
+    tree: ast.Module
+    source: str
+    comments: dict           # line -> comment text (without '#')
+
+    def comment_at(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+
+# parsed-source cache shared by the four checkers: run_all() has them
+# scan overlapping subtrees, so without it most of the package is
+# ast.parse+tokenize'd several times per lint run. Keyed on
+# (path, mtime_ns, size) so an edited file re-parses — a long pytest
+# session linting many fixture trees stays correct.
+_PARSE_CACHE: dict = {}
+
+
+def parse_file(path: pathlib.Path, root: pathlib.Path
+               ) -> SourceFile | Finding:
+    rel = path.relative_to(root).as_posix()
+    try:
+        st = path.stat()
+        cache_key = (str(path), st.st_mtime_ns, st.st_size)
+        hit = _PARSE_CACHE.get(cache_key)
+        if hit is not None and hit.rel == rel:
+            return hit
+        source = path.read_text(encoding="utf-8")
+        tree = ast.parse(source, filename=str(path))
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        return Finding(checker="core", rule="parse_error", path=rel,
+                       line=getattr(e, "lineno", 0) or 0, symbol=rel,
+                       message=f"cannot parse: {e!r}")
+    comments: dict = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments[tok.start[0]] = tok.string.lstrip("#").strip()
+    except tokenize.TokenError:
+        pass   # comments stay partial; the AST already parsed
+    sf = SourceFile(path=path, rel=rel, tree=tree, source=source,
+                    comments=comments)
+    if len(_PARSE_CACHE) > 4096:   # fixture-tree churn bound
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[cache_key] = sf
+    return sf
+
+
+def repo_root(root=None) -> pathlib.Path:
+    """Resolve the tree to lint: an explicit root, else the repo this
+    package is installed from (three parents up: analysis/ -> package
+    -> checkout)."""
+    if root is not None:
+        return pathlib.Path(root).resolve()
+    return pathlib.Path(__file__).resolve().parents[2]
+
+
+def repo_files(root, subdirs=None) -> list:
+    """Every .py file under `root` (or just `subdirs` of it), sorted,
+    skipping derived trees. `subdirs` entries may be files."""
+    root = repo_root(root)
+    paths = []
+    bases = ([root / s for s in subdirs] if subdirs else [root])
+    for base in bases:
+        if base.is_file():
+            paths.append(base)
+            continue
+        if not base.exists():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if not any(part in _SKIP_DIRS for part in p.parts):
+                paths.append(p)
+    return paths
+
+
+def parse_many(root, subdirs=None):
+    """(sources, findings) over the selected files."""
+    root = repo_root(root)
+    sources, findings = [], []
+    for p in repo_files(root, subdirs):
+        got = parse_file(p, root)
+        if isinstance(got, Finding):
+            findings.append(got)
+        else:
+            sources.append(got)
+    return sources, findings

@@ -1,0 +1,132 @@
+"""Generated registry documentation: the README knob/metric tables.
+
+The hand-maintained README knob and metric lists were exactly the drift
+surface the registries exist to kill, so they are GENERATED here from
+``utils/config.KNOBS`` and ``obs/metric_names.REGISTRY`` and spliced
+between HTML-comment markers in README.md::
+
+    <!-- BEGIN GENERATED: tts-knob-registry -->
+    ... (do not edit by hand) ...
+    <!-- END GENERATED: tts-knob-registry -->
+
+``tools/tts_lint.py --write-docs`` rewrites the blocks;
+:func:`check_block` (run by the knob and metric checkers) reports a
+``docs_drift`` finding when a block is missing or stale, so CI fails a
+registry edit that forgot to regenerate the docs.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, repo_root
+
+__all__ = ["render_block", "write_docs", "check_block", "BLOCKS"]
+
+_SCOPE_TITLES = (("runtime", "Runtime"), ("bench", "bench.py"),
+                 ("tool", "tools/ drivers"), ("test", "Test suite"))
+
+
+def _fmt_default(v) -> str:
+    if v is None:
+        return "unset"
+    if v is True:
+        return "on"
+    if v is False:
+        return "off"
+    return f"`{v}`"
+
+
+def render_knob_table() -> str:
+    from ..utils.config import KNOBS
+    lines = ["_Generated from `utils/config.KNOBS` by "
+             "`tools/tts_lint.py --write-docs`; edit the registry, "
+             "not this table._", ""]
+    for scope, title in _SCOPE_TITLES:
+        rows = [k for k in KNOBS.values() if k.scope == scope]
+        if not rows:
+            continue
+        lines += [f"**{title}**", "",
+                  "| knob | type | default | what it does |",
+                  "|---|---|---|---|"]
+        lines += [f"| `{k.name}` | {k.kind} | {_fmt_default(k.default)} "
+                  f"| {k.doc} |" for k in rows]
+        lines.append("")
+    return "\n".join(lines).rstrip()
+
+
+def render_metric_table() -> str:
+    from ..obs.metric_names import REGISTRY
+    lines = ["_Generated from `obs/metric_names.REGISTRY` by "
+             "`tools/tts_lint.py --write-docs`; edit the registry, "
+             "not this table._", "",
+             "| metric | type | labels | meaning |", "|---|---|---|---|"]
+    for m in sorted(REGISTRY.values(), key=lambda m: m.name):
+        labels = f"`{m.labels}`" if m.labels else "—"
+        lines.append(f"| `{m.name}` | {m.kind} | {labels} | {m.doc} |")
+    return "\n".join(lines)
+
+
+BLOCKS = {
+    "tts-knob-registry": render_knob_table,
+    "tts-metric-registry": render_metric_table,
+}
+
+
+def _markers(block: str) -> tuple:
+    return (f"<!-- BEGIN GENERATED: {block} -->",
+            f"<!-- END GENERATED: {block} -->")
+
+
+def _splice(text: str, block: str, body: str) -> str | None:
+    begin, end = _markers(block)
+    i = text.find(begin)
+    j = text.find(end)
+    if i < 0 or j < 0 or j < i:
+        return None
+    return text[:i + len(begin)] + "\n" + body + "\n" + text[j:]
+
+
+def write_docs(root=None) -> list:
+    """Regenerate every marked README block; returns the block names
+    that changed. Blocks whose markers are absent are left alone (the
+    drift check reports them)."""
+    root = repo_root(root)
+    path = root / "README.md"
+    text = path.read_text(encoding="utf-8")
+    changed = []
+    for block, render in BLOCKS.items():
+        new = _splice(text, block, render())
+        if new is not None and new != text:
+            text = new
+            changed.append(block)
+    if changed:
+        path.write_text(text, encoding="utf-8")
+    return changed
+
+
+def check_block(root, block: str) -> list:
+    """``docs_drift`` findings for one generated README block (run by
+    the checker that owns the corresponding registry)."""
+    root = repo_root(root)
+    path = root / "README.md"
+    if not path.exists():
+        return []
+    text = path.read_text(encoding="utf-8")
+    begin, end = _markers(block)
+    i, j = text.find(begin), text.find(end)
+    if i < 0 or j < 0 or j < i:
+        return [Finding(
+            checker="metrics" if "metric" in block else "knobs",
+            rule="docs_drift", path="README.md", line=0, symbol=block,
+            message=f"README.md is missing the generated {block} block "
+                    f"(add the {begin} / {end} markers and run "
+                    "tools/tts_lint.py --write-docs)")]
+    current = text[i + len(begin):j].strip("\n")
+    want = BLOCKS[block]().strip("\n")
+    if current != want:
+        return [Finding(
+            checker="metrics" if "metric" in block else "knobs",
+            rule="docs_drift", path="README.md",
+            line=text[:i].count("\n") + 1, symbol=block,
+            message=f"generated {block} block is stale — run "
+                    "tools/tts_lint.py --write-docs")]
+    return []

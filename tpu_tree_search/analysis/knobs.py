@@ -1,0 +1,207 @@
+"""Knob-registry checker: every TTS_* env knob is single-sourced.
+
+``utils/config.py`` owns the knob registry (``config.KNOBS``) and the
+typed accessors (``env_flag`` / ``env_str`` / ``env_int`` /
+``env_float`` / ``env_ints`` / ``set_env``). This checker enforces the
+single-sourcing three ways:
+
+- **scattered_env_read / scattered_env_write** — any raw
+  ``os.environ`` / ``os.getenv`` access of a ``TTS_*`` literal outside
+  ``utils/config.py`` is a finding. (The two legitimate exceptions in
+  the tree — reads that must happen BEFORE the package, and therefore
+  jax, can be imported — carry explicit waivers.)
+- **unregistered_knob** — a ``TTS_*`` name used at any accessor or raw
+  site that has no ``config.KNOBS`` row. The accessors also refuse
+  these at runtime; the checker catches the ones runtime never reaches.
+- **unreferenced_knob / knob_undocumented** — registry rows no code
+  references (dead knobs drift into lies) and rows README never
+  mentions (the generated registry table normally satisfies this —
+  see :mod:`docs`).
+
+Constant indirection is resolved: ``AOT_CACHE_ENV = "TTS_AOT_CACHE"``
+in config (or ``ENV_FLAG = ...`` in telemetry) makes
+``env_str(cfg.AOT_CACHE_ENV)`` count as a reference to the underlying
+knob.
+
+The registry-side rules run only when the scanned root IS this repo
+(it contains ``tpu_tree_search/utils/config.py``); fixture trees in
+tests exercise just the site-side rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, parse_many, repo_root
+
+__all__ = ["check", "KNOB_DIRS"]
+
+KNOB_DIRS = ("tpu_tree_search", "tools", "tests", "bench.py",
+             "__graft_entry__.py")
+
+_ACCESSORS = {"env_flag", "env_str", "env_int", "env_float", "env_ints",
+              "set_env"}
+_KNOB_RE = re.compile(r"^TTS_[A-Z0-9_]+$")
+_CONFIG_REL = "tpu_tree_search/utils/config.py"
+_ANALYSIS_PREFIX = "tpu_tree_search/analysis/"
+
+
+def _dotted(expr) -> str:
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    elif isinstance(expr, ast.Call):
+        parts.append("()")
+    return ".".join(reversed(parts))
+
+
+def _literal_knob(expr) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+            and _KNOB_RE.match(expr.value):
+        return expr.value
+    return None
+
+
+def check(root=None) -> list:
+    root = repo_root(root)
+    sources, findings = parse_many(root, KNOB_DIRS)
+    out: list = list(findings)
+
+    # ---- constant indirection: NAME = "TTS_X" at module/class level
+    const_map: dict = {}
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and \
+                    _literal_knob(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        const_map[t.id] = node.value.value
+
+    def resolve_name(expr) -> str | None:
+        lit = _literal_knob(expr)
+        if lit:
+            return lit
+        if isinstance(expr, ast.Name):
+            return const_map.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return const_map.get(expr.attr)
+        return None
+
+    referenced: set = set()
+
+    for src in sources:
+        in_config = src.rel == _CONFIG_REL
+        if src.rel.startswith(_ANALYSIS_PREFIX):
+            continue          # the linter's own pattern tables
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call):
+                fd = _dotted(node.func)
+                tail = fd.split(".")[-1]
+                if tail in ("get", "getenv", "pop", "setdefault") and \
+                        ("environ" in fd or tail == "getenv"):
+                    knob = resolve_name(node.args[0]) if node.args \
+                        else None
+                    if knob:
+                        referenced.add(knob)
+                        if not in_config:
+                            # pop/setdefault MUTATE the environment —
+                            # misfiling them as reads would point the
+                            # fix at the read accessors (and stamp the
+                            # wrong rule into the waiver fingerprint)
+                            write = tail in ("pop", "setdefault")
+                            remedy = ("config.set_env (tests: "
+                                      "monkeypatch.setenv/delenv)"
+                                      if write
+                                      else "the config env_* accessors")
+                            out.append(Finding(
+                                checker="knobs",
+                                rule=("scattered_env_write" if write
+                                      else "scattered_env_read"),
+                                path=src.rel, line=node.lineno,
+                                symbol=knob,
+                                message=f"raw {fd}({knob!r}) outside "
+                                        f"utils/config.py — use "
+                                        f"{remedy}"))
+                elif tail in _ACCESSORS:
+                    knob = resolve_name(node.args[0]) if node.args \
+                        else None
+                    if knob:
+                        referenced.add(knob)
+            elif isinstance(node, ast.Subscript):
+                if not _dotted(node.value).endswith("environ"):
+                    continue
+                knob = resolve_name(node.slice)
+                if not knob:
+                    continue
+                referenced.add(knob)
+                if in_config:
+                    continue
+                write = isinstance(node.ctx, (ast.Store, ast.Del))
+                out.append(Finding(
+                    checker="knobs",
+                    rule=("scattered_env_write" if write
+                          else "scattered_env_read"),
+                    path=src.rel, line=node.lineno, symbol=knob,
+                    message=(f"raw os.environ[{knob!r}] "
+                             f"{'write' if write else 'read'} outside "
+                             "utils/config.py — use config.set_env / "
+                             "the env_* accessors")))
+
+    # every knob literal seen ANYWHERE (incl. const defs) counts as a
+    # reference for the dead-knob rule. Registration is only REQUIRED
+    # for names seen outside tests/ — the linter's own test fixtures
+    # use synthetic TTS_* names on purpose (and a test typo'ing a real
+    # knob still fails at runtime: the accessors refuse unregistered
+    # names).
+    required: set = set()
+    for src in sources:
+        if src.rel.startswith(_ANALYSIS_PREFIX):
+            continue
+        for node in ast.walk(src.tree):
+            lit = _literal_knob(node) if isinstance(node, ast.Constant) \
+                else None
+            if lit:
+                referenced.add(lit)
+                if not src.rel.startswith("tests/"):
+                    required.add(lit)
+
+    # ---- registry-side rules (real repo only)
+    if not (root / _CONFIG_REL).exists():
+        return out
+    from ..utils.config import KNOBS
+    for knob in sorted(required):
+        if knob not in KNOBS:
+            # anchor to the first site that used it
+            site = next((f for f in out if f.symbol == knob), None)
+            out.append(Finding(
+                checker="knobs", rule="unregistered_knob",
+                path=site.path if site else _CONFIG_REL,
+                line=site.line if site else 0, symbol=knob,
+                message=f"{knob} is used but has no config.KNOBS row "
+                        "(every knob needs a registered default + doc "
+                        "line)"))
+    for knob in sorted(set(KNOBS) - referenced):
+        out.append(Finding(
+            checker="knobs", rule="unreferenced_knob",
+            path=_CONFIG_REL, line=0, symbol=knob,
+            message=f"config.KNOBS registers {knob} but no code "
+                    "references it — dead registry rows drift into "
+                    "lies; delete the row or wire the knob"))
+    readme = root / "README.md"
+    if readme.exists():
+        text = readme.read_text(encoding="utf-8")
+        for knob in sorted(KNOBS):
+            if knob not in text:
+                out.append(Finding(
+                    checker="knobs", rule="knob_undocumented",
+                    path="README.md", line=0, symbol=knob,
+                    message=f"registered knob {knob} is not mentioned "
+                            "in README.md (regenerate the registry "
+                            "table: tools/tts_lint.py --write-docs)"))
+    from . import docs
+    out.extend(docs.check_block(root, "tts-knob-registry"))
+    return out

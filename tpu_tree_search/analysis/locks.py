@@ -1,0 +1,477 @@
+"""Lock-discipline checker: guarded-attribute annotations + a
+lock-acquisition-order graph.
+
+The threaded classes (AOTCache, ExecutorCache, TuningCache,
+IncumbentBoard, the metrics Registry, TraceLog, HealthMonitor, the
+async checkpoint writer) each learned their race fixes the hard way in
+review passes. This checker makes the resulting discipline declarative:
+
+**Annotation grammar** (trailing comments — they survive formatting and
+need no runtime import):
+
+- ``self._best = {}   # guarded-by: self._lock`` — declares the
+  attribute guarded: every MUTATION of it anywhere in the class must
+  sit lexically inside ``with self._lock:`` (or in a method annotated
+  as holding it). Reads are not checked — the repo's snapshot-read
+  idiom is deliberate.
+- ``_FINDINGS = deque()   # guarded-by: _LOCK`` — same, for
+  module-level shared state.
+- ``def _rotate_locked(self):   # holds: self._lock`` — declares a
+  helper only ever called with the lock held; its mutations count as
+  guarded and lock acquisitions inside it order AFTER the held lock.
+- ``__init__`` is exempt (the object is not yet shared).
+
+**Lock-order graph**: every ``with <lock>`` acquisition nested (again
+lexically, plus one call-resolution hop computed to fixpoint over the
+repo-local call graph) inside another lock's scope adds an edge
+``outer -> inner``; locks are identified class-granularly
+(``ClassName.attr`` / ``module:NAME``). A cycle in that graph is a
+potential deadlock ordering and is reported as one finding per strongly
+connected component. Class-granular identity can alias distinct
+instances (two metrics' ``_lock`` are different objects) — that is the
+usual static-analysis over-approximation; waive such a finding with the
+aliasing argument written down.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, parse_many
+
+__all__ = ["check", "LOCK_DIRS"]
+
+LOCK_DIRS = ("tpu_tree_search/service", "tpu_tree_search/obs",
+             "tpu_tree_search/tune", "tpu_tree_search/engine/checkpoint.py",
+             "tpu_tree_search/engine/incumbent.py")
+
+_MUTATORS = {"append", "appendleft", "add", "clear", "discard", "extend",
+             "insert", "pop", "popleft", "popitem", "remove",
+             "setdefault", "update", "sort", "reverse"}
+
+_GUARD_TAG = "guarded-by:"
+_HOLDS_TAG = "holds:"
+
+
+def _unparse(expr) -> str:
+    try:
+        return ast.unparse(expr)
+    except Exception:  # noqa: BLE001 — display-only
+        return "<expr>"
+
+
+def _tag_value(comment: str, tag: str) -> str | None:
+    if tag not in comment:
+        return None
+    return comment.split(tag, 1)[1].strip().split()[0].rstrip(",;")
+
+
+def _stmt_comment(src, node) -> str:
+    end = getattr(node, "end_lineno", node.lineno)
+    for line in range(node.lineno, end + 1):
+        c = src.comment_at(line)
+        if c:
+            return c
+    return ""
+
+
+class _Class:
+    def __init__(self, name: str, node: ast.ClassDef, src):
+        self.name = name
+        self.node = node
+        self.src = src
+        self.guarded: dict = {}     # attr -> lock expr string
+        self.methods: dict = {}     # name -> FunctionDef
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[item.name] = item
+        # guarded-by annotations anywhere in the class body
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            guard = _tag_value(_stmt_comment(src, stmt), _GUARD_TAG)
+            if not guard:
+                continue
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    self.guarded[t.attr] = guard
+
+
+def _method_holds(src, fn) -> set:
+    """`# holds:` annotations for a function: the line above the def,
+    any line of the (possibly multi-line) signature, or a standalone
+    comment line between the header and the first body statement."""
+    held = set()
+    first_body = fn.body[0].lineno if fn.body else fn.lineno + 1
+    for line in range(fn.lineno - 1, first_body):
+        v = _tag_value(src.comment_at(line), _HOLDS_TAG)
+        if v:
+            held.add(v)
+    return held
+
+
+def _self_attr(expr):
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def _mutations(node):
+    """Yield (attr_or_name, line, kind, selfish) mutations at `node`
+    (one AST statement/expression level, not recursive). `selfish`
+    distinguishes `self.X` mutations (class-attribute discipline) from
+    bare-name mutations (module-level state discipline) — a local
+    variable that happens to share a guarded attribute's name must not
+    trip the class check."""
+    if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr:
+                yield attr, node.lineno, "assign", True
+            elif isinstance(base, ast.Name) and base is not t:
+                # NAME[...] = v  (container store through a bare name)
+                yield base.id, node.lineno, "assign", False
+    elif isinstance(node, ast.Delete):
+        for t in node.targets:
+            base = t
+            while isinstance(base, ast.Subscript):
+                base = base.value
+            attr = _self_attr(base)
+            if attr:
+                yield attr, node.lineno, "delete", True
+            elif isinstance(base, ast.Name):
+                yield base.id, node.lineno, "delete", False
+    elif isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _MUTATORS:
+        recv = node.func.value
+        attr = _self_attr(recv)
+        if attr:
+            yield attr, node.lineno, f".{node.func.attr}()", True
+        elif isinstance(recv, ast.Name):
+            yield recv.id, node.lineno, f".{node.func.attr}()", False
+
+
+def _walk_with_locks(fn, base_held: frozenset, visit):
+    """Depth-first walk calling visit(node, held_lock_strings) on every
+    node; `with X:` scopes extend the held set for their bodies."""
+
+    def go(node, held):
+        visit(node, held)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = held | {_unparse(i.context_expr)
+                            for i in node.items}
+            for i in node.items:
+                go(i.context_expr, held)
+            for child in node.body:
+                go(child, inner)
+            return
+        for child in ast.iter_child_nodes(node):
+            go(child, held)
+
+    for stmt in fn.body:
+        go(stmt, frozenset(base_held))
+
+
+# ------------------------------------------------------------ the checker
+
+
+def check(root=None) -> list:
+    sources, findings = parse_many(root, LOCK_DIRS)
+    out: list = list(findings)
+
+    classes: list = []          # (_Class, src)
+    module_guarded: dict = {}   # (rel, name) -> lock str
+    module_locks: dict = {}     # per rel: {name} of module-level locks
+    for src in sources:
+        locks_here = set()
+        for stmt in src.tree.body:
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    _unparse(stmt.value.func).split(".")[-1] in (
+                        "Lock", "RLock", "Condition", "Semaphore"):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        locks_here.add(t.id)
+            guard = _tag_value(_stmt_comment(src, stmt), _GUARD_TAG) \
+                if isinstance(stmt, (ast.Assign, ast.AnnAssign)) else None
+            if guard and isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                           else [stmt.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        module_guarded[(src.rel, t.id)] = guard
+        module_locks[src.rel] = locks_here
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.append(_Class(node.name, node, src))
+
+    # ---- guarded-mutation verification (classes)
+    for cls in classes:
+        if not cls.guarded:
+            continue
+        for mname, fn in cls.methods.items():
+            if mname == "__init__":
+                continue
+            held0 = _method_holds(cls.src, fn)
+
+            def visit(node, held, _cls=cls, _m=mname):
+                for attr, line, kind, selfish in _mutations(node):
+                    if not selfish:
+                        continue   # bare local names shadow attr names
+                    lock = _cls.guarded.get(attr)
+                    if lock is None:
+                        continue
+                    if lock in held:
+                        continue
+                    out.append(Finding(
+                        checker="locks", rule="unguarded_mutation",
+                        path=_cls.src.rel, line=line,
+                        symbol=f"{_cls.name}.{attr}@{_m}",
+                        message=f"mutation ({kind}) of "
+                                f"self.{attr} in {_cls.name}.{_m} "
+                                f"outside 'with {lock}' (declared "
+                                f"guarded-by {lock})"))
+
+            _walk_with_locks(fn, frozenset(held0), visit)
+
+    # ---- guarded-mutation verification (module-level state)
+    for src in sources:
+        names = {n for (rel, n) in module_guarded if rel == src.rel}
+        if not names:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            held0 = _method_holds(src, node)
+
+            def visit(n, held, _src=src, _fn=node, _names=names):
+                for name, line, kind, selfish in _mutations(n):
+                    if selfish or name not in _names:
+                        continue
+                    lock = module_guarded[(_src.rel, name)]
+                    if lock in held:
+                        continue
+                    out.append(Finding(
+                        checker="locks", rule="unguarded_mutation",
+                        path=_src.rel, line=line,
+                        symbol=f"{name}@{_fn.name}",
+                        message=f"mutation ({kind}) of module-level "
+                                f"{name} in {_fn.name}() outside "
+                                f"'with {lock}' (declared guarded-by "
+                                f"{lock})"))
+
+            _walk_with_locks(node, frozenset(held0), visit)
+
+    # ---- lock-order graph
+    out.extend(_lock_order(sources, classes, module_locks))
+    return out
+
+
+# ----------------------------------------------------- acquisition order
+
+
+def _lock_id(expr_str: str, cls_name: str | None, rel: str,
+             module_locks: dict) -> str | None:
+    """Normalize a with-expression to a lock node id, or None when it
+    is not a known lock."""
+    if expr_str.startswith("self.") and cls_name:
+        return f"{cls_name}.{expr_str[5:]}"
+    if expr_str in module_locks.get(rel, ()):
+        mod = rel.rsplit("/", 1)[-1]
+        return f"{mod}:{expr_str}"
+    return None
+
+
+def _lock_order(sources, classes, module_locks) -> list:
+    # function registry: (rel, qualname) -> (fn node, cls or None, src)
+    funcs: dict = {}
+    by_bare: dict = {}         # bare name -> [(rel, qual)]
+    by_method: dict = {}       # method name -> [(rel, qual)]
+    cls_of: dict = {}
+    for src in sources:
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (src.rel, node.name)
+                funcs[key] = (node, None, src)
+                by_bare.setdefault(node.name, []).append(key)
+    for cls in classes:
+        for mname, fn in cls.methods.items():
+            key = (cls.src.rel, f"{cls.name}.{mname}")
+            funcs[key] = (fn, cls, cls.src)
+            by_method.setdefault(mname, []).append(key)
+            cls_of[key] = cls
+
+    def resolve_call(call, cls, src) -> list:
+        func = call.func
+        if isinstance(func, ast.Name):
+            # bare name: same module first, else unique across repo
+            same = [(r, q) for (r, q) in by_bare.get(func.id, ())
+                    if r == src.rel]
+            if same:
+                return same
+            allb = by_bare.get(func.id, [])
+            return allb if len(allb) == 1 else []
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == "self" and cls is not None:
+                key = (src.rel, f"{cls.name}.{func.attr}")
+                return [key] if key in funcs else []
+            # module alias: resolve a top-level function in that module
+            if isinstance(func.value, ast.Name):
+                cand = [(r, q) for (r, q) in by_bare.get(func.attr, ())
+                        if r.rsplit("/", 1)[-1].startswith(
+                            func.value.id + ".")]
+                if len(cand) == 1:
+                    return cand
+            # unique method name across analyzed classes
+            meths = by_method.get(func.attr, [])
+            return meths if len(meths) == 1 else []
+        return []
+
+    # direct acquisitions + call lists per function
+    direct: dict = {k: set() for k in funcs}
+    calls: dict = {k: [] for k in funcs}
+    for key, (fn, cls, src) in funcs.items():
+        cls_name = cls.name if cls else None
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _lock_id(_unparse(item.context_expr), cls_name,
+                                   src.rel, module_locks)
+                    if lid:
+                        direct[key].add(lid)
+            elif isinstance(node, ast.Call):
+                calls[key].append(node)
+
+    # fixpoint: may-acquire set per function through repo-local calls
+    acq = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, (fn, cls, src) in funcs.items():
+            for call in calls[key]:
+                for tgt in resolve_call(call, cls, src):
+                    extra = acq.get(tgt, set()) - acq[key]
+                    if extra:
+                        acq[key] |= extra
+                        changed = True
+
+    # edges: for every with-lock scope, inner acquisitions (lexical
+    # with + calls inside the body, transitively) order after it
+    edges: dict = {}
+
+    def note_edge(a, b, rel, line):
+        if a != b:
+            edges.setdefault((a, b), (rel, line))
+
+    for key, (fn, cls, src) in funcs.items():
+        cls_name = cls.name if cls else None
+        held0 = set()
+        for h in _method_holds(src, fn):
+            lid = _lock_id(h, cls_name, src.rel, module_locks)
+            if lid:
+                held0.add(lid)
+
+        def visit(node, held, _cls=cls_name, _src=src):
+            ids = set()
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    lid = _lock_id(_unparse(item.context_expr), _cls,
+                                   _src.rel, module_locks)
+                    if lid:
+                        ids.add(lid)
+            elif isinstance(node, ast.Call):
+                for tgt in resolve_call(node, cls_of.get(key), _src):
+                    ids |= acq.get(tgt, set())
+            for h in held:
+                hid = _lock_id(h, _cls, _src.rel, module_locks)
+                if hid:
+                    for lid in ids:
+                        note_edge(hid, lid, _src.rel, node.lineno)
+            for hid in held0:
+                for lid in ids:
+                    note_edge(hid, lid, _src.rel, node.lineno)
+
+        _walk_with_locks(fn, frozenset(), visit)
+
+    # cycle detection (iterative Tarjan SCC)
+    graph: dict = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+        graph.setdefault(b, set())
+    index: dict = {}
+    low: dict = {}
+    on_stack: set = set()
+    stack: list = []
+    sccs: list = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(sorted(graph.get(v, ()))))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on_stack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(graph.get(w, ())))))
+                    advanced = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+
+    for v in sorted(graph):
+        if v not in index:
+            strongconnect(v)
+
+    out = []
+    for comp in sccs:
+        cyclic = len(comp) > 1 or (comp[0] in graph.get(comp[0], ()))
+        if not cyclic:
+            continue
+        nodes = sorted(comp)
+        witness = [f"{a} -> {b} ({edges[(a, b)][0]}:{edges[(a, b)][1]})"
+                   for (a, b) in sorted(edges)
+                   if a in comp and b in comp]
+        out.append(Finding(
+            checker="locks", rule="lock_cycle",
+            path=edges[next((e for e in sorted(edges)
+                             if e[0] in comp and e[1] in comp))][0],
+            line=0, symbol="<->".join(nodes),
+            message="lock-acquisition-order cycle between "
+                    f"{', '.join(nodes)}: " + "; ".join(witness)))
+    return out

@@ -1,0 +1,164 @@
+"""Metric-registry checker: ``tts_*`` metric names cannot drift.
+
+``obs/metric_names.REGISTRY`` is the one checked-in table of every
+series the stack emits. This checker reconciles it against the code:
+
+- **unregistered_metric** — a literal ``tts_*`` name at an emit site
+  (``counter()`` / ``gauge()`` / ``histogram()``) or a reference site
+  (``gauge_samples()`` / ``remove_matching()``, the health rules' and
+  aggregator's read paths) with no registry row. Constant indirection
+  (``DROPPED = "tts_metrics_dropped_total"``) is resolved.
+- **unemitted_metric** — a registry row with no emit site inside
+  ``tpu_tree_search/`` (dead rows are how a README table starts lying).
+- **kind_mismatch** — an emit site whose accessor (counter vs gauge vs
+  histogram) disagrees with the registered kind; the runtime Registry
+  raises on this too, but only when both sites actually execute in one
+  process — the lint catches it across processes and test gaps.
+
+Registry-side rules run only against this repo (fixture trees exercise
+the site-side rules).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, parse_many, repo_root
+
+__all__ = ["check", "METRIC_DIRS"]
+
+METRIC_DIRS = ("tpu_tree_search", "tools", "bench.py")
+
+_EMIT = {"counter": "counter", "gauge": "gauge", "histogram": "histogram"}
+_REFERENCE = {"gauge_samples", "remove_matching"}
+_NAME_RE = re.compile(r"^tts_[a-z0-9_]+$")
+_REGISTRY_REL = "tpu_tree_search/obs/metric_names.py"
+_ANALYSIS_PREFIX = "tpu_tree_search/analysis/"
+
+
+def _literal_metric(expr) -> str | None:
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str) \
+            and _NAME_RE.match(expr.value):
+        return expr.value
+    return None
+
+
+def check(root=None) -> list:
+    root = repo_root(root)
+    sources, findings = parse_many(root, METRIC_DIRS)
+    out: list = list(findings)
+
+    const_map: dict = {}
+    for src in sources:
+        if src.rel == _REGISTRY_REL:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and _literal_metric(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        const_map[t.id] = node.value.value
+                    elif isinstance(t, ast.Attribute):
+                        const_map[t.attr] = node.value.value
+
+    def resolve(expr) -> str | None:
+        lit = _literal_metric(expr)
+        if lit:
+            return lit
+        if isinstance(expr, ast.Name):
+            return const_map.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            return const_map.get(expr.attr)
+        return None
+
+    emit_sites: list = []     # (name, kind, src, line, in_package)
+    ref_sites: list = []
+    mentions: set = set()     # literal tts_* names anywhere in the pkg
+    for src in sources:
+        if src.rel == _REGISTRY_REL or \
+                src.rel.startswith(_ANALYSIS_PREFIX):
+            continue
+        in_pkg = src.rel.startswith("tpu_tree_search/")
+        # local aliases of the emit accessors (`g = registry.gauge`)
+        aliases: dict = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr in _EMIT:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases[t.id] = _EMIT[node.value.attr]
+        for node in ast.walk(src.tree):
+            if in_pkg and isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str) and \
+                    _NAME_RE.match(node.value):
+                mentions.add(node.value)
+            if not (isinstance(node, ast.Call) and node.args):
+                continue
+            if isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+            elif isinstance(node.func, ast.Name) and \
+                    node.func.id in aliases:
+                attr = None
+                name = resolve(node.args[0])
+                if name:
+                    emit_sites.append((name, aliases[node.func.id],
+                                       src, node.lineno, in_pkg))
+                continue
+            else:
+                continue
+            if attr in _EMIT:
+                name = resolve(node.args[0])
+                if name:
+                    emit_sites.append((name, _EMIT[attr], src,
+                                       node.lineno, in_pkg))
+            elif attr in _REFERENCE:
+                name = resolve(node.args[0])
+                if name:
+                    ref_sites.append((name, src, node.lineno))
+
+    real_repo = (root / _REGISTRY_REL).exists()
+    if not real_repo:
+        # fixture tree: judge sites against an empty registry is wrong;
+        # only surface obviously malformed emissions (none detectable
+        # without a registry) — return parse findings only
+        return out
+    from ..obs.metric_names import REGISTRY
+
+    for name, kind, src, line, _ in emit_sites:
+        m = REGISTRY.get(name)
+        if m is None:
+            out.append(Finding(
+                checker="metrics", rule="unregistered_metric",
+                path=src.rel, line=line, symbol=name,
+                message=f"emit site for {name} has no "
+                        "obs/metric_names.REGISTRY row"))
+        elif m.kind != kind:
+            out.append(Finding(
+                checker="metrics", rule="kind_mismatch",
+                path=src.rel, line=line, symbol=name,
+                message=f"{name} emitted as {kind} but registered as "
+                        f"{m.kind}"))
+    for name, src, line in ref_sites:
+        if name not in REGISTRY:
+            out.append(Finding(
+                checker="metrics", rule="unregistered_metric",
+                path=src.rel, line=line, symbol=name,
+                message=f"reference site for {name} has no "
+                        "obs/metric_names.REGISTRY row (health rule / "
+                        "aggregator reading a series nobody emits?)"))
+    # the unemitted rule accepts any in-package MENTION as evidence of
+    # life: several emitters build names from tuples/dicts (telemetry's
+    # SERIES table) where the literal and the emit call are separated
+    emitted_in_pkg = {n for n, _, _, _, in_pkg in emit_sites if in_pkg}
+    emitted_in_pkg |= mentions
+    for name in sorted(set(REGISTRY) - emitted_in_pkg):
+        out.append(Finding(
+            checker="metrics", rule="unemitted_metric",
+            path=_REGISTRY_REL, line=0, symbol=name,
+            message=f"REGISTRY lists {name} but no emit site exists in "
+                    "tpu_tree_search/ — delete the row or restore the "
+                    "series"))
+    from . import docs
+    out.extend(docs.check_block(root, "tts-metric-registry"))
+    return out

@@ -1,0 +1,347 @@
+"""Trace-safety checker: host-sync and nondeterminism hazards inside
+traced code.
+
+The engine's hot loops are ``jit`` / ``shard_map`` programs built from
+``lax.{while_loop, cond, switch, scan}`` callables. Host-sync calls
+inside them (``.item()``, ``jax.device_get``, ``np.asarray`` on a traced
+value) either fail at trace time in the best case or silently serialize
+the device against the host in the worst; trace-time reads of ambient
+state (``time.time()``, ``os.environ`` / the config accessors) bake a
+value into the executable — a static flag read inside a traced function
+is a silent retrace-or-stale hazard (the executable keeps the value the
+FIRST trace saw; flipping the env var later does nothing, or worse,
+retraces mid-serve).
+
+Method: per analyzed module, index every function (including nested
+defs), mark TRACED ROOTS — functions passed to the jit family
+(``jit``/``pjit``/``vmap``/``pmap``/``shard_map``/``remat``), used as
+decorators from that family, or passed as callables to ``lax`` control
+flow — then walk the call graph (bare-name and imported-module
+resolution, repo-local only) and scan every reachable function for the
+hazard patterns. Lambdas passed to control flow are scanned in their
+enclosing function's context.
+
+Precision stance: ``np``/``float()``/``int()`` are ONLY flagged when
+applied directly to a parameter of the traced function (parameters are
+traced values by construction; np use on static shape math at trace
+time is idiomatic and fine). Everything here is best-effort static
+analysis — the waiver file exists for the rare justified exception, and
+the fixture tests pin both directions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, parse_many
+
+__all__ = ["check", "TRACED_DIRS"]
+
+# the subtrees whose jit entry points are the engine's compiled surface
+TRACED_DIRS = ("tpu_tree_search/engine", "tpu_tree_search/ops")
+
+_JIT_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map", "remat",
+                 "named_call", "custom_jvp", "custom_vjp"}
+_LAX_CTRL = {"cond", "switch", "scan", "while_loop", "fori_loop",
+             "associative_scan"}
+
+# host-sync calls by terminal attribute / bare name
+_HOST_SYNC_ATTRS = {"device_get", "block_until_ready", "copy_to_host_async"}
+_TIME_FNS = {"time", "monotonic", "perf_counter", "process_time",
+             "time_ns", "monotonic_ns", "perf_counter_ns"}
+_ENV_READERS = {"getenv", "env_flag", "env_str", "env_int", "env_float",
+                "env_ints"}
+_CASTS = {"float", "int", "bool", "complex"}
+_NP_MATERIALIZERS = {"asarray", "array", "ascontiguousarray"}
+
+
+def _terminal_attr(func) -> str | None:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _dotted(expr) -> str:
+    """Best-effort dotted rendering of a Name/Attribute chain."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    return ".".join(reversed(parts))
+
+
+class _ModuleIndex:
+    """Per-module function table + import map for repo-local call
+    resolution."""
+
+    def __init__(self, src, pkg_key: str):
+        self.src = src
+        self.key = pkg_key                 # dotted module key
+        self.functions: dict = {}          # qualname -> FunctionDef
+        self.by_name: dict = {}            # bare name -> [qualname]
+        self.import_alias: dict = {}       # local alias -> module key
+        self.from_func: dict = {}          # local name -> (mod key, name)
+        self._index()
+
+    def _index(self) -> None:
+        stack: list = []
+
+        def visit(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    self.functions[qual] = child
+                    self.by_name.setdefault(child.name, []).append(qual)
+                    stack.append(child.name)
+                    visit(child)
+                    stack.pop()
+                elif isinstance(child, ast.ClassDef):
+                    stack.append(child.name)
+                    visit(child)
+                    stack.pop()
+                else:
+                    visit(child)
+
+        visit(self.src.tree)
+        pkg_parts = self.key.split(".")
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.import_alias[a.asname or
+                                      a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:-node.level]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.from_func[local] = (mod, a.name)
+                    # `from . import device` style: the name is a module
+                    self.import_alias.setdefault(
+                        local, f"{mod}.{a.name}" if mod else a.name)
+
+
+def _module_key(rel: str) -> str:
+    return rel[:-3].replace("/", ".")      # strip .py
+
+
+def _func_args(fn) -> set:
+    a = fn.args
+    names = [p.arg for p in (a.posonlyargs + a.args + a.kwonlyargs)]
+    if a.vararg:
+        names.append(a.vararg.arg)
+    if a.kwarg:
+        names.append(a.kwarg.arg)
+    return set(names)
+
+
+def _callable_args(call: ast.Call) -> list:
+    """Expressions passed to a jit-family / lax-control call that may
+    be callables: names, attributes, lambdas, partial(...) first args,
+    list/tuple elements (switch branches)."""
+    out = []
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if isinstance(arg, (ast.Name, ast.Attribute, ast.Lambda)):
+            out.append(arg)
+        elif isinstance(arg, (ast.List, ast.Tuple)):
+            out.extend(e for e in arg.elts
+                       if isinstance(e, (ast.Name, ast.Attribute,
+                                         ast.Lambda)))
+        elif isinstance(arg, ast.Call) and \
+                _terminal_attr(arg.func) == "partial" and arg.args:
+            out.append(arg.args[0])
+    return out
+
+
+def _is_wrapper_call(call: ast.Call) -> bool:
+    name = _terminal_attr(call.func)
+    return name in _JIT_WRAPPERS or name in _LAX_CTRL
+
+
+def _resolve(expr, mod: _ModuleIndex, modules: dict) -> list:
+    """Resolve a callable expression to [(module, qualname)] within the
+    analyzed set. Best effort; unresolvable -> []."""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+        if name in mod.by_name:
+            return [(mod, q) for q in mod.by_name[name]]
+        if name in mod.from_func:
+            mkey, orig = mod.from_func[name]
+            target = modules.get(mkey)
+            if target and orig in target.by_name:
+                return [(target, q) for q in target.by_name[orig]]
+        return []
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name):
+            mkey = mod.import_alias.get(base.id)
+            target = modules.get(mkey) if mkey else None
+            if target and expr.attr in target.by_name:
+                return [(target, q) for q in target.by_name[expr.attr]]
+        return []
+    return []
+
+
+def _body_calls(fn):
+    """Call nodes in a function's own body, excluding nested defs (they
+    are separate call-graph nodes); lambda bodies stay included."""
+    skip: set = set()
+    for node in ast.walk(fn):
+        if node is not fn and isinstance(node, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+            skip.update(ast.walk(node))
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and node not in skip:
+            yield node
+
+
+def _lambda_sites(fn):
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Lambda):
+            yield node
+
+
+def check(root=None) -> list:
+    sources, findings = parse_many(root, TRACED_DIRS)
+    modules = {_module_key(s.rel): _ModuleIndex(s, _module_key(s.rel))
+               for s in sources}
+
+    # --- traced roots
+    roots: set = set()     # (module key, qualname)
+    for key, mod in modules.items():
+        # decorator roots
+        for qual, fn in mod.functions.items():
+            for dec in fn.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                if _terminal_attr(target) in _JIT_WRAPPERS:
+                    roots.add((key, qual))
+                elif isinstance(dec, ast.Call) and \
+                        _terminal_attr(dec.func) == "partial" and \
+                        dec.args and \
+                        _terminal_attr(dec.args[0]) in _JIT_WRAPPERS:
+                    roots.add((key, qual))
+        # call-site roots: jit(f), lax.while_loop(cond, body, ...)
+        for node in ast.walk(mod.src.tree):
+            if isinstance(node, ast.Call) and _is_wrapper_call(node):
+                for expr in _callable_args(node):
+                    if isinstance(expr, ast.Lambda):
+                        continue       # scanned with its enclosing fn
+                    for tgt_mod, qual in _resolve(expr, mod, modules):
+                        roots.add((tgt_mod.key, qual))
+
+    # --- reachability over repo-local calls
+    reachable: set = set()
+    work = sorted(roots)
+    while work:
+        key, qual = work.pop()
+        if (key, qual) in reachable:
+            continue
+        reachable.add((key, qual))
+        mod = modules[key]
+        fn = mod.functions.get(qual)
+        if fn is None:
+            continue
+        for call in _body_calls(fn):
+            for tgt_mod, tgt_qual in _resolve(call.func, mod, modules):
+                if (tgt_mod.key, tgt_qual) not in reachable:
+                    work.append((tgt_mod.key, tgt_qual))
+            # partial(f, ...) built inside traced code: f executes in
+            # the trace when the partial is invoked
+            if _terminal_attr(call.func) == "partial" and call.args:
+                for tgt_mod, tgt_qual in _resolve(call.args[0], mod,
+                                                  modules):
+                    if (tgt_mod.key, tgt_qual) not in reachable:
+                        work.append((tgt_mod.key, tgt_qual))
+            # callables handed onward to nested control flow
+            if _is_wrapper_call(call):
+                for expr in _callable_args(call):
+                    if isinstance(expr, ast.Lambda):
+                        continue
+                    for tgt_mod, tgt_qual in _resolve(expr, mod,
+                                                      modules):
+                        if (tgt_mod.key, tgt_qual) not in reachable:
+                            work.append((tgt_mod.key, tgt_qual))
+
+    # --- hazard scan
+    seen_fp: set = set()
+
+    def emit(mod, qual, token, rule, line, what):
+        f = Finding(checker="trace_safety", rule=rule, path=mod.src.rel,
+                    line=line, symbol=f"{qual}:{token}",
+                    message=f"{what} inside traced function {qual!r}")
+        if f.fingerprint() not in seen_fp:
+            seen_fp.add(f.fingerprint())
+            out.append(f)
+
+    out: list = []
+    for key, qual in sorted(reachable):
+        mod = modules[key]
+        fn = mod.functions.get(qual)
+        if fn is None:
+            continue
+        params = _func_args(fn)
+        for lam in _lambda_sites(fn):
+            params |= _func_args(lam)
+        for call in _body_calls(fn):
+            name = _terminal_attr(call.func)
+            dotted = _dotted(call.func)
+            base = dotted.split(".")[0] if dotted else ""
+            if name == "item" and isinstance(call.func, ast.Attribute):
+                emit(mod, qual, "item", "host_sync", call.lineno,
+                     ".item() (device->host sync)")
+            elif name in _HOST_SYNC_ATTRS:
+                emit(mod, qual, name, "host_sync", call.lineno,
+                     f"{dotted}() (device->host sync)")
+            elif base in ("time",) and name in _TIME_FNS:
+                emit(mod, qual, f"time.{name}", "nondeterminism",
+                     call.lineno,
+                     f"{dotted}() (trace-time clock read bakes a "
+                     "constant into the executable)")
+            elif base in ("random",) or dotted.startswith("np.random") \
+                    or dotted.startswith("numpy.random"):
+                emit(mod, qual, dotted or "random", "nondeterminism",
+                     call.lineno,
+                     f"{dotted}() (trace-time randomness: every trace "
+                     "bakes a different program)")
+            elif name in _ENV_READERS or dotted.endswith("environ.get"):
+                emit(mod, qual, name or dotted, "env_read", call.lineno,
+                     f"{dotted}() (static flag read in traced code: "
+                     "silent retrace/stale-value hazard — read it at "
+                     "state init and pass the value in)")
+            elif name in _CASTS and isinstance(call.func, ast.Name) \
+                    and len(call.args) == 1 \
+                    and isinstance(call.args[0], ast.Name) \
+                    and call.args[0].id in params:
+                emit(mod, qual, f"{name}({call.args[0].id})",
+                     "host_sync", call.lineno,
+                     f"{name}() applied to traced parameter "
+                     f"{call.args[0].id!r} (forces a concrete value)")
+            elif base in ("np", "numpy") and name in _NP_MATERIALIZERS \
+                    and call.args \
+                    and isinstance(call.args[0], ast.Name) \
+                    and call.args[0].id in params:
+                emit(mod, qual, f"np.{name}({call.args[0].id})",
+                     "host_sync", call.lineno,
+                     f"{dotted}() on traced parameter "
+                     f"{call.args[0].id!r} (materializes on host)")
+        # env reads via subscript: os.environ["TTS_X"]
+        skip: set = set()
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(node, (ast.FunctionDef,
+                                                    ast.AsyncFunctionDef)):
+                skip.update(ast.walk(node))
+        for node in ast.walk(fn):
+            if node in skip or not isinstance(node, ast.Subscript):
+                continue
+            if _dotted(node.value).endswith("environ"):
+                emit(mod, qual, "os.environ[]", "env_read", node.lineno,
+                     "os.environ[...] (static flag read in traced code)")
+    return findings + out

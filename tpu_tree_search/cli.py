@@ -118,15 +118,18 @@ def _serve_parser(sub):
                         "files; results land beside them as "
                         "<id>.res.json (see service/spool.py for the "
                         "payload schema)")
-    p.add_argument("--submeshes", type=int, default=1,
+    p.add_argument("--submeshes", type=int,
+                   default=_cfg.env_int("TTS_SUBMESHES"),
                    help="partition the device mesh into this many equal "
                         "submeshes, one concurrent request each "
-                        "(must divide the device count)")
+                        "(must divide the device count; TTS_SUBMESHES "
+                        "sets the default — the campaign respawn "
+                        "channel)")
     p.add_argument("--workdir", type=str, default=None,
                    help="checkpoint directory for preempted/deadline "
                         "requests (default: a fresh temp dir)")
     p.add_argument("--queue-depth", type=int,
-                   default=_cfg.SERVICE_QUEUE_DEPTH_DEFAULT,
+                   default=_cfg.env_int("TTS_QUEUE_DEPTH"),
                    help="admission bound: requests beyond this are "
                         "rejected with a reason, not buffered")
     p.add_argument("--segment-iters", type=int,
@@ -282,17 +285,17 @@ def run_serve(args) -> int:
 
     if args.search_telemetry:
         # static compile-in flag, read at each request's state init
-        os.environ["TTS_SEARCH_TELEMETRY"] = "1"
+        _cfg.set_env("TTS_SEARCH_TELEMETRY", "1")
     if args.overlap:
         # env too, not just the server knob: campaign-style respawns
         # and in-process tools must see the same static flag
-        os.environ["TTS_OVERLAP"] = "1"
+        _cfg.set_env("TTS_OVERLAP", "1")
     if args.share_incumbent:
-        os.environ["TTS_SHARE_INCUMBENT"] = "1"
+        _cfg.set_env("TTS_SHARE_INCUMBENT", "1")
     if args.ladder:
         # static flag: every engine entry (serve dispatches, prewarm's
         # rung warms, in-process tools) must see the same ladder mode
-        os.environ[_cfg.LADDER_FLAG] = "1"
+        _cfg.set_env(_cfg.LADDER_FLAG, "1")
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -335,7 +338,7 @@ def run_serve(args) -> int:
                       "/status /trace /alerts /dashboard; "
                       "POST /submit /cancel /profile?duration_s=N",
                       flush=True)
-            env_spec = os.environ.get(_cfg.PREWARM_ENV) or None
+            env_spec = _cfg.env_str(_cfg.PREWARM_ENV)
             prewarm_spec = (args.prewarm if args.prewarm is not None
                             else env_spec)
             if env_spec is not None and env_spec.strip().lower() in (
@@ -586,14 +589,15 @@ def run_pfsp(args) -> int:
     # resilience knobs travel as env so every run_segmented in the call
     # tree (direct, distributed.search's, a respawned campaign worker's)
     # sees the same policy
+    from .utils import config as _cfg
     if getattr(args, "retry_attempts", None) is not None:
-        os.environ["TTS_RETRY_ATTEMPTS"] = str(args.retry_attempts)
+        _cfg.set_env("TTS_RETRY_ATTEMPTS", args.retry_attempts)
     if getattr(args, "segment_timeout", None) is not None:
-        os.environ["TTS_SEG_TIMEOUT_S"] = str(args.segment_timeout)
+        _cfg.set_env("TTS_SEG_TIMEOUT_S", args.segment_timeout)
     if getattr(args, "search_telemetry", False):
         # env, not a Python knob: init_state reads it at state
         # creation, and respawned campaign workers must inherit it
-        os.environ["TTS_SEARCH_TELEMETRY"] = "1"
+        _cfg.set_env("TTS_SEARCH_TELEMETRY", "1")
     if getattr(args, "faults", None):
         from .utils import faults
         faults.configure(args.faults)

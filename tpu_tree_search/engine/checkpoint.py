@@ -377,17 +377,28 @@ class AsyncCheckpointWriter:
                  max_pending: int | None = None):
         from ..utils import config as _cfg
         if retry_attempts is None:
-            retry_attempts = int(os.environ.get(
-                "TTS_RETRY_ATTEMPTS", _cfg.RETRY_ATTEMPTS_DEFAULT))
+            retry_attempts = _cfg.env_int("TTS_RETRY_ATTEMPTS")
         if retry_base_s is None:
-            retry_base_s = float(os.environ.get(
-                "TTS_RETRY_BASE_S", _cfg.RETRY_BASE_S_DEFAULT))
+            retry_base_s = _cfg.env_float("TTS_RETRY_BASE_S")
         self.retry_attempts = retry_attempts
         self.retry_base_s = retry_base_s
         self._q: queue.Queue = queue.Queue(
             maxsize=max_pending or _cfg.ASYNC_CKPT_QUEUE_DEPTH)
-        self._err: BaseException | None = None
-        self._closed = False
+        # the AOTCache close discipline, with TWO locks on purpose:
+        # _close_lock makes the closed-check + enqueue atomic against
+        # close() (a task slipped in AFTER the shutdown sentinel would
+        # never run its task_done, hanging a later drain) — the writer
+        # thread NEVER takes it, so a submit blocked on the bounded
+        # queue while holding it still drains; _err_lock serializes the
+        # error hand-off between the writer and the submitting side. A
+        # single shared lock would deadlock: a producer holding it
+        # while blocked in the full queue's put() and the writer's
+        # error path wanting it before task_done() is an ABBA cycle
+        # between the lock and the queue capacity.
+        self._close_lock = threading.Lock()
+        self._err_lock = threading.Lock()
+        self._err: BaseException | None = None   # guarded-by: self._err_lock
+        self._closed = False                     # guarded-by: self._close_lock
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="tts-ckpt-writer")
         self._thread.start()
@@ -419,9 +430,10 @@ class AsyncCheckpointWriter:
         self._raise_pending()
         if task is None:
             return
-        if self._closed:
-            raise RuntimeError("AsyncCheckpointWriter is closed")
-        self._q.put(task)
+        with self._close_lock:
+            if self._closed:
+                raise RuntimeError("AsyncCheckpointWriter is closed")
+            self._q.put(task)
 
     def submit(self, path, state: SearchState, meta: dict | None = None,
                segment: int | None = None) -> None:
@@ -439,16 +451,20 @@ class AsyncCheckpointWriter:
         """Drain, stop the thread, optionally surface pending errors
         (False on exception-unwind paths, where masking the original
         error with a writer error would hide the root cause)."""
-        if not self._closed:
-            self._closed = True
-            self._q.put(None)
+        with self._close_lock:
+            was_closed = self._closed
+            if not was_closed:
+                self._closed = True
+                self._q.put(None)
+        if not was_closed:
             self._thread.join()
         if raise_pending:
             self._raise_pending()
 
     def _raise_pending(self) -> None:
-        if self._err is not None:
+        with self._err_lock:
             err, self._err = self._err, None
+        if err is not None:
             raise err
 
     # ---------------------------------------------------- writer thread
@@ -461,8 +477,9 @@ class AsyncCheckpointWriter:
                     return
                 self._write_one(task)
             except BaseException as e:  # noqa: BLE001 — surfaced at the
-                if self._err is None:   # next enqueue()/drain()
-                    self._err = e
+                with self._err_lock:    # next enqueue()/drain()
+                    if self._err is None:
+                        self._err = e
             finally:
                 self._q.task_done()
 
@@ -1062,14 +1079,11 @@ def run_segmented(run_fn, state: SearchState, segment_iters: int = 2048,
     """
     from ..utils import config as _cfg
     if retry_attempts is None:
-        retry_attempts = int(os.environ.get(
-            "TTS_RETRY_ATTEMPTS", _cfg.RETRY_ATTEMPTS_DEFAULT))
+        retry_attempts = _cfg.env_int("TTS_RETRY_ATTEMPTS")
     if retry_base_s is None:
-        retry_base_s = float(os.environ.get(
-            "TTS_RETRY_BASE_S", _cfg.RETRY_BASE_S_DEFAULT))
+        retry_base_s = _cfg.env_float("TTS_RETRY_BASE_S")
     if segment_timeout_s is None:
-        segment_timeout_s = float(os.environ.get(
-            "TTS_SEG_TIMEOUT_S", _cfg.SEGMENT_TIMEOUT_S_DEFAULT))
+        segment_timeout_s = _cfg.env_float("TTS_SEG_TIMEOUT_S")
     import jax
     if jax.process_count() > 1:
         # Multi-controller: run_fn, save and the scalar fetch all

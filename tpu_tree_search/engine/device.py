@@ -39,9 +39,15 @@ import numpy as np
 
 from ..ops import batched, pallas_expand, reference as ref
 from ..ops.batched import BoundTables
+from ..utils import config as _cfg
 from . import telemetry as tele
 
 I32_MAX = jnp.int32(2**31 - 1)
+
+# read ONCE at import, never inside the traced step: an env read at
+# trace time is a silent retrace/stale-value hazard (tts-lint
+# trace_safety) — the executable keeps whatever the first trace saw
+_DEBUG_STEP = _cfg.env_flag("TTS_DEBUG_STEP")
 
 # default telemetry leaf for keyword-constructed states (numpy, not jnp:
 # a module-import-time jnp array would force backend selection before
@@ -662,8 +668,7 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
 
         perm1 = _partition(cand)
         SW = pallas_expand.sched_words(J)
-        debug_tap = bool(__debug__ and P > KH and
-                         __import__("os").environ.get("TTS_DEBUG_STEP"))
+        debug_tap = bool(__debug__ and P > KH and _DEBUG_STEP)
         if limit is None:
             limit = row_limit(capacity, B, J)
 

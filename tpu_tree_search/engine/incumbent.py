@@ -44,7 +44,6 @@ unaffected.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 
 import numpy as np
@@ -93,15 +92,10 @@ class IncumbentBoard:
     def __init__(self, max_keys: int | None = None):
         from ..utils import config as _cfg
         if max_keys is None:
-            try:
-                max_keys = int(os.environ.get(
-                    "TTS_INCUMBENT_MAX_KEYS",
-                    _cfg.INCUMBENT_MAX_KEYS_DEFAULT))
-            except ValueError:
-                max_keys = _cfg.INCUMBENT_MAX_KEYS_DEFAULT
+            max_keys = _cfg.env_int("TTS_INCUMBENT_MAX_KEYS")
         self._lock = threading.Lock()
         self._max_keys = max(1, int(max_keys))
-        self._best: dict[str, int] = {}
+        self._best: dict[str, int] = {}   # guarded-by: self._lock
 
     def publish(self, key: str, value: int, source: str = "") -> bool:
         """Min-fold `value` into the board; True iff it improved the

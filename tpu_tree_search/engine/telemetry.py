@@ -37,8 +37,6 @@ instances).
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
 
 # ---------------------------------------------------------------- layout
@@ -70,8 +68,8 @@ def enabled() -> bool:
     """The static compile-in flag (TTS_SEARCH_TELEMETRY / CLI
     --search-telemetry). Read at state-INIT time: a state keeps the
     width it was born (or checkpointed) with."""
-    return os.environ.get(ENV_FLAG, "").strip().lower() in (
-        "1", "true", "on", "yes")
+    from ..utils.config import env_flag
+    return env_flag(ENV_FLAG)
 
 
 def enabled_width() -> int:

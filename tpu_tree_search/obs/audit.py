@@ -49,12 +49,12 @@ from __future__ import annotations
 
 import collections
 import dataclasses
-import os
 import threading
 import time
 
 import numpy as np
 
+from ..utils import config as _cfg
 from . import metrics, tracelog
 
 __all__ = ["AuditError", "Finding", "enabled", "hard", "roundtrip_enabled",
@@ -81,30 +81,29 @@ class Finding:
 
 # recent findings, process-wide: the health layer's `audit` rule and
 # /alerts read this ring; bounded so a flapping invariant cannot leak
-_FINDINGS: collections.deque[Finding] = collections.deque(maxlen=256)
+_FINDINGS: collections.deque[Finding] = collections.deque(
+    maxlen=256)   # guarded-by: _LOCK
 _LOCK = threading.Lock()
 
 
 def enabled() -> bool:
     """Result/reshard auditing (TTS_AUDIT; default ON — the checks are
     host-side sums over already-fetched counters)."""
-    return os.environ.get("TTS_AUDIT", "1").strip().lower() not in (
+    return (_cfg.env_str("TTS_AUDIT") or "1").strip().lower() not in (
         "0", "off", "false", "no")
 
 
 def hard() -> bool:
     """CI mode: any failed invariant raises AuditError."""
-    return os.environ.get("TTS_AUDIT_HARD", "").strip().lower() in (
-        "1", "true", "on", "yes")
+    return _cfg.env_flag("TTS_AUDIT_HARD")
 
 
 def roundtrip_enabled() -> bool:
     """Checkpoint re-read verification (TTS_AUDIT=full or
     TTS_AUDIT_CKPT=1); off by default — it re-reads every snapshot."""
-    if os.environ.get("TTS_AUDIT", "").strip().lower() == "full":
+    if (_cfg.env_str("TTS_AUDIT") or "").strip().lower() == "full":
         return True
-    return os.environ.get("TTS_AUDIT_CKPT", "").strip().lower() in (
-        "1", "true", "on", "yes")
+    return _cfg.env_flag("TTS_AUDIT_CKPT")
 
 
 def record(invariant: str, ok: bool, **detail) -> Finding:

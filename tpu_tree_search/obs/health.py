@@ -74,16 +74,11 @@ RESOLVED = "resolved"
 _SEVERITY_ORDER = {"critical": 0, "page": 0, "warn": 1, "info": 2}
 
 
-def _env_f(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 @dataclasses.dataclass
 class Thresholds:
-    """The rule family's knobs; :meth:`from_env` reads TTS_HEALTH_*."""
+    """The rule family's knobs; :meth:`from_env` reads TTS_HEALTH_*
+    through the config accessors (defaults come from the knob
+    registry — one source, lint-checked)."""
 
     queue_wait_p99_s: float = cfg.HEALTH_QUEUE_WAIT_P99_S_DEFAULT
     stall_s: float = cfg.HEALTH_STALL_S_DEFAULT
@@ -98,25 +93,17 @@ class Thresholds:
     @classmethod
     def from_env(cls) -> "Thresholds":
         return cls(
-            queue_wait_p99_s=_env_f("TTS_HEALTH_QUEUE_WAIT_P99_S",
-                                    cfg.HEALTH_QUEUE_WAIT_P99_S_DEFAULT),
-            stall_s=_env_f("TTS_HEALTH_STALL_S",
-                           cfg.HEALTH_STALL_S_DEFAULT),
-            stall_warmup_s=_env_f("TTS_HEALTH_STALL_WARMUP_S",
-                                  cfg.HEALTH_STALL_WARMUP_S_DEFAULT),
-            mem_frac=_env_f("TTS_HEALTH_MEM_FRAC",
-                            cfg.HEALTH_MEM_FRAC_DEFAULT),
-            compile_storm=_env_f("TTS_HEALTH_COMPILE_STORM",
-                                 cfg.HEALTH_COMPILE_STORM_DEFAULT),
-            pruning_min_rate=_env_f(
-                "TTS_HEALTH_PRUNING_MIN_RATE",
-                cfg.HEALTH_PRUNING_MIN_RATE_DEFAULT),
-            pruning_min_nodes=_env_f(
-                "TTS_HEALTH_PRUNING_MIN_NODES",
-                cfg.HEALTH_PRUNING_MIN_NODES_DEFAULT),
-            audit_window_s=_env_f("TTS_HEALTH_AUDIT_WINDOW_S",
-                                  cfg.HEALTH_AUDIT_WINDOW_S_DEFAULT),
-            perf_json=os.environ.get("TTS_HEALTH_PERF_JSON") or None)
+            queue_wait_p99_s=cfg.env_float("TTS_HEALTH_QUEUE_WAIT_P99_S"),
+            stall_s=cfg.env_float("TTS_HEALTH_STALL_S"),
+            stall_warmup_s=cfg.env_float("TTS_HEALTH_STALL_WARMUP_S"),
+            mem_frac=cfg.env_float("TTS_HEALTH_MEM_FRAC"),
+            compile_storm=cfg.env_float("TTS_HEALTH_COMPILE_STORM"),
+            pruning_min_rate=cfg.env_float(
+                "TTS_HEALTH_PRUNING_MIN_RATE"),
+            pruning_min_nodes=cfg.env_float(
+                "TTS_HEALTH_PRUNING_MIN_NODES"),
+            audit_window_s=cfg.env_float("TTS_HEALTH_AUDIT_WINDOW_S"),
+            perf_json=cfg.env_str("TTS_HEALTH_PERF_JSON"))
 
 
 @dataclasses.dataclass
@@ -400,11 +387,10 @@ class HealthMonitor:
         self.rules = (rules if rules is not None
                       else default_rules(self.thresholds))
         if interval_s is None:
-            interval_s = _env_f("TTS_HEALTH_INTERVAL_S",
-                                cfg.OBS_HEALTH_INTERVAL_S_DEFAULT)
+            interval_s = cfg.env_float("TTS_HEALTH_INTERVAL_S")
         self.interval_s = float(interval_s)
-        self.alerts: dict[str, Alert] = {}
-        self.history: dict[str, list] = {}
+        self.alerts: dict[str, Alert] = {}    # guarded-by: self._lock
+        self.history: dict[str, list] = {}    # guarded-by: self._lock
         self._g_alerts = self.registry.gauge(
             "tts_alerts",
             "alert state by rule (0 inactive, 0.5 pending, 1 firing)")
@@ -414,8 +400,8 @@ class HealthMonitor:
             "tts_health_evaluations_total", "health rule sweeps")
         self._lock = threading.RLock()
         self._stop = threading.Event()
-        self._thread: threading.Thread | None = None
-        self.evaluations = 0
+        self._thread: threading.Thread | None = None  # guarded-by: self._lock
+        self.evaluations = 0     # guarded-by: self._lock
         if autostart and self.interval_s > 0:
             self.start()
 
@@ -449,8 +435,12 @@ class HealthMonitor:
         self._stop.set()
         th = self._thread
         if th is not None:
+            # join OUTSIDE the lock: the daemon may be mid-evaluate_now
+            # (which holds it); taking the lock before the join would
+            # deadlock a stop() racing an evaluation sweep
             th.join(timeout=5)
-        self._thread = None
+        with self._lock:
+            self._thread = None
 
     def close(self) -> None:
         self.stop()
@@ -481,7 +471,7 @@ class HealthMonitor:
         return self.alerts_snapshot()
 
     def _advance(self, rule: Rule, active: bool, detail: dict,
-                 now: float) -> None:
+                 now: float) -> None:    # holds: self._lock
         a = self.alerts.get(rule.name)
         labels = {"rule": rule.name, "severity": rule.severity}
         if active:
@@ -517,6 +507,7 @@ class HealthMonitor:
                 del self.alerts[rule.name]
 
     def _sample_history(self, ctx: _Ctx, now: float) -> None:
+        # holds: self._lock
         def push(name, value):
             if value is None:
                 return

@@ -1,0 +1,182 @@
+"""The metric-name registry: every ``tts_*`` series the stack emits.
+
+ONE checked-in table for every metric name that can appear on
+``/metrics`` — the registry the static analyzer
+(``tpu_tree_search/analysis/metric_registry.py``, via
+``tools/tts_lint.py``) reconciles against the actual emit sites, so a
+renamed counter cannot silently orphan a health rule, a dashboard
+query, or a README row (the README "Metric registry" table is GENERATED
+from this dict by ``tools/tts_lint.py --write-docs``).
+
+Rules enforced by the lint:
+
+- every literal ``tts_*`` name at a ``counter()`` / ``gauge()`` /
+  ``histogram()`` call (emit site) or a ``gauge_samples()`` /
+  ``remove_matching()`` call (reference site) must have a row here;
+- every row here must have at least one emit site inside
+  ``tpu_tree_search/`` (no dead registry rows).
+
+Keep imports stdlib-only: the lint leg loads this module without the
+accelerator stack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+__all__ = ["Metric", "REGISTRY"]
+
+
+@dataclasses.dataclass(frozen=True)
+class Metric:
+    name: str
+    kind: str       # "counter" | "gauge" | "histogram"
+    labels: str     # comma list of label keys, "" when unlabeled
+    doc: str        # one line; lands in the generated README table
+
+
+def _table(*rows: Metric) -> dict:
+    out = {}
+    for m in rows:
+        if m.name in out:
+            raise ValueError(f"duplicate metric {m.name}")
+        out[m.name] = m
+    return out
+
+
+REGISTRY: dict[str, Metric] = _table(
+    # --- service: requests and queueing
+    Metric("tts_requests_submitted_total", "counter", "", "admissions"),
+    Metric("tts_requests_total", "counter", "state",
+           "terminal states (done/cancelled/deadline/failed)"),
+    Metric("tts_preemptions_total", "counter", "",
+           "higher-priority preemptions (checkpoint + requeue)"),
+    Metric("tts_redispatches_total", "counter", "",
+           "re-dispatches after a submesh failure"),
+    Metric("tts_request_spent_seconds", "histogram", "",
+           "per-request accumulated execution time"),
+    Metric("tts_queue_wait_seconds", "histogram", "",
+           "admission-to-dispatch wait"),
+    Metric("tts_queue_depth", "gauge", "", "live admission-queue depth"),
+    Metric("tts_queue_peak_depth", "gauge", "",
+           "high-water queue depth since server start"),
+    Metric("tts_queue_rejected", "gauge", "",
+           "admissions rejected at the depth bound"),
+    Metric("tts_submeshes", "gauge", "",
+           "submesh slots partitioned at startup"),
+    Metric("tts_submeshes_busy", "gauge", "",
+           "submeshes currently running a request"),
+    Metric("tts_phase_seconds", "gauge", "phase,worker,request",
+           "live kernel/gen_child/balance/idle attribution; series "
+           "retire at the request's terminal state"),
+    # --- executor + AOT caches
+    Metric("tts_executor_cache_hits_total", "counter", "",
+           "requests served from an already-compiled loop"),
+    Metric("tts_executor_cache_misses_total", "counter", "",
+           "compiled-loop builds (traces/compiles paid)"),
+    Metric("tts_executor_cache_entries", "gauge", "",
+           "distinct compiled loops held"),
+    Metric("tts_compile_seconds", "histogram", "",
+           "trace+compile wall seconds per new executable (disk "
+           "replays excluded)"),
+    Metric("tts_aot_cache_hits_total", "counter", "",
+           "executables deserialized from the disk AOT cache"),
+    Metric("tts_aot_cache_misses_total", "counter", "",
+           "disk AOT lookups with no loadable entry"),
+    Metric("tts_aot_cache_errors_total", "counter", "",
+           "corrupt/unreadable/unserializable AOT entries (corrupt "
+           "ones quarantined)"),
+    Metric("tts_deserialize_seconds", "histogram", "",
+           "disk AOT deserialize+load wall seconds per hit"),
+    # --- tuner
+    Metric("tts_tuner_cache_hits_total", "counter", "",
+           "tuned params replayed from the tuning cache (zero probes)"),
+    Metric("tts_tuner_cache_misses_total", "counter", "",
+           "tuning-cache lookups with no loadable entry"),
+    Metric("tts_tuner_probes_total", "counter", "",
+           "warmed probe executions (candidate measurements)"),
+    Metric("tts_tuner_probe_seconds", "histogram", "",
+           "wall seconds per tuning sweep (all candidates of a shape)"),
+    # --- checkpoints / resilience
+    Metric("tts_checkpoint_saves_total", "counter", "",
+           "checkpoint snapshots written"),
+    Metric("tts_checkpoint_save_seconds", "histogram", "",
+           "checkpoint save latency (fetch+compress+fsync)"),
+    Metric("tts_checkpoint_bytes", "histogram", "",
+           "checkpoint file size"),
+    Metric("tts_checkpoint_loads_total", "counter", "",
+           "checkpoint loads"),
+    Metric("tts_checkpoint_corrupt_total", "counter", "",
+           "corrupt snapshots detected at load"),
+    Metric("tts_checkpoint_quarantines_total", "counter", "",
+           "corrupt snapshots renamed *.corrupt"),
+    Metric("tts_checkpoint_rollbacks_total", "counter", "",
+           "resumes that fell back to the .prev last-good snapshot"),
+    Metric("tts_elastic_reshards_total", "counter", "",
+           "N->M worker elastic resumes"),
+    Metric("tts_pool_grows_total", "counter", "",
+           "lossless pool-overflow recoveries (fetch+grow+recommit)"),
+    Metric("tts_retries_total", "counter", "what",
+           "one increment per retried transient"),
+    Metric("tts_faults_injected_total", "counter", "point,fault",
+           "deterministic fault injections that fired"),
+    # --- segments / engine throughput
+    Metric("tts_segment_seconds", "histogram", "", "segment latency"),
+    Metric("tts_segment_gap_seconds", "histogram", "",
+           "device-idle gap between segments (TTS_OVERLAP drives it "
+           "to ~0)"),
+    Metric("tts_nodes_explored_total", "counter", "",
+           "explored-node throughput (segment deltas)"),
+    Metric("tts_incumbent_folds_total", "counter", "direction",
+           "cross-request incumbent exchanges (out=published, "
+           "in=folded)"),
+    Metric("tts_ladder_switches_total", "counter", "direction",
+           "chunk-ladder rung switches at segment boundaries"),
+    # --- on-device search telemetry (TTS_SEARCH_TELEMETRY=1)
+    Metric("tts_search_popped", "gauge", "bucket,request,tag",
+           "nodes popped by relative-depth bucket"),
+    Metric("tts_search_branched", "gauge", "bucket,request,tag",
+           "children branched by relative-depth bucket"),
+    Metric("tts_search_pruned", "gauge", "bucket,request,tag",
+           "children pruned by relative-depth bucket"),
+    Metric("tts_search_bound_gap", "gauge", "outcome,bin,request,tag",
+           "child bound-value histogram, pruned vs surviving"),
+    Metric("tts_search_pruning_rate", "gauge", "request,tag",
+           "pruned/evaluated ratio"),
+    Metric("tts_search_frontier_depth", "gauge", "request,tag",
+           "mean relative frontier depth (0=root, 1=leaves)"),
+    Metric("tts_search_pool_highwater", "gauge", "request,tag",
+           "peak pool occupancy"),
+    Metric("tts_search_steal_sent", "gauge", "request,tag",
+           "work-stealing rows sent"),
+    Metric("tts_search_steal_recv", "gauge", "request,tag",
+           "work-stealing rows received"),
+    Metric("tts_search_improvements", "gauge", "request,tag",
+           "incumbent improvements found"),
+    # --- resources
+    Metric("tts_device_bytes_in_use", "gauge", "device,platform",
+           "per-device HBM in use"),
+    Metric("tts_device_bytes_peak", "gauge", "device,platform",
+           "per-device peak HBM"),
+    Metric("tts_device_bytes_limit", "gauge", "device,platform",
+           "per-device memory limit"),
+    Metric("tts_host_rss_bytes", "gauge", "",
+           "host process resident set"),
+    # --- health / audit / meta
+    Metric("tts_alerts", "gauge", "rule,severity",
+           "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),
+    Metric("tts_alerts_fired_total", "counter", "rule",
+           "pending->firing transitions"),
+    Metric("tts_health_evaluations_total", "counter", "",
+           "health rule sweeps"),
+    Metric("tts_audit_checks_total", "counter", "invariant",
+           "audit invariant evaluations"),
+    Metric("tts_audit_failures_total", "counter", "invariant",
+           "failed audit invariants"),
+    Metric("tts_http_requests_total", "counter", "path",
+           "observability endpoint hits"),
+    Metric("tts_profile_captures_total", "counter", "",
+           "completed on-demand profiler captures"),
+    Metric("tts_metrics_dropped_total", "counter", "metric",
+           "label sets dropped by the per-metric cardinality cap"),
+)

@@ -30,7 +30,6 @@ lives in README.md's Observability section.
 from __future__ import annotations
 
 import math
-import os
 import threading
 import time
 
@@ -69,7 +68,7 @@ class _Metric:
         self.name = name
         self.help = help
         self._lock = threading.Lock()
-        self._series: dict[tuple, object] = {}
+        self._series: dict[tuple, object] = {}  # guarded-by: self._lock
         # cardinality valve (set by the owning Registry): a NEW label
         # set beyond the cap is dropped (and reported via _on_drop)
         # instead of growing the metric without bound — a leaked
@@ -264,21 +263,17 @@ class Registry:
                  max_series_per_metric: int | None = None):
         self.namespace = namespace
         self._lock = threading.Lock()
-        self._metrics: dict[str, _Metric] = {}
+        self._metrics: dict[str, _Metric] = {}  # guarded-by: self._lock
         self.created_unix = time.time()
         if max_series_per_metric is None:
             try:
-                from ..utils.config import OBS_METRIC_MAX_SERIES_DEFAULT
-            except ImportError:
-                OBS_METRIC_MAX_SERIES_DEFAULT = 2048
-            try:
-                max_series_per_metric = int(os.environ.get(
-                    "TTS_METRIC_MAX_SERIES", "")
-                    or OBS_METRIC_MAX_SERIES_DEFAULT)
-            except ValueError:
-                # a typo'd env knob must not take down every Registry()
-                # construction in the process
-                max_series_per_metric = OBS_METRIC_MAX_SERIES_DEFAULT
+                from ..utils.config import env_int
+                # env_int falls back to the registry default on a
+                # typo'd value — a bad knob must not take down every
+                # Registry() construction in the process
+                max_series_per_metric = env_int("TTS_METRIC_MAX_SERIES")
+            except ImportError:     # keep the registry usable solo
+                max_series_per_metric = 2048
         self.max_series_per_metric = (max_series_per_metric
                                       if max_series_per_metric
                                       and max_series_per_metric > 0

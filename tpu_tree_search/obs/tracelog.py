@@ -97,28 +97,25 @@ class TraceLog:
         self.t0_unix = time.time()
         self._lock = threading.Lock()
         self._buf: collections.deque[dict] = collections.deque(
-            maxlen=max(int(capacity), 1))
+            maxlen=max(int(capacity), 1))   # guarded-by: self._lock
         self._seq = itertools.count()
         self._tls = threading.local()
-        self._sink = None
-        self._sink_bytes = 0
-        self.rotations = 0
+        self._sink = None        # guarded-by: self._lock
+        self._sink_bytes = 0     # guarded-by: self._lock
+        self.rotations = 0       # guarded-by: self._lock
         # size-capped rotation (TTS_TRACE_MAX_MB, 0 disables): at the
         # cap the sink rolls to a `.1` sibling and restarts — a long
         # serve session's recorder is bounded at ~2x the cap on disk
         if max_sink_bytes is None:
             try:
-                from ..utils.config import OBS_TRACE_MAX_MB_DEFAULT
-            except ImportError:
-                OBS_TRACE_MAX_MB_DEFAULT = 64
-            try:
-                mb = float(os.environ.get("TTS_TRACE_MAX_MB", "")
-                           or OBS_TRACE_MAX_MB_DEFAULT)
-            except ValueError:   # a typo'd env knob must not take down
-                mb = OBS_TRACE_MAX_MB_DEFAULT  # the recorder
+                from ..utils.config import env_float
+                mb = env_float("TTS_TRACE_MAX_MB")
+            except ImportError:  # keep the recorder usable solo
+                mb = 64.0
             max_sink_bytes = int(mb * (1 << 20))
         self.max_sink_bytes = max(int(max_sink_bytes), 0)
-        self.dropped = 0           # records evicted from the ring
+        self.dropped = 0           # guarded-by: self._lock
+        #                            (records evicted from the ring)
         if sink_path:
             self.set_sink(sink_path)
 
@@ -148,7 +145,7 @@ class TraceLog:
             self._sink_bytes += len(meta)
             self._sink_path = path
 
-    def _rotate_locked(self) -> None:
+    def _rotate_locked(self) -> None:    # holds: self._lock
         """Roll the sink to `<path>.1` (replacing any previous rollover)
         and restart it fresh; caller holds the lock. A rotation failure
         downgrades to sink-off — the recorder must never raise."""
@@ -301,13 +298,12 @@ def get() -> TraceLog:
     with _global_lock:
         if _global is None:
             try:
-                from ..utils.config import OBS_TRACE_RING_DEFAULT
+                from ..utils.config import env_int, env_str
+                capacity = env_int("TTS_TRACE_RING")
+                sink = env_str("TTS_TRACE_FILE")
             except ImportError:     # keep the recorder usable solo
-                OBS_TRACE_RING_DEFAULT = 16384
-            _global = TraceLog(
-                capacity=int(os.environ.get(
-                    "TTS_TRACE_RING", str(OBS_TRACE_RING_DEFAULT))),
-                sink_path=os.environ.get("TTS_TRACE_FILE") or None)
+                capacity, sink = 16384, None
+            _global = TraceLog(capacity=capacity, sink_path=sink)
         return _global
 
 

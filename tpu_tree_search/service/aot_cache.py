@@ -163,12 +163,17 @@ class AOTCache:
         self.root = pathlib.Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.fingerprint = runtime_fingerprint(fingerprint_extra)
-        self.hits = 0
-        self.misses = 0          # no entry on disk for the key
-        self.mismatches = 0      # entry present but wrong-runtime header
-        self.errors = 0          # corrupt/unreadable/unserializable
-        self.quarantined = 0
-        self.writes = 0
+        self.hits = 0            # guarded-by: self._lock
+        self.misses = 0          # guarded-by: self._lock
+        #                          (no entry on disk for the key)
+        self.mismatches = 0      # guarded-by: self._lock
+        #                          (entry present, wrong-runtime header)
+        self.errors = 0          # guarded-by: self._lock
+        #                          (corrupt/unreadable/unserializable)
+        self.quarantined = 0     # guarded-by: self._lock
+        self.writes = 0          # guarded-by: self._lock
+        # deliberately UNguarded (atomic tuple swap, staleness is fine
+        # for a stats field): see entries()
         self._entries_cache: tuple | None = None
         self._lock = threading.Lock()
         self._hits_c = self._misses_c = self._errors_c = None
@@ -196,7 +201,7 @@ class AOTCache:
         # fresh compile, so the bound is essentially never felt)
         self._q: queue.Queue = queue.Queue(
             maxsize=max_pending or cfg.AOT_WRITER_QUEUE_DEPTH)
-        self._closed = False
+        self._closed = False     # guarded-by: self._close_lock
         # makes store()'s closed-check + enqueue atomic against
         # close(): without it a racing store() could enqueue AFTER the
         # shutdown sentinel — its task_done never runs, so a later

@@ -70,11 +70,11 @@ class _Entry:
     def __init__(self, fn, record: dict, on_measured, aot=None,
                  key: tuple = (), on_fallback=None):
         self.fn = fn
-        self.compiled = None
+        self.compiled = None     # guarded-by: self._lock
         self.record = record
         # reentrant: _first_call runs under it and may book a fallback
         self._lock = threading.RLock()
-        self._measured = False
+        self._measured = False   # guarded-by: self._lock
         self._on_measured = on_measured
         self._on_fallback = on_fallback
         self._aot = aot
@@ -115,7 +115,7 @@ class _Entry:
             if self._on_fallback is not None:
                 self._on_fallback(rec)
 
-    def _load_from_disk(self) -> bool:
+    def _load_from_disk(self) -> bool:   # holds: self._lock
         """Try the disk AOT tier (caller holds the lock). A hit readies
         `self.compiled` with ZERO lower()/compile() calls and books the
         entry as source=disk."""
@@ -182,7 +182,7 @@ class _Entry:
                                 key_repr=rec["key"])
             return "compile"
 
-    def _first_call(self, *args):
+    def _first_call(self, *args):        # holds: self._lock
         rec = self.record
         if self._load_from_disk():
             try:
@@ -279,12 +279,14 @@ class ExecutorCache:
 
     def __init__(self, registry=None, aot=None):
         self._lock = threading.Lock()
-        self._fns: dict[tuple, _Entry] = {}
-        self.hits = 0
-        self.misses = 0
+        self._fns: dict[tuple, _Entry] = {}   # guarded-by: self._lock
+        self.hits = 0                # guarded-by: self._lock
+        self.misses = 0              # guarded-by: self._lock
         self.aot = aot
-        self.compiles = 0            # fresh XLA compiles, any origin
-        self.planned_compiles = 0    # ...of which pre-warm initiated
+        self.compiles = 0            # guarded-by: self._lock
+        #                              (fresh XLA compiles, any origin)
+        self.planned_compiles = 0    # guarded-by: self._lock
+        #                              (...of which pre-warm initiated)
         # optional metrics mirror (obs/metrics.Registry): the server
         # passes its per-server registry so /metrics exposes the same
         # hit/miss counts the JSON snapshot reports, plus the

@@ -45,6 +45,7 @@ class RequestQueue:
         self.max_depth = max_depth
         self._lock = threading.Lock()
         self._heap: list[tuple[int, int, RequestRecord]] = []
+        # guarded-by: self._lock
         self.rejected = 0          # admission-control rejections (stats)
         self.peak_depth = 0        # high-water mark since construction —
                                    # the capacity-planning number a

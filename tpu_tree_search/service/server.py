@@ -196,7 +196,7 @@ class SearchServer:
         # round-trip a program degrades to the pre-cache behavior, it
         # never serves maybe-wrong bytes.
         if aot_cache_dir is None:
-            aot_cache_dir = os.environ.get(cfg.AOT_CACHE_ENV) or None
+            aot_cache_dir = cfg.env_str(cfg.AOT_CACHE_ENV)
         self.aot = None
         if aot_cache_dir:
             from . import aot_cache as aot_mod
@@ -227,7 +227,7 @@ class SearchServer:
         # happens at boot (prewarm_boot with tune_at_boot / TTS_TUNE);
         # a warm cache dir replays with zero probes.
         if tune_cache_dir is None:
-            tune_cache_dir = os.environ.get(cfg.TUNE_CACHE_ENV) or None
+            tune_cache_dir = cfg.env_str(cfg.TUNE_CACHE_ENV)
         self.tune_at_boot = (cfg.env_flag(cfg.TUNE_ENV)
                              if tune_at_boot is None
                              else bool(tune_at_boot))
@@ -259,9 +259,7 @@ class SearchServer:
         # them) plus memory counter lanes in the trace log; the daemon
         # thread samples on its own cadence, close() retires the series
         if resource_sample_s is None:
-            resource_sample_s = float(os.environ.get(
-                "TTS_RESOURCE_SAMPLE_S",
-                str(cfg.OBS_RESOURCE_SAMPLE_S_DEFAULT)))
+            resource_sample_s = cfg.env_float("TTS_RESOURCE_SAMPLE_S")
         self.resources = obs_resource.ResourceSampler(
             registry=self.metrics, period_s=resource_sample_s)
         if resource_sample_s > 0:
@@ -299,7 +297,7 @@ class SearchServer:
         # profiling to that dispatch — an opt-in production knob)
         self.phase_profile = phase_profile
         self._prof_cache: dict[tuple, dict] = {}
-        self.records: dict[str, RequestRecord] = {}
+        self.records: dict[str, RequestRecord] = {}  # guarded-by: self._lock
         self._lock = threading.RLock()
         self._seq = itertools.count()
         self._t0 = time.monotonic()
@@ -552,12 +550,7 @@ class SearchServer:
                     " 'spool' or 'JxM')")
 
         if concurrency is None:
-            try:
-                concurrency = int(os.environ.get(
-                    "TTS_PREWARM_CONCURRENCY", "")
-                    or cfg.PREWARM_CONCURRENCY_DEFAULT)
-            except ValueError:
-                concurrency = cfg.PREWARM_CONCURRENCY_DEFAULT
+            concurrency = cfg.env_int("TTS_PREWARM_CONCURRENCY")
         concurrency = max(1, concurrency)
 
         def warm_one(shape, mesh):

@@ -89,12 +89,14 @@ class TuningCache:
         self.root = pathlib.Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.fingerprint = tuning_fingerprint(fingerprint_extra)
-        self.hits = 0
-        self.misses = 0
-        self.mismatches = 0
-        self.errors = 0
-        self.quarantined = 0
-        self.writes = 0
+        self.hits = 0            # guarded-by: self._lock
+        self.misses = 0          # guarded-by: self._lock
+        self.mismatches = 0      # guarded-by: self._lock
+        self.errors = 0          # guarded-by: self._lock
+        self.quarantined = 0     # guarded-by: self._lock
+        self.writes = 0          # guarded-by: self._lock
+        # deliberately UNguarded (atomic tuple swap; staleness is fine
+        # for a stats field): see entries()
         self._entries_cache: tuple | None = None
         self._lock = threading.Lock()
         self._hits_c = self._misses_c = None

@@ -57,17 +57,6 @@ CHUNK_CANDIDATES_DEFAULT = (256, 1024, 4096, 16384, 65536)
 PERIOD_CANDIDATES_DEFAULT = (1, 4, 16)
 
 
-def _env_ints(name: str, default: tuple) -> tuple:
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return tuple(default)
-    try:
-        vals = tuple(int(t) for t in raw.split(",") if t.strip())
-        return vals or tuple(default)
-    except ValueError:
-        return tuple(default)
-
-
 class Autotuner:
     """Cache → probe → defaults resolution of the dispatch knobs.
 
@@ -86,22 +75,25 @@ class Autotuner:
         self.cache = (TuningCache(cache_dir, registry=registry,
                                   fingerprint_extra=fingerprint_extra)
                       if cache_dir else None)
-        self.chunks = tuple(chunks) if chunks else _env_ints(
+        from ..utils import config as _cfg
+        self.chunks = tuple(chunks) if chunks else _cfg.env_ints(
             "TTS_TUNE_CHUNKS", CHUNK_CANDIDATES_DEFAULT)
-        self.periods = tuple(periods) if periods else _env_ints(
+        self.periods = tuple(periods) if periods else _cfg.env_ints(
             "TTS_TUNE_PERIODS", PERIOD_CANDIDATES_DEFAULT)
         self.window_iters = int(window_iters
-                                or os.environ.get("TTS_TUNE_WINDOW", "")
-                                or 24)
+                                or _cfg.env_int("TTS_TUNE_WINDOW")
+                                or _cfg.TUNE_WINDOW_ITERS_DEFAULT)
         self.warm_iters = int(warm_iters
-                              or os.environ.get("TTS_TUNE_WARM", "")
-                              or 200)
+                              or _cfg.env_int("TTS_TUNE_WARM")
+                              or _cfg.TUNE_WARM_ITERS_DEFAULT)
         self.capacity = int(capacity or 1 << 18)
         self.repeats = int(repeats)
-        self.probes_run = 0          # probe executions this lifetime —
-        #                              the zero-probe warm-boot assertion
-        self.ledger: list[dict] = []  # one record per probe execution
-        self._memo: dict[tuple, Params] = {}
+        self.probes_run = 0          # guarded-by: self._lock
+        #                              (probe executions this lifetime —
+        #                              the zero-probe warm-boot assertion)
+        self.ledger: list[dict] = []  # guarded-by: self._lock
+        #                               (one record per probe execution)
+        self._memo: dict[tuple, Params] = {}  # guarded-by: self._lock
         self._lock = threading.Lock()
         self._probes_c = self._probe_h = None
         if registry is not None:

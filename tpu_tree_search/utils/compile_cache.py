@@ -14,7 +14,6 @@ elsewhere with TTS_COMPILE_CACHE_DIR.
 
 from __future__ import annotations
 
-import os
 import pathlib
 
 _DEFAULT_DIR = "~/.cache/tpu_tree_search/xla"
@@ -24,9 +23,10 @@ def enable(cache_dir: str | None = None) -> str | None:
     """Turn on JAX's persistent compilation cache (best-effort: unknown
     backends or read-only filesystems degrade to in-memory caching, never
     to an error). Returns the directory in use, or None if disabled."""
-    if os.environ.get("TTS_NO_COMPILE_CACHE"):
+    from . import config as _cfg
+    if _cfg.env_flag("TTS_NO_COMPILE_CACHE"):
         return None
-    path = (cache_dir or os.environ.get("TTS_COMPILE_CACHE_DIR")
+    path = (cache_dir or _cfg.env_str("TTS_COMPILE_CACHE_DIR")
             or _DEFAULT_DIR)
     path = str(pathlib.Path(path).expanduser())
     try:

@@ -17,14 +17,98 @@ from ..tune import defaults as tune_defaults
 _TRUTHY = ("1", "true", "on", "yes")
 
 
-def env_flag(name: str, default: bool = False) -> bool:
+def _knob_default(name: str, site_default):
+    """Resolve an accessor's default: the call site's explicit value
+    wins, else the registry row's. TTS_* names MUST be registered
+    (tools/tts_lint.py enforces the same at commit time; this raises at
+    runtime so a typo'd knob name fails the first read, not silently
+    never-applies). Non-TTS names pass through unchecked — the accessors
+    stay usable for one-off vars without polluting the registry."""
+    if name.startswith("TTS_"):
+        knob = KNOBS.get(name)
+        if knob is None:
+            raise KeyError(
+                f"unregistered knob {name!r}: every TTS_* env var must "
+                "have a row in utils/config.KNOBS (the single-source "
+                "registry tools/tts_lint.py checks)")
+        if site_default is None:
+            return knob.default
+    return site_default
+
+
+def env_flag(name: str, default: bool | None = None) -> bool:
     """Parse a boolean TTS_* env knob ('1'/'true'/'on'/'yes' = on;
     '0'/'false'/'off'/'no'/'' = off). One parser for every static
     feature flag so the accepted spellings cannot drift per call site."""
+    default = bool(_knob_default(name, default) or False)
     raw = os.environ.get(name, "").strip().lower()
     if not raw:
         return default
     return raw in _TRUTHY
+
+
+def env_str(name: str, default: str | None = None) -> str | None:
+    """String knob; '' and unset both resolve to the default (an empty
+    path/spec knob in a fleet unit file means "off", not "here")."""
+    default = _knob_default(name, default)
+    return os.environ.get(name) or default
+
+
+def env_int(name: str, default: int | None = None) -> int | None:
+    """Integer knob. A malformed value falls back to the default — the
+    repo-wide stance that a typo'd env knob must never take down the
+    process (it degrades, and the lint-checked registry documents the
+    real spelling)."""
+    default = _knob_default(name, default)
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float | None = None) -> float | None:
+    """Float knob; malformed values fall back like :func:`env_int`."""
+    default = _knob_default(name, default)
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def env_ints(name: str, default: tuple = ()) -> tuple:
+    """Comma-separated integer-list knob (the tuner's candidate
+    ladders: TTS_TUNE_CHUNKS="64,256,1024"). Malformed lists fall back
+    whole — a half-parsed candidate ladder is worse than the default."""
+    if name.startswith("TTS_") and name not in KNOBS:
+        raise KeyError(
+            f"unregistered knob {name!r}: add a row to "
+            "utils/config.KNOBS")
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return tuple(default)
+    try:
+        vals = tuple(int(t) for t in raw.split(",") if t.strip())
+        return vals or tuple(default)
+    except ValueError:
+        return tuple(default)
+
+
+def set_env(name: str, value) -> None:
+    """The one sanctioned TTS_* env WRITE path (CLI flags propagating
+    static knobs to respawned campaign workers / engine state init).
+    Registration-checked like the readers, so a flag can't be spelled
+    one way at the write site and another in the registry."""
+    if name.startswith("TTS_") and name not in KNOBS:
+        raise KeyError(
+            f"unregistered knob {name!r}: add a row to "
+            "utils/config.KNOBS")
+    os.environ[name] = str(value)
 
 # Resilience defaults — THE single source for engine/checkpoint.
 # run_segmented's env fallbacks (TTS_RETRY_ATTEMPTS / TTS_RETRY_BASE_S /
@@ -188,6 +272,234 @@ INCUMBENT_MAX_KEYS_DEFAULT = 4096  # TTS_INCUMBENT_MAX_KEYS — bound on
 LADDER_FLAG = "TTS_LADDER"
 TUNE_CACHE_ENV = "TTS_TUNE_CACHE"
 TUNE_ENV = "TTS_TUNE"
+TUNE_WINDOW_ITERS_DEFAULT = 24    # TTS_TUNE_WINDOW — measured iters
+                                  # per probe candidate
+TUNE_WARM_ITERS_DEFAULT = 200     # TTS_TUNE_WARM — warm-up iters
+                                  # before a probe's measured window
+
+
+# --------------------------------------------------------- knob registry
+#
+# THE single source of truth for every TTS_* environment knob. The
+# static analyzer (tpu_tree_search/analysis/knobs.py, run by
+# tools/tts_lint.py and the CI lint leg) enforces that (a) no module
+# outside this file reads TTS_* from os.environ directly — everything
+# goes through the env_* accessors above, which refuse unregistered
+# names — and (b) every registered knob appears in README.md (the
+# "Knob registry" table there is GENERATED from this dict by
+# `tools/tts_lint.py --write-docs`; edit here, never there).
+#
+# `scope` partitions the table: "runtime" knobs configure the engine/
+# service/obs stack proper; "bench", "tool" and "test" knobs configure
+# bench.py, the tools/ drivers and the test suite.
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str          # "flag" | "int" | "float" | "str" | "ints"
+    default: object    # value when unset (None = no default / off)
+    doc: str           # one line; lands in the generated README table
+    scope: str = "runtime"
+
+
+def _knob_table(*rows: Knob) -> dict:
+    table = {}
+    for k in rows:
+        if k.name in table:
+            raise ValueError(f"duplicate knob {k.name}")
+        table[k.name] = k
+    return table
+
+
+KNOBS: dict[str, Knob] = _knob_table(
+    # --- static engine flags (read once per search/server; off-modes
+    #     are bit-identical by the tier-1 matrix contract)
+    Knob("TTS_SEARCH_TELEMETRY", "flag", False,
+         "compile the on-device search-telemetry block into the loop "
+         "(static, read at state init; counts bit-identical on/off)"),
+    Knob("TTS_OVERLAP", "flag", False,
+         "pipelined segmented driver: async dispatch, donated carries, "
+         "writer-thread checkpoints (segment gap -> ~0)"),
+    Knob("TTS_SHARE_INCUMBENT", "flag", False,
+         "cross-request incumbent board: concurrent same-instance "
+         "requests tighten each other's pruning"),
+    Knob("TTS_LADDER", "flag", False,
+         "chunk-ladder execution: pre-built rungs switched at segment "
+         "boundaries from pool occupancy"),
+    Knob("TTS_DEBUG_STEP", "flag", False,
+         "compile jax.debug taps into the device step (trace-time "
+         "flag; debug builds only)"),
+    # --- resilience
+    Knob("TTS_RETRY_ATTEMPTS", "int", RETRY_ATTEMPTS_DEFAULT,
+         "in-place retries of transient I/O / dispatch errors"),
+    Knob("TTS_RETRY_BASE_S", "float", RETRY_BASE_S_DEFAULT,
+         "exponential-backoff base for those retries (seconds)"),
+    Knob("TTS_SEG_TIMEOUT_S", "float", SEGMENT_TIMEOUT_S_DEFAULT,
+         "per-segment wall-clock watchdog (0 = off)"),
+    Knob("TTS_FAULTS", "str", None,
+         "deterministic fault-injection plan (utils/faults syntax; "
+         "test/drill harness)"),
+    # --- service
+    Knob("TTS_SUBMESHES", "int", 1,
+         "serve: submesh partition count (campaign respawn channel)"),
+    Knob("TTS_QUEUE_DEPTH", "int", SERVICE_QUEUE_DEPTH_DEFAULT,
+         "serve: admission-queue depth (reject beyond)"),
+    Knob("TTS_AOT_CACHE", "str", None,
+         "disk AOT executable cache directory (unset = in-memory "
+         "executor cache only)"),
+    Knob("TTS_PREWARM", "str", None,
+         "boot pre-warm spec ('taillard,spool', explicit 'JxM' tokens; "
+         "'0'/'off'/'no' kill-switch beats the CLI flag)"),
+    Knob("TTS_PREWARM_CONCURRENCY", "int", PREWARM_CONCURRENCY_DEFAULT,
+         "parallel pre-warm workers at boot"),
+    Knob("TTS_INCUMBENT_MAX_KEYS", "int", INCUMBENT_MAX_KEYS_DEFAULT,
+         "incumbent-board distinct-instance bound (LRU-evicted)"),
+    # --- adaptive dispatch
+    Knob("TTS_TUNE_CACHE", "str", None,
+         "persistent tuning-cache directory (fingerprint-checked, "
+         "CRC-stamped)"),
+    Knob("TTS_TUNE", "flag", False,
+         "allow boot-time probing of cold shapes during pre-warm"),
+    Knob("TTS_TUNE_CHUNKS", "ints", None,
+         "probe candidate chunk ladder (comma list; unset = the "
+         "tuner's built-in pow2 ladder)"),
+    Knob("TTS_TUNE_PERIODS", "ints", None,
+         "probe candidate balance periods (comma list)"),
+    Knob("TTS_TUNE_WINDOW", "int", TUNE_WINDOW_ITERS_DEFAULT,
+         "measured iterations per probe candidate"),
+    Knob("TTS_TUNE_WARM", "int", TUNE_WARM_ITERS_DEFAULT,
+         "warm-up iterations before a probe's measured window"),
+    # --- observability
+    Knob("TTS_TRACE_FILE", "str", None,
+         "flight-recorder JSONL sink path (unset = ring buffer only)"),
+    Knob("TTS_TRACE_RING", "int", OBS_TRACE_RING_DEFAULT,
+         "flight-recorder in-RAM ring capacity (records)"),
+    Knob("TTS_TRACE_MAX_MB", "float", OBS_TRACE_MAX_MB_DEFAULT,
+         "sink rotation cap in MB (one .1 rollover kept; 0 disables)"),
+    Knob("TTS_METRIC_MAX_SERIES", "int", OBS_METRIC_MAX_SERIES_DEFAULT,
+         "per-metric label-set cap (new series beyond it drop, "
+         "counted in tts_metrics_dropped_total)"),
+    Knob("TTS_RESOURCE_SAMPLE_S", "float", OBS_RESOURCE_SAMPLE_S_DEFAULT,
+         "resource-sampler cadence (device bytes + host RSS; <= 0 "
+         "disables the daemon)"),
+    # --- audit
+    Knob("TTS_AUDIT", "str", "1",
+         "node-conservation auditor: '1' on (default), '0' off, "
+         "'full' adds checkpoint re-read verification"),
+    Knob("TTS_AUDIT_CKPT", "flag", False,
+         "checkpoint roundtrip verification alone (TTS_AUDIT=full "
+         "implies it)"),
+    Knob("TTS_AUDIT_HARD", "flag", False,
+         "raise AuditError on any failed invariant (the CI mode)"),
+    # --- health rules (thresholds; semantics per README Operations)
+    Knob("TTS_HEALTH_INTERVAL_S", "float", OBS_HEALTH_INTERVAL_S_DEFAULT,
+         "health-monitor evaluation interval (<= 0 disables daemon)"),
+    Knob("TTS_HEALTH_QUEUE_WAIT_P99_S", "float",
+         HEALTH_QUEUE_WAIT_P99_S_DEFAULT,
+         "queue_wait rule: windowed p99 SLO threshold (seconds)"),
+    Knob("TTS_HEALTH_STALL_S", "float", HEALTH_STALL_S_DEFAULT,
+         "stall rule: max heartbeat age of a RUNNING request"),
+    Knob("TTS_HEALTH_STALL_WARMUP_S", "float",
+         HEALTH_STALL_WARMUP_S_DEFAULT,
+         "stall rule: the limit BEFORE the first heartbeat (covers "
+         "XLA trace+compile)"),
+    Knob("TTS_HEALTH_MEM_FRAC", "float", HEALTH_MEM_FRAC_DEFAULT,
+         "mem_headroom rule: in_use/limit firing fraction"),
+    Knob("TTS_HEALTH_COMPILE_STORM", "float", HEALTH_COMPILE_STORM_DEFAULT,
+         "compile_storm rule: unplanned fresh compiles per interval"),
+    Knob("TTS_HEALTH_PRUNING_MIN_RATE", "float",
+         HEALTH_PRUNING_MIN_RATE_DEFAULT,
+         "pruning_collapse rule: minimum pruning rate"),
+    Knob("TTS_HEALTH_PRUNING_MIN_NODES", "float",
+         HEALTH_PRUNING_MIN_NODES_DEFAULT,
+         "pruning_collapse rule: judged only past this many children"),
+    Knob("TTS_HEALTH_AUDIT_WINDOW_S", "float",
+         HEALTH_AUDIT_WINDOW_S_DEFAULT,
+         "audit rule: how long a failure keeps the alert firing"),
+    Knob("TTS_HEALTH_PERF_JSON", "str", None,
+         "perf rule: path to a perf_sentry --json verdict file"),
+    # --- XLA persistent compile cache
+    Knob("TTS_NO_COMPILE_CACHE", "flag", False,
+         "opt out of XLA's persistent compilation cache"),
+    Knob("TTS_COMPILE_CACHE_DIR", "str", None,
+         "redirect the XLA persistent compilation cache directory"),
+    # --- bench.py
+    Knob("TTS_BENCH_PLATFORM", "str", None,
+         "bench: force a jax platform before backend init", "bench"),
+    Knob("TTS_BENCH_INSTANCE", "int", 21,
+         "bench: Taillard instance id", "bench"),
+    Knob("TTS_BENCH_CHUNK", "int", None,
+         "bench: chunk override (unset = measured-defaults table)",
+         "bench"),
+    Knob("TTS_BENCH_ITERS", "int", 2000,
+         "bench: measured loop iterations", "bench"),
+    Knob("TTS_BENCH_WARM", "int", None,
+         "bench: warm-up iterations override", "bench"),
+    Knob("TTS_BENCH_LB", "str", "1,2",
+         "bench: comma list of bounds to measure", "bench"),
+    Knob("TTS_BENCH_TUNED", "flag", False,
+         "bench: resolve chunk/period through the Autotuner", "bench"),
+    Knob("TTS_BENCH_SEGGAP", "flag", True,
+         "bench: emit the segment-gap row", "bench"),
+    Knob("TTS_BENCH_COLDSTART", "flag", True,
+         "bench: emit the cold-start rows", "bench"),
+    Knob("TTS_BENCH_RAMPDRAIN", "flag", True,
+         "bench: emit the ramp/drain ladder rows", "bench"),
+    Knob("TTS_BENCH_RAMP_JOBS", "int", 10,
+         "bench: ramp/drain synthetic instance jobs", "bench"),
+    Knob("TTS_BENCH_RAMP_CHUNK", "int", 1024,
+         "bench: ramp/drain tuned-chunk rung", "bench"),
+    # --- tools/ drivers
+    Knob("TTS_CAMPAIGN_OUT", "str", "/tmp/campaign.jsonl",
+         "run_campaign: result JSONL path", "tool"),
+    Knob("TTS_WORKDIR", "str", "/tmp",
+         "run_campaign: checkpoint/workdir root", "tool"),
+    Knob("TTS_LB", "int", 2, "run_campaign: bound kind", "tool"),
+    Knob("TTS_CHUNK", "int", 32768, "run_campaign: pop chunk", "tool"),
+    Knob("TTS_CAPACITY", "int", 0,
+         "run_campaign: pool rows (0 = sized from the instance)",
+         "tool"),
+    Knob("TTS_BUDGET_S", "float", 7200.0,
+         "run_campaign: per-instance execution budget", "tool"),
+    Knob("TTS_SEG", "int", 2000,
+         "run_campaign: segment iterations", "tool"),
+    Knob("TTS_CKPT_EVERY", "int", 8,
+         "run_campaign: segments between checkpoints", "tool"),
+    Knob("TTS_UB", "str", "opt",
+         "run_campaign: incumbent seed ('opt' | 'inf')", "tool"),
+    Knob("TTS_STALL_GRACE", "float", 900.0,
+         "run_campaign: supervisor stall grace (seconds)", "tool"),
+    Knob("TTS_STALL_FACTOR", "float", 4.0,
+         "run_campaign: stall limit as a multiple of segment time",
+         "tool"),
+    Knob("TTS_STALL_MIN", "float", 720.0,
+         "run_campaign: stall limit floor (seconds)", "tool"),
+    Knob("TTS_MAX_RESTARTS", "int", 50,
+         "run_campaign: worker respawn budget", "tool"),
+    Knob("TTS_DEAD_LIMIT", "int", 5,
+         "run_campaign: consecutive no-progress restarts before an "
+         "instance is declared dead", "tool"),
+    Knob("TTS_TABLE_OUT", "str", "/tmp/single_device_table.jsonl",
+         "run_single_device_table: output path", "tool"),
+    Knob("TTS_BAL_CHUNK", "int", 32768,
+         "bench_balance: chunk", "tool"),
+    Knob("TTS_BAL_CAP", "int", 1 << 21,
+         "bench_balance: pool capacity", "tool"),
+    Knob("TTS_BAL_ROUNDS", "int", 20,
+         "bench_balance: measured rounds", "tool"),
+    Knob("TTS_BRACKET_REPS", "int", 256,
+         "validate_attribution: bracket repetitions", "tool"),
+    # --- test suite
+    Knob("TTS_TEST_TPU", "flag", False,
+         "tests: keep the attached TPU backend instead of the 8-device "
+         "virtual CPU mesh", "test"),
+    Knob("TTS_TEST_STALL_AT_SEG", "int", 0,
+         "campaign kill-drill: worker self-stalls at this segment",
+         "test"),
+    Knob("TTS_OBS_ARTIFACT_DIR", "str", None,
+         "tests: export serve-session trace artifacts here (the CI "
+         "upload dir)", "test"),
+)
 
 
 @dataclasses.dataclass

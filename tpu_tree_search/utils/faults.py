@@ -155,7 +155,8 @@ def active() -> FaultPlan | None:
     if stack:
         return stack[-1]
     if not _configured:
-        spec = os.environ.get("TTS_FAULTS", "")
+        from . import config as _cfg
+        spec = _cfg.env_str("TTS_FAULTS") or ""
         _plan = FaultPlan.parse(spec) if spec else None
         _configured = True
     return _plan
