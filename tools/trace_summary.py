@@ -25,6 +25,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 TERMINALS = ("done", "cancelled", "deadline", "failed")
 
+# pseudo-row pooling request-less remediation events (quarantine,
+# readmit, admission pause) so the footer count is complete; never
+# rendered as a request row
+SERVER_ROW = "<server>"
+
 
 def load_records(path: str) -> list[dict]:
     """Normalize either trace format to tracelog-shaped records
@@ -60,15 +65,22 @@ def summarize(records: list[dict]) -> dict[str, dict]:
             "state": "?", "admit_ts": None, "first_dispatch_ts": None,
             "terminal_ts": None, "dispatches": 0, "preemptions": 0,
             "checkpoints": 0, "retries": 0, "faults": 0, "exec_s": 0.0,
+            "failures": 0, "failure_log": [], "remediations": 0,
             "submeshes": set()})
 
     for r in sorted(records, key=lambda r: (r.get("ts", 0.0),
                                             r.get("seq", 0))):
         rid = r.get("request_id")
+        name = r.get("name", "")
         if rid is None:
+            if name.startswith("remediation."):
+                # server-level actions (quarantine/readmit/pause)
+                # carry no request id; pool them under a pseudo-row so
+                # the footer's remediation count stays complete (the
+                # render skips the row in the per-request table)
+                req(SERVER_ROW)["remediations"] += 1
             continue
         s = req(rid)
-        name = r.get("name", "")
         if name == "request.admit":
             s["admit_ts"] = r["ts"]
         elif name == "request.dispatch":
@@ -89,6 +101,20 @@ def summarize(records: list[dict]) -> dict[str, dict]:
             s["retries"] += 1
         elif name == "fault.injected":
             s["faults"] += 1
+        elif name == "request.dispatch_failure":
+            # one per dispatch failure INCLUDING the terminal one
+            # (request.redispatch only marks the requeue path) — the
+            # post-hoc failure_log the self-healing tier keeps on the
+            # RequestRecord, rebuilt from the flight recorder so a
+            # dead-lettered FAILED request is diagnosable from the
+            # trace alone
+            s["failures"] += 1
+            s["failure_log"].append(
+                {"submesh": r.get("submesh"),
+                 "attempt": r.get("attempt"),
+                 "error": r.get("error")})
+        elif name.startswith("remediation."):
+            s["remediations"] += 1
         elif name.startswith("request.") \
                 and name.split(".", 1)[1] in TERMINALS:
             s["state"] = name.split(".", 1)[1].upper()
@@ -99,25 +125,42 @@ def summarize(records: list[dict]) -> dict[str, dict]:
 
 def render(reqs: dict[str, dict]) -> str:
     hdr = (f"{'request':<10} {'state':<9} {'wait_s':>8} {'latency_s':>10} "
-           f"{'exec_s':>8} {'disp':>4} {'pre':>4} {'ckpt':>4} "
-           f"{'retry':>5}  submeshes")
+           f"{'exec_s':>8} {'disp':>4} {'pre':>4} {'fail':>4} "
+           f"{'ckpt':>4} {'retry':>5}  submeshes")
     lines = [hdr, "-" * len(hdr)]
 
     def f(a, b):
         return f"{b - a:.3f}" if a is not None and b is not None else "-"
 
-    for rid in sorted(reqs):
-        s = reqs[rid]
+    rows = {rid: s for rid, s in reqs.items() if rid != SERVER_ROW}
+    for rid in sorted(rows):
+        s = rows[rid]
         lines.append(
             f"{rid:<10} {s['state']:<9} "
             f"{f(s['admit_ts'], s['first_dispatch_ts']):>8} "
             f"{f(s['admit_ts'], s['terminal_ts']):>10} "
             f"{s['exec_s']:>8.3f} {s['dispatches']:>4} "
-            f"{s['preemptions']:>4} {s['checkpoints']:>4} "
+            f"{s['preemptions']:>4} {s['failures']:>4} "
+            f"{s['checkpoints']:>4} "
             f"{s['retries']:>5}  "
             f"{sorted(s['submeshes'])}")
-    n_pre = sum(s["preemptions"] for s in reqs.values())
-    lines.append(f"{len(reqs)} request(s), {n_pre} preemption(s)")
+    n_pre = sum(s["preemptions"] for s in rows.values())
+    n_fail = sum(s["failures"] for s in rows.values())
+    n_rem = sum(s["remediations"] for s in reqs.values())
+    lines.append(f"{len(rows)} request(s), {n_pre} preemption(s), "
+                 f"{n_fail} dispatch failure(s), "
+                 f"{n_rem} remediation record(s)")
+    # the per-failure story for anything that failed (a dead-lettered
+    # request's trail: which submesh, which attempt, which error)
+    for rid in sorted(rows):
+        s = rows[rid]
+        if not s["failure_log"]:
+            continue
+        lines.append(f"\nfailure log {rid} ({s['state']}):")
+        for i, e in enumerate(s["failure_log"], 1):
+            lines.append(f"  {i}. submesh={e.get('submesh')} "
+                         f"attempt={e.get('attempt')}: "
+                         f"{e.get('error')}")
     return "\n".join(lines)
 
 

@@ -240,6 +240,19 @@ def _serve_parser(sub):
                         "so ramp/drain run small-chunk steps "
                         "(engine/ladder.py; off-mode is bit-identical "
                         "to the fixed-chunk driver)")
+    p.add_argument("--remediate", action="store_true",
+                   help="EXECUTE the self-healing policy table (also "
+                        "via TTS_REMEDIATE=1; service/remediate.py): "
+                        "stall alerts auto-preempt + requeue with the "
+                        "offending submesh excluded, failures "
+                        "localized to one submesh quarantine it "
+                        "(drain, canary-probe, readmit), failures "
+                        "following a request across submeshes "
+                        "dead-letter it with a full failure_log, "
+                        "compile storms pause admission (429), audit "
+                        "failures quarantine the bad checkpoint. "
+                        "Default: observe-only — the controller logs "
+                        "the action it WOULD take and touches nothing")
     p.add_argument("--prewarm", type=str, nargs="?", const="",
                    default=None, metavar="SPEC",
                    help="boot pre-warm: ready compiled loops BEFORE "
@@ -296,6 +309,8 @@ def run_serve(args) -> int:
         # static flag: every engine entry (serve dispatches, prewarm's
         # rung warms, in-process tools) must see the same ladder mode
         _cfg.set_env(_cfg.LADDER_FLAG, "1")
+    if args.remediate:
+        _cfg.set_env(_cfg.REMEDIATE_FLAG, "1")
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -314,8 +329,12 @@ def run_serve(args) -> int:
                                            else None),
                           aot_cache_dir=args.aot_cache,
                           tune_cache_dir=args.tune_cache,
-                          tune_at_boot=(True if args.tune else None)
+                          tune_at_boot=(True if args.tune else None),
+                          remediate=(True if args.remediate else None)
                           ) as srv:
+            print(f"remediation: "
+                  f"{'ACT' if srv.remediation.enabled else 'observe'}"
+                  f"-mode (TTS_REMEDIATE)", flush=True)
             if srv.aot is not None:
                 print(f"aot cache: {srv.aot.root} "
                       f"({srv.aot.entries()} entr(y/ies))", flush=True)
@@ -527,16 +546,24 @@ def run_doctor(args) -> int:
                              if k != "metrics"}}, indent=1))
     else:
         for s in merged["servers"]:
+            degraded = bool(s.get("quarantined"))
             mark = ("ok" if s["ok"] and s["healthz"] == "ok"
-                    and not s.get("firing") else "UNHEALTHY")
+                    and not s.get("firing") and not degraded
+                    else ("DEGRADED" if degraded and s["ok"]
+                          and s["healthz"] == "ok"
+                          and not s.get("firing") else "UNHEALTHY"))
             aot = s.get("aot_cache")
             aot_col = (f" aot={aot['hits']}h/{aot['misses']}m"
                        f"/{aot['entries']}e" if aot else "")
+            paused = s.get("admission_paused")
+            rem_col = (f" quarantined={s.get('quarantined')}"
+                       if s.get("quarantined") else "") + (
+                       f" PAUSED({paused})" if paused else "")
             print(f"{s['origin']:<24} {mark:<10} "
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
-                  f"requests={s.get('requests')}{aot_col}")
+                  f"requests={s.get('requests')}{aot_col}{rem_col}")
         print("healthy" if healthy else
               "UNHEALTHY:\n  " + "\n  ".join(reasons))
     return 0 if healthy else 1

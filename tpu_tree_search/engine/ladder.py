@@ -38,12 +38,39 @@ flight-recorder events (segment, pool, from/to chunks).
 
 from __future__ import annotations
 
+import threading
+
 from ..obs import metrics as obs_metrics
 from ..obs import tracelog
 
 __all__ = ["RungController", "rungs_for", "min_rung_for",
+           "set_memory_pressure", "memory_pressure",
            "LADDER_FACTOR", "LADDER_RUNGS", "LADDER_MIN_CHUNK",
            "LADDER_MIN_CHUNK_LB2"]
+
+# process-wide memory-pressure hint (the remediation controller's
+# mem_headroom action raises it, the alert's resolution clears it).
+# Under pressure the controller holds the smallest COVERING rung —
+# the ramp-momentum bump one rung above covering is suppressed, so the
+# next segments run the narrowest per-iteration scratch that still
+# pops exactly what the tuned chunk would. Covering-rung pops are
+# pool-limited identically across rungs, so node accounting stays
+# bit-identical with the hint on or off — it trades only adaptation
+# latency for headroom. A threading.Event, not a flag under a lock:
+# the readers are per-segment host callbacks.
+_MEM_PRESSURE = threading.Event()
+
+
+def set_memory_pressure(on: bool) -> None:
+    """Raise/clear the demote-the-ladder hint (service/remediate)."""
+    if on:
+        _MEM_PRESSURE.set()
+    else:
+        _MEM_PRESSURE.clear()
+
+
+def memory_pressure() -> bool:
+    return _MEM_PRESSURE.is_set()
 
 # rung geometry: LADDER_RUNGS rungs, each LADDER_FACTOR× the previous,
 # topped by the tuned chunk (pow2 factor keeps every rung lane-aligned
@@ -132,12 +159,15 @@ class RungController:
         used for the NEXT dispatch."""
         target = self._target(pool_total)
         if (self._last_pool is not None
-                and pool_total > 2 * max(self._last_pool, 1)):
+                and pool_total > 2 * max(self._last_pool, 1)
+                and not memory_pressure()):
             # ramp momentum: the pool at least doubled inside the last
             # segment, so the boundary snapshot is already stale — go
             # one rung above covering to cut the chase (an explosive
             # warm-up otherwise costs one under-rung segment per
-            # doubling)
+            # doubling). Suppressed under the remediation tier's
+            # memory-pressure hint: covering is the demoted,
+            # narrowest-scratch choice and pops identically
             target = min(target + 1, len(self.chunks) - 1)
         self._last_pool = int(pool_total)
         if target == self.idx:

@@ -138,7 +138,8 @@ def merge(fleet: dict) -> dict:
                "healthz": (s["healthz"] or {}).get("status"),
                "firing": None, "queue_depth": None, "submeshes": None,
                "submeshes_busy": None, "requests": 0, "uptime_s": None,
-               "aot_cache": None}
+               "aot_cache": None, "quarantined": 0,
+               "admission_paused": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -151,6 +152,12 @@ def merge(fleet: dict) -> dict:
             # server runs without a disk AOT cache) — the doctor
             # surfaces them per server
             row["aot_cache"] = st.get("aot_cache")
+            # the self-healing tier's degraded-configuration facts:
+            # active submesh quarantines and a paused admission valve
+            # (service/remediate) — the doctor's degraded verdict input
+            rem = st.get("remediation") or {}
+            row["quarantined"] = len(rem.get("quarantined") or [])
+            row["admission_paused"] = rem.get("admission_paused")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             for rid, snap in reqs.items():
@@ -184,7 +191,10 @@ def fleet_to_prometheus(merged: dict) -> str:
 
 def verdict(merged: dict) -> tuple[bool, list[str]]:
     """The doctor's judgment: (healthy, reasons). Healthy iff every
-    server scraped, healthz says ok, and zero alerts are firing."""
+    server scraped, healthz says ok, zero alerts are firing, and no
+    server is serving in a degraded (quarantined-submesh)
+    configuration — a fleet routing around a held-out submesh works,
+    but it is running on reduced capacity and a human should know."""
     reasons = []
     for s in merged["servers"]:
         if not s["ok"]:
@@ -194,6 +204,10 @@ def verdict(merged: dict) -> tuple[bool, list[str]]:
         if s.get("firing"):
             reasons.append(f"{s['origin']}: {s['firing']} firing "
                            "alert(s)")
+        if s.get("quarantined"):
+            reasons.append(
+                f"{s['origin']}: DEGRADED — {s['quarantined']} "
+                f"submesh(es) quarantined of {s.get('submeshes')}")
     for a in merged["alerts"]:
         if a.get("state") == "firing":
             reasons.append(

@@ -177,10 +177,34 @@ def _page(title: str, sub: str, body: str) -> str:
         "</footer></body></html>")
 
 
+def _remediation_rows(rem: dict | None) -> str:
+    """The self-healing journal tail (service/remediate snapshot)."""
+    actions = (rem or {}).get("actions") or []
+    if not actions:
+        mode = (rem or {}).get("mode", "observe")
+        return (f'<tr><td colspan="4" class="ok">✓ no remediation '
+                f"activity ({_esc(mode)} mode)</td></tr>")
+    rows = []
+    for a in reversed(actions[-12:]):
+        outcome = a.get("outcome", "?")
+        cls = ("ok" if outcome in ("applied", "observed")
+               else "err" if outcome in ("failed", "error") else "")
+        detail = ", ".join(
+            f"{k}={_fmt(v) if isinstance(v, float) else v}"
+            for k, v in (a.get("detail") or {}).items())
+        rows.append(
+            f"<tr><td>{_esc(a.get('rule'))}</td>"
+            f"<td>{_esc(a.get('action'))}</td>"
+            f'<td class="{cls}">{_esc(outcome)}</td>'
+            f'<td class="mono">{_esc(detail)}</td></tr>')
+    return "".join(rows)
+
+
 def render_server(snapshot: dict | None, alerts: dict | None,
                   history: dict | None) -> str:
-    """One serve session: stat tiles, alert panel, sparklines from the
-    health monitor's history rings, request table."""
+    """One serve session: stat tiles, alert panel, self-healing
+    journal, sparklines from the health monitor's history rings,
+    request table."""
     snapshot = snapshot or {}
     alerts = alerts or {}
     firing = alerts.get("firing", 0)
@@ -189,10 +213,16 @@ def render_server(snapshot: dict | None, alerts: dict | None,
     busy = sum(1 for s in subs if s.get("running"))
     counters = snapshot.get("counters") or {}
     cache = snapshot.get("executor_cache") or {}
+    rem = snapshot.get("remediation") or {}
+    n_quar = len(rem.get("quarantined") or [])
+    paused = rem.get("admission_paused")
     tiles = "".join([
         _tile("firing alerts", firing, bad=firing > 0),
         _tile("queue depth", queue.get("depth", 0)),
         _tile("submeshes busy", f"{busy}/{len(subs)}"),
+        _tile("quarantined", n_quar, bad=n_quar > 0),
+        _tile("admission", "paused" if paused else "open",
+              bad=bool(paused)),
         _tile("done", counters.get("done", 0)),
         _tile("failed", counters.get("failed", 0),
               bad=counters.get("failed", 0) > 0),
@@ -214,6 +244,9 @@ def render_server(snapshot: dict | None, alerts: dict | None,
         "<h2>Alerts</h2><table><tr><th>severity</th><th>rule</th>"
         "<th>state</th><th>fired</th><th>detail</th></tr>"
         f"{_alert_rows(alerts.get('alerts') or [])}</table>"
+        f"<h2>Self-healing ({_esc(rem.get('mode', 'observe'))} mode)"
+        "</h2><table><tr><th>rule</th><th>action</th><th>outcome</th>"
+        f"<th>detail</th></tr>{_remediation_rows(rem)}</table>"
         + (f"<h2>Trends</h2><div class='sparks'>{''.join(sparks)}</div>"
            if sparks else "")
         + "<h2>Requests</h2><table><tr><th>id</th><th>state</th>"
@@ -233,30 +266,43 @@ def render_fleet(merged: dict) -> str:
     servers = merged.get("servers") or []
     firing = merged.get("firing", 0)
     down = sum(1 for s in servers if not s["ok"])
+    quarantined = sum(s.get("quarantined") or 0 for s in servers)
+    paused = sum(1 for s in servers if s.get("admission_paused"))
     tiles = "".join([
         _tile("servers", len(servers)),
         _tile("unreachable", down, bad=down > 0),
         _tile("firing alerts", firing, bad=firing > 0),
+        _tile("quarantined submeshes", quarantined,
+              bad=quarantined > 0),
+        _tile("admission paused", paused, bad=paused > 0),
         _tile("requests", len(merged.get("requests") or [])),
     ])
     srv_rows = []
     for s in servers:
         ok = s["ok"] and s.get("healthz") == "ok"
-        mark = ('<span class="ok">✓ ok</span>' if ok else
-                f'<span class="err">✗ '
-                f"{_esc(s.get('error') or s.get('healthz'))}</span>")
+        degraded = bool(s.get("quarantined"))
+        mark = (f'<span class="err">✗ '
+                f"{_esc(s.get('error') or s.get('healthz'))}</span>"
+                if not ok else
+                '<span class="sev warn">● degraded</span>'
+                if degraded else '<span class="ok">✓ ok</span>')
+        rem = ((f"{s.get('quarantined')} quarantined"
+                if s.get("quarantined") else "")
+               + (" · paused" if s.get("admission_paused") else ""))
         srv_rows.append(
             f"<tr><td>{_esc(s['origin'])}</td><td>{mark}</td>"
             f'<td class="num">{_esc(s.get("firing", "-"))}</td>'
             f'<td class="num">{_esc(s.get("queue_depth", "-"))}</td>'
             f'<td class="num">{_esc(s.get("submeshes_busy", "-"))}/'
             f"{_esc(s.get('submeshes', '-'))}</td>"
+            f"<td>{_esc(rem or '—')}</td>"
             f'<td class="num">{_esc(s.get("requests", 0))}</td>'
             f'<td class="num">{_esc(s.get("uptime_s", "-"))}</td></tr>')
     body = (
         f'<div class="tiles">{tiles}</div>'
         "<h2>Servers</h2><table><tr><th>origin</th><th>health</th>"
-        "<th>firing</th><th>queue</th><th>busy</th><th>requests</th>"
+        "<th>firing</th><th>queue</th><th>busy</th>"
+        "<th>remediation</th><th>requests</th>"
         f"<th>uptime s</th></tr>{''.join(srv_rows)}</table>"
         "<h2>Alerts</h2><table><tr><th>origin</th><th>severity</th>"
         "<th>rule</th><th>state</th><th>fired</th><th>detail</th></tr>"

@@ -208,22 +208,36 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
         ages = getattr(ctx.server, "heartbeat_ages", lambda: {})()
         if not ages:
             return False, {}
-        # a request that has not produced its FIRST heartbeat yet is
-        # still warming up (empty progress snapshot): the gap includes
-        # XLA trace+compile on an executor-cache miss, which runs to
-        # minutes legitimately — judge it against the larger warmup
-        # threshold instead of false-firing a critical alert
+        # a request whose CURRENT dispatch has not heartbeat yet is
+        # still warming up: the gap includes XLA trace+compile on an
+        # executor-cache miss, which runs to minutes legitimately —
+        # judge it against the larger warmup threshold instead of
+        # false-firing a critical alert. Per DISPATCH, not per
+        # lifetime: a preempted request resuming on a cold submesh
+        # pays that compile again, and judging it by its old progress
+        # would re-fire stall mid-compile (and, under remediation,
+        # ping-pong the request between submeshes). Servers without
+        # the dispatch_heartbeats snapshot key (older/duck-typed) fall
+        # back to the empty-progress heuristic.
         reqs = (ctx.snapshot or {}).get("requests", {})
         worst = None
         for rid, age in ages.items():
-            warming = not (reqs.get(rid) or {}).get("progress")
+            snap_r = reqs.get(rid) or {}
+            if "dispatch_heartbeats" in snap_r:
+                warming = not snap_r["dispatch_heartbeats"]
+            else:
+                warming = not snap_r.get("progress")
             limit = th.stall_warmup_s if warming else th.stall_s
             if age > limit and (worst is None or age > worst[1]):
                 worst = (rid, age, limit, warming)
         if worst is None:
             return False, {}
+        # the submesh the stall was OBSERVED on rides the detail: a
+        # remediation action executing later must not act on a fresh
+        # dispatch that already moved elsewhere
         return True, {
             "request_id": worst[0],
+            "submesh": (reqs.get(worst[0]) or {}).get("submesh"),
             "heartbeat_age_s": round(worst[1], 3),
             "threshold_s": worst[2], "warming": worst[3]}
 
@@ -391,6 +405,12 @@ class HealthMonitor:
         self.interval_s = float(interval_s)
         self.alerts: dict[str, Alert] = {}    # guarded-by: self._lock
         self.history: dict[str, list] = {}    # guarded-by: self._lock
+        # alert-transition subscribers (the remediation controller's
+        # trigger feed): fn(rule_name, transition, alert_json) called
+        # AFTER the evaluation sweep releases the lock — a listener may
+        # take server/controller locks of its own without ordering
+        # against this monitor's
+        self.listeners: list = []             # guarded-by: self._lock
         self._g_alerts = self.registry.gauge(
             "tts_alerts",
             "alert state by rule (0 inactive, 0.5 pending, 1 firing)")
@@ -450,11 +470,21 @@ class HealthMonitor:
 
     # -------------------------------------------------------- evaluation
 
+    def add_listener(self, fn) -> None:
+        """Subscribe to alert transitions: ``fn(rule_name, transition,
+        alert_json)`` with transition in {"pending", "firing",
+        "resolved"}. Called outside the monitor's lock, after each
+        sweep; a raising listener is recorded and dropped from that
+        sweep's fan-out, never a monitor crash."""
+        with self._lock:
+            self.listeners.append(fn)
+
     def evaluate_now(self) -> dict:
         """One sweep: run every rule, advance lifecycles, publish, and
         append the history sample. Returns `alerts_snapshot()`."""
         now = time.time()
         ctx = _Ctx(self, now)
+        transitions: list[tuple[str, str, dict]] = []
         with self._lock:
             self.evaluations += 1
             self._c_evals.inc()
@@ -466,12 +496,30 @@ class HealthMonitor:
                     tracelog.event("alert.rule_error", rule=rule.name,
                                    error=repr(e))
                     continue
-                self._advance(rule, bool(active), detail or {}, now)
+                self._advance(rule, bool(active), detail or {}, now,
+                              transitions)
             self._sample_history(ctx, now)
+            listeners = list(self.listeners)
+        # fan transitions out OUTSIDE the lock: a listener (the
+        # remediation controller) takes server locks of its own, and a
+        # lock-ordering edge monitor->server would deadlock against the
+        # server's own snapshot calls into this monitor
+        for rule_name, transition, alert_json in transitions:
+            for fn in listeners:
+                try:
+                    fn(rule_name, transition, alert_json)
+                except Exception as e:  # noqa: BLE001 — observer tier
+                    tracelog.event("alert.listener_error",
+                                   rule=rule_name, error=repr(e))
         return self.alerts_snapshot()
 
     def _advance(self, rule: Rule, active: bool, detail: dict,
-                 now: float) -> None:    # holds: self._lock
+                 now: float, transitions: list | None = None
+                 ) -> None:    # holds: self._lock
+        def note(state: str, a: Alert) -> None:
+            if transitions is not None:
+                transitions.append((rule.name, state, a.to_json()))
+
         a = self.alerts.get(rule.name)
         labels = {"rule": rule.name, "severity": rule.severity}
         if active:
@@ -482,6 +530,7 @@ class HealthMonitor:
                 self.alerts[rule.name] = a
                 tracelog.event("alert.pending", **labels, **detail)
                 self._g_alerts.set(0.5, **labels)
+                note(PENDING, a)
             a.detail = detail
             if a.state == PENDING and now - a.since_unix >= rule.for_s:
                 a.state = FIRING
@@ -490,6 +539,7 @@ class HealthMonitor:
                 self._c_fired.inc(rule=rule.name)
                 tracelog.event("alert.firing", **labels, **detail)
                 self._g_alerts.set(1.0, **labels)
+                note(FIRING, a)
         elif a is not None and a.state != RESOLVED:
             was_firing = a.state == FIRING
             a.state = RESOLVED
@@ -500,6 +550,7 @@ class HealthMonitor:
                                firing_s=round(
                                    now - (a.firing_since_unix or now),
                                    3))
+                note(RESOLVED, a)
             # an unconfirmed pending that cleared is not an incident:
             # no resolved event, and the record drops so /alerts shows
             # only confirmed history

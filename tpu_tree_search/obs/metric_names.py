@@ -162,6 +162,14 @@ REGISTRY: dict[str, Metric] = _table(
            "per-device memory limit"),
     Metric("tts_host_rss_bytes", "gauge", "",
            "host process resident set"),
+    # --- self-healing (service/remediate.py)
+    Metric("tts_remediations_total", "counter", "rule,action,outcome",
+           "remediation decisions (outcome: applied/observed/"
+           "rate_limited/noop/skipped/failed/error)"),
+    Metric("tts_quarantined_submeshes", "gauge", "",
+           "submesh slots currently held out of the partition"),
+    Metric("tts_admission_paused", "gauge", "",
+           "1 while the remediation controller holds admission paused"),
     # --- health / audit / meta
     Metric("tts_alerts", "gauge", "rule,severity",
            "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),

@@ -31,6 +31,14 @@ class AdmissionError(RuntimeError):
         self.reason = reason
 
 
+class AdmissionPaused(AdmissionError):
+    """Rejected because the remediation tier is holding admission
+    paused (a TEMPORARY valve, e.g. a compile storm). Typed, not a
+    string protocol: the spool front-end must HOLD its backlog on this
+    and only this rejection — matching on the message wording would
+    turn a future rewording into silent backlog loss."""
+
+
 class RequestQueue:
     """Thread-safe bounded max-priority queue of RequestRecords.
 
@@ -93,13 +101,35 @@ class RequestQueue:
                            (-rec.request.priority, rec.seq, rec))
             self.peak_depth = max(self.peak_depth, self._depth())
 
-    def pop_best(self) -> RequestRecord | None:
-        """Highest-priority waiting request, or None if empty."""
+    def pop_best(self, eligible=None) -> RequestRecord | None:
+        """Highest-priority waiting request, or None if empty.
+
+        `eligible` (optional predicate over the record) lets the
+        scheduler pop per SLOT: the best request whose excluded-submesh
+        set allows the slot in hand, with every skipped (higher-
+        priority but ineligible) entry left in line at its original
+        position. With no predicate — or all-empty exclusion sets, the
+        TTS_REMEDIATE=0 default — this is exactly the old
+        highest-priority pop."""
         with self._lock:
             self._prune()
-            if not self._heap:
-                return None
-            return heapq.heappop(self._heap)[2]
+            if eligible is None:
+                if not self._heap:
+                    return None
+                return heapq.heappop(self._heap)[2]
+            skipped = []
+            found = None
+            while self._heap:
+                entry = heapq.heappop(self._heap)
+                if entry[2].state not in (QUEUED, PREEMPTED):
+                    continue        # stale (cancelled/expired in line)
+                if eligible(entry[2]):
+                    found = entry[2]
+                    break
+                skipped.append(entry)
+            for entry in skipped:
+                heapq.heappush(self._heap, entry)
+            return found
 
     def best_priority(self) -> int | None:
         """Priority of the head of the line (None if empty) — the
@@ -108,6 +138,14 @@ class RequestQueue:
             self._prune()
             return (self._heap[0][2].request.priority
                     if self._heap else None)
+
+    def peek_best(self) -> RequestRecord | None:
+        """The head of the line WITHOUT popping it — the scheduler's
+        preemption pass needs the record itself (its excluded-submesh
+        set decides whether a free slot actually helps it)."""
+        with self._lock:
+            self._prune()
+            return self._heap[0][2] if self._heap else None
 
     def count_priority_above(self, priority: int) -> int:
         """How many waiting requests outrank `priority` — the

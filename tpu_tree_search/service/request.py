@@ -42,6 +42,8 @@ FAILED = "FAILED"
 
 TERMINAL_STATES = frozenset({DONE, CANCELLED, DEADLINE, FAILED})
 
+FAILURE_LOG_CAP = 32        # failure_log entries kept per request
+
 
 @dataclasses.dataclass
 class SearchRequest:
@@ -123,6 +125,18 @@ class RequestRecord:
     last_heartbeat_t: float | None = None   # last engine heartbeat (or
                                         # dispatch) — the stall rule's
                                         # liveness signal
+    dispatch_heartbeats: int = 0        # heartbeats since the CURRENT
+                                        # dispatch started; 0 means the
+                                        # dispatch is still warming
+                                        # (possibly an XLA compile on a
+                                        # cold submesh), so the stall
+                                        # rule judges it against the
+                                        # warmup threshold — per
+                                        # DISPATCH, or a remediation
+                                        # preempt that resumes on a
+                                        # cold submesh would re-fire
+                                        # stall during the compile and
+                                        # ping-pong the request
     started_t: float | None = None      # current dispatch's start
     finished_t: float | None = None
     spent_prev_s: float = 0.0           # execution time of past dispatches
@@ -130,9 +144,28 @@ class RequestRecord:
     dispatches: int = 0
     preemptions: int = 0
     failures: int = 0                   # submesh failures (re-dispatched)
+    # one entry per dispatch failure: {"t", "submesh", "attempt",
+    # "error"} — the post-hoc diagnosis surface a dead-lettered FAILED
+    # record used to lack (it carried only the LAST error string).
+    # Bounded at FAILURE_LOG_CAP; always recorded, remediation on or off
+    failure_log: list = dataclasses.field(default_factory=list)
+    # submeshes this request must not be dispatched to again (the
+    # remediation tier appends the offender on failures/stall preempts;
+    # the scheduler honors it). Always empty while TTS_REMEDIATE is
+    # off — the default dispatch order is then bit-identical to the
+    # pre-remediation scheduler
+    excluded_submeshes: set = dataclasses.field(default_factory=set)
     error: str | None = None
     checkpoint_path: str | None = None
     hold: bool = False                  # preempted-and-held (ops drain)
+    # the request's PARSED fault plan (utils/faults), built once at
+    # first dispatch and reused on every redispatch so injection
+    # budgets (kill_submesh=SEG:N, fail_host_fetch=N) span the
+    # request's whole service lifetime — a drill fault follows the
+    # request like a real poisoned input, it does not re-arm per
+    # dispatch. (The GLOBAL TTS_FAULTS plan keeps the per-process
+    # re-arm model for respawned campaign workers.)
+    fault_plan: object | None = None
     progress: dict = dataclasses.field(default_factory=dict)
     result: object | None = None        # DistResult (final or partial)
     seq: int = 0                        # FIFO tiebreak within a priority
@@ -165,6 +198,8 @@ class RequestRecord:
             "dispatches": self.dispatches,
             "preemptions": self.preemptions,
             "failures": self.failures,
+            "failure_log": [dict(f) for f in self.failure_log],
+            "excluded_submeshes": sorted(self.excluded_submeshes),
             "spent_s": round(self.spent_s(), 3),
             "error": self.error,
             # flight-recorder cross-reference: filter the JSONL event
@@ -180,6 +215,7 @@ class RequestRecord:
                 round(time.monotonic() - self.last_heartbeat_t, 3)
                 if self.state == RUNNING
                 and self.last_heartbeat_t is not None else None),
+            "dispatch_heartbeats": self.dispatch_heartbeats,
             "progress": dict(self.progress),
         }
         res = self.result

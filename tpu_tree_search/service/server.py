@@ -69,9 +69,10 @@ from ..utils import config as cfg
 from ..utils import faults
 from ..utils.retry import backoff_delay
 from .executors import ExecutorCache
-from .queueing import AdmissionError, RequestQueue
-from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
-                      RUNNING, TERMINAL_STATES, RequestRecord, SearchRequest)
+from .queueing import AdmissionError, AdmissionPaused, RequestQueue
+from .request import (CANCELLED, DEADLINE, DONE, FAILED, FAILURE_LOG_CAP,
+                      PREEMPTED, QUEUED, RUNNING, TERMINAL_STATES,
+                      RequestRecord, SearchRequest)
 
 __all__ = ["SearchServer", "AdmissionError", "SearchRequest"]
 
@@ -99,6 +100,12 @@ class _Slot:
         self.record: RequestRecord | None = None
         self.thread: threading.Thread | None = None
         self.stop_event: threading.Event | None = None
+        # submesh quarantine (service/remediate): a quarantined slot is
+        # held out of the partition — the scheduler never dispatches to
+        # it — until the controller's canary probe readmits it
+        self.quarantined: bool = False
+        self.quarantined_since: float | None = None
+        self.quarantine_reason: str | None = None
 
     @property
     def device_ids(self) -> list[int]:
@@ -133,7 +140,8 @@ class SearchServer:
                  share_incumbent: bool | None = None,
                  aot_cache_dir: str | None = None,
                  tune_cache_dir: str | None = None,
-                 tune_at_boot: bool | None = None):
+                 tune_at_boot: bool | None = None,
+                 remediate: bool | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -312,11 +320,25 @@ class SearchServer:
         self.health = obs_health.HealthMonitor(
             server=self, registry=self.metrics,
             interval_s=health_interval_s)
+        # admission pause valve (the remediation controller's
+        # compile_storm action; None = admitting). A paused server
+        # REJECTS submit() with the reason — HTTP clients see 429 —
+        # while the file spool holds its backlog unserved instead
+        self._paused_reason: str | None = None  # guarded-by: self._lock
+        # self-healing (service/remediate): subscribes to the monitor
+        # above, so it must construct after it. remediate=None resolves
+        # TTS_REMEDIATE; the default (off) is OBSERVE-ONLY — detection
+        # and journaling run, zero actions are taken, behavior is
+        # bit-identical to the pre-remediation server
+        from .remediate import RemediationController
+        self.remediation = RemediationController(
+            self, enabled=remediate, registry=self.metrics)
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
                        overlap=self.overlap,
-                       share_incumbent=self.incumbents is not None)
+                       share_incumbent=self.incumbents is not None,
+                       remediate=self.remediation.enabled)
         if autostart:
             self.start()
 
@@ -376,6 +398,8 @@ class SearchServer:
         self.resources.close()
         # same valve for the health daemon and its tts_alerts series
         self.health.close()
+        # and the remediation worker (its journal stays readable)
+        self.remediation.close()
         # flush the AOT-cache writer so every compile paid this
         # lifetime is on disk for the next one (store() after this
         # point is a silent no-op — late executor threads on
@@ -401,6 +425,16 @@ class SearchServer:
             self.queue.rejected += 1
             tracelog.event("request.reject", reason="server closed")
             raise AdmissionError("server closed")
+        paused = self.admission_paused()
+        if paused is not None:
+            # the remediation controller's compile_storm valve: an
+            # explicit retry-later rejection (HTTP 429 through
+            # obs/httpd; the typed subclass tells the spool to HOLD),
+            # cleared when the alert resolves
+            self.queue.rejected += 1
+            tracelog.event("request.reject",
+                           reason=f"admission paused: {paused}")
+            raise AdmissionPaused(f"admission paused: {paused}")
         reason = request.validate()
         if reason is not None:
             self.queue.rejected += 1
@@ -699,6 +733,91 @@ class SearchServer:
             self.queue.requeue(rec)
             return True
 
+    # ----------------------------------------- remediation support API
+    # (service/remediate.RemediationController's actuation surface; the
+    # controller never reaches into server internals directly, and none
+    # of these run unless an action executes — TTS_REMEDIATE=1)
+
+    def pause_admission(self, reason: str) -> None:
+        """Reject new submissions with `reason` until resumed (the
+        spool front-end holds its backlog instead)."""
+        with self._lock:
+            self._paused_reason = reason
+        tracelog.event("server.admission_paused", reason=reason)
+
+    def resume_admission(self) -> None:
+        with self._lock:
+            was, self._paused_reason = self._paused_reason, None
+        if was is not None:
+            tracelog.event("server.admission_resumed")
+
+    def admission_paused(self) -> str | None:
+        """The pause reason, or None while admitting."""
+        with self._lock:
+            return self._paused_reason
+
+    def remediate_preempt(self, request_id: str,
+                          exclude_submesh: bool = True,
+                          expected_submesh: int | None = None
+                          ) -> tuple[bool, int | None]:
+        """Controller preemption: stop a RUNNING request at its next
+        segment boundary (checkpoint + requeue, like `preempt`) and —
+        by default — append its current submesh to the request's
+        excluded set so the resume lands elsewhere.
+        `expected_submesh` (when not None) must match the request's
+        CURRENT submesh — a stall observed on one submesh must not
+        preempt (and exclude!) a later dispatch that already moved to
+        a healthy one. Returns (preempted, excluded_submesh)."""
+        with self._lock:
+            rec = self.records.get(request_id)
+            if rec is None or rec.state != RUNNING:
+                return False, None
+            if expected_submesh is not None \
+                    and rec.submesh != expected_submesh:
+                return False, None
+            submesh = rec.submesh
+            if exclude_submesh and submesh is not None:
+                self.add_exclusion(rec, submesh)
+            rec.hold = False
+            if rec.stop_reason is None:
+                rec.stop_reason = "preempt"
+            self._stop_slot_of(rec)
+            return True, (submesh if exclude_submesh else None)
+
+    def add_exclusion(self, rec: RequestRecord, submesh: int) -> None:
+        """Exclude `submesh` for `rec` (caller may hold the lock — it
+        is an RLock). If the exclusions would cover the whole
+        partition, only the newest offender is kept (on a
+        single-submesh server: none at all) — a request must always
+        have somewhere left to run; one that genuinely fails
+        everywhere dead-letters through the failure path instead."""
+        with self._lock:
+            rec.excluded_submeshes.add(int(submesh))
+            if len(rec.excluded_submeshes) >= len(self.slots):
+                rec.excluded_submeshes = (
+                    {int(submesh)} if len(self.slots) > 1 else set())
+
+    def lowest_priority_running(self) -> str | None:
+        """The shed_memory action's victim: the lowest-priority,
+        youngest RUNNING request not already stopping."""
+        with self._lock:
+            cands = [s.record for s in self.slots
+                     if s.record is not None
+                     and s.record.state == RUNNING
+                     and s.record.stop_reason is None]
+            if not cands:
+                return None
+            return min(cands,
+                       key=lambda r: (r.request.priority,
+                                      -(r.started_t or 0.0))).id
+
+    def readmit_submesh(self, index: int) -> None:
+        """Clear a slot's quarantine (the canary probe passed)."""
+        with self._lock:
+            slot = self.slots[index]
+            slot.quarantined = False
+            slot.quarantine_reason = None
+
     def heartbeat_ages(self) -> dict:
         """Seconds since each RUNNING request's last engine heartbeat —
         the health layer's `stall` rule input (a wedged submesh stops
@@ -730,8 +849,10 @@ class SearchServer:
                           "rejected": self.queue.rejected},
                 "submeshes": [
                     {"index": s.index, "devices": s.device_ids,
-                     "running": s.record.id if s.record else None}
+                     "running": s.record.id if s.record else None,
+                     "quarantined": s.quarantined}
                     for s in self.slots],
+                "remediation": self.remediation.snapshot(),
                 "executor_cache": self.cache.snapshot(),
                 "aot_cache": (self.aot.snapshot()
                               if self.aot is not None else None),
@@ -830,11 +951,32 @@ class SearchServer:
                         and rec.over_deadline(now)):
                     rec.stop_reason = "deadline"
                     slot.stop_event.set()
-            # 2. dispatch to free submeshes
+            # 2. dispatch to free submeshes. Quarantined slots are held
+            # out of the partition; each pop honors the request's
+            # excluded-submesh set FOR THIS SLOT (skipped entries stay
+            # in line at their position). A request whose exclusions
+            # cover EVERY healthy (non-quarantined) slot is eligible
+            # anywhere again — trying the least-bad submesh beats
+            # stranding it QUEUED forever (exclusions can come to
+            # cover the partition later, when a quarantine shrinks it
+            # after the add_exclusion cap was applied). With
+            # remediation off both filters are vacuous and this is the
+            # pre-remediation scheduler exactly.
+            healthy = [s.index for s in self.slots
+                       if not s.quarantined]
+
+            def eligible_for(idx):
+                def ok(r):
+                    excl = r.excluded_submeshes
+                    return idx not in excl \
+                        or all(h in excl for h in healthy)
+                return ok
+
             for slot in self.slots:
-                if slot.record is not None:
+                if slot.record is not None or slot.quarantined:
                     continue
-                rec = self.queue.pop_best()
+                idx = slot.index
+                rec = self.queue.pop_best(eligible=eligible_for(idx))
                 while (rec is not None and rec.over_deadline(now)
                        and rec.dispatches > 0):
                     # a preempted request can exhaust its compute budget
@@ -846,20 +988,34 @@ class SearchServer:
                     # result, like the legacy campaign worker, instead
                     # of finalizing with no result at all
                     self._finalize(rec, DEADLINE)
-                    rec = self.queue.pop_best()
+                    rec = self.queue.pop_best(
+                        eligible=eligible_for(idx))
                 if rec is None:
-                    break
+                    continue
                 self._dispatch(slot, rec)
-            # 3. preemption: highest waiting priority vs running requests
-            best = self.queue.best_priority()
-            if best is None:
+            # 3. preemption: highest waiting priority vs running
+            # requests. Judged against the actual HEAD RECORD, not just
+            # its priority: a free slot only suppresses preemption if
+            # the head can USE it (a slot it is excluded from does not
+            # help — suppressing on it would priority-invert), and a
+            # victim is only worth stopping if its slot is one the head
+            # can run on.
+            head = self.queue.peek_best()
+            if head is None:
                 return
+            best = head.request.priority
             running = [s.record for s in self.slots
                        if s.record is not None
                        and s.record.state == RUNNING]
-            if not running or any(s.record is None for s in self.slots):
+            if not running or any(
+                    s.record is None and not s.quarantined
+                    and eligible_for(s.index)(head)
+                    for s in self.slots):
                 return
-            candidates = [r for r in running if r.stop_reason is None]
+            candidates = [r for r in running
+                          if r.stop_reason is None
+                          and r.submesh is not None
+                          and eligible_for(r.submesh)(head)]
             if not candidates:
                 return
             victim = min(candidates,
@@ -890,6 +1046,9 @@ class SearchServer:
         if rec.queued_t:
             self._m_queue_wait.observe(rec.started_t - rec.queued_t)
         rec.last_heartbeat_t = rec.started_t
+        rec.dispatch_heartbeats = 0     # this dispatch warms afresh
+        # (stall judges it against the warmup threshold until the
+        # engine heartbeats — a resume on a cold submesh pays a compile)
         tracelog.event("request.dispatch", request_id=rec.id,
                        submesh=slot.index, dispatch=rec.dispatches,
                        queue_depth=len(self.queue))
@@ -922,6 +1081,7 @@ class SearchServer:
 
         def hb(rep):
             rec.last_heartbeat_t = time.monotonic()
+            rec.dispatch_heartbeats += 1
             rec.progress = {
                 "segment": rep.segment, "iters": rep.iters,
                 "tree": rep.tree, "sol": rep.sol, "best": rep.best,
@@ -946,8 +1106,14 @@ class SearchServer:
                 self._publish_phases(rec, rep, unit_costs)
 
         # per-request fault injection stays thread-scoped: it must not
-        # leak into requests concurrently served on other submeshes
-        scope = (faults.scoped(req.faults) if req.faults is not None
+        # leak into requests concurrently served on other submeshes.
+        # The plan object is parsed ONCE per request and reused across
+        # redispatches so its injection budgets span the request's
+        # lifetime (see RequestRecord.fault_plan)
+        if req.faults is not None and rec.fault_plan is None:
+            rec.fault_plan = faults.FaultPlan.parse(req.faults)
+        scope = (faults.scoped(rec.fault_plan)
+                 if req.faults is not None
                  else contextlib.nullcontext())
         res = error = None
         # every record the engine emits from this thread (segment spans,
@@ -1076,7 +1242,29 @@ class SearchServer:
             if error is not None:
                 rec.failures += 1
                 rec.error = error
-                if (rec.failures <= self.service_retry_attempts
+                # the post-hoc diagnosis trail: EVERY failure lands in
+                # the record's failure_log (surfaced on /status and by
+                # tools/trace_summary.py), remediation on or off
+                rec.failure_log.append(
+                    {"t": time.time(), "submesh": slot.index,
+                     "attempt": rec.dispatches, "error": error})
+                del rec.failure_log[:-FAILURE_LOG_CAP]
+                # one flight-recorder entry per failure — including
+                # the TERMINAL one (redispatch events only cover the
+                # requeue path), so trace_summary can rebuild the
+                # complete failure_log from the trace alone
+                tracelog.event("request.dispatch_failure",
+                               request_id=rec.id, submesh=slot.index,
+                               attempt=rec.dispatches, error=error)
+                # remediation verdict: exclude the failing submesh /
+                # quarantine it / dead-letter a request whose failures
+                # followed it across distinct submeshes. Observe-only
+                # (the default) journals and returns "requeue" with
+                # zero state mutated — today's behavior exactly
+                verdict = self.remediation.on_dispatch_failure(
+                    rec, slot.index, error)
+                if (verdict == "requeue"
+                        and rec.failures <= self.service_retry_attempts
                         and not self._closing.is_set()):
                     # submesh failure: cool this slot down for the
                     # backoff, then put the request back in line — the
@@ -1091,6 +1279,13 @@ class SearchServer:
                     backoff = backoff_delay(rec.failures - 1,
                                             self.service_retry_base_s)
                     requeue = rec
+                elif verdict == "deadletter":
+                    self._finalize(
+                        rec, FAILED,
+                        error=f"dead-lettered: failed on "
+                              f"{len({f['submesh'] for f in rec.failure_log})} "
+                              f"distinct submeshes (the fault follows "
+                              f"the request); last: {error}")
                 else:
                     self._finalize(rec, FAILED, error=error)
             else:

@@ -151,7 +151,7 @@ def serve_spool(server, spool: str | pathlib.Path,
     an ``"error"``) — a client polling for it must not hang forever on
     a bad submission.
     """
-    from .queueing import AdmissionError
+    from .queueing import AdmissionError, AdmissionPaused
     from .request import TERMINAL_STATES
 
     spool = pathlib.Path(spool)
@@ -162,13 +162,32 @@ def serve_spool(server, spool: str | pathlib.Path,
     last_work = time.monotonic()
     last_status = 0.0
     while True:
-        for sid, req_file in unserved_requests(spool, skip=seen):
+        # while the remediation tier holds admission paused
+        # (compile_storm), the backlog WAITS in the spool instead of
+        # being turned into permanent REJECTED results — the pause is a
+        # temporary valve, and a spooled file carries its own retry
+        paused = getattr(server, "admission_paused",
+                         lambda: None)()
+        for sid, req_file in ([] if paused is not None
+                              else unserved_requests(spool, skip=seen)):
             seen.add(sid)
             try:
                 payload = json.loads(req_file.read_text())
                 rid = server.submit(request_from_payload(payload))
-            except (AdmissionError, ValueError, KeyError,
-                    json.JSONDecodeError) as e:
+            except AdmissionPaused:
+                # the pause engaged between this loop's paused check
+                # and the submit: HOLD the file (back out of `seen` so
+                # the next poll retries it) — a temporary valve must
+                # never turn backlog into permanent REJECTED results
+                seen.discard(sid)
+                break
+            except AdmissionError as e:
+                _atomic_write_json(
+                    spool / f"{sid}{RES_SUFFIX}",
+                    {"spool_id": sid, "state": "REJECTED",
+                     "error": str(e)})
+                continue
+            except (ValueError, KeyError, json.JSONDecodeError) as e:
                 _atomic_write_json(
                     spool / f"{sid}{RES_SUFFIX}",
                     {"spool_id": sid, "state": "REJECTED",
@@ -182,8 +201,11 @@ def serve_spool(server, spool: str | pathlib.Path,
                                    {"spool_id": sid, **snap})
                 del pending[sid]
                 served += 1
-        busy = bool(pending) or len(server.queue) > 0 or any(
-            s.record is not None for s in server.slots)
+        # a paused server is mid-incident, not idle: the idle-exit
+        # clock must not shut it down on top of a held backlog
+        busy = bool(pending) or paused is not None \
+            or len(server.queue) > 0 or any(
+                s.record is not None for s in server.slots)
         now = time.monotonic()
         if busy:
             last_work = now

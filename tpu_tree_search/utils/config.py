@@ -277,6 +277,28 @@ TUNE_WINDOW_ITERS_DEFAULT = 24    # TTS_TUNE_WINDOW — measured iters
 TUNE_WARM_ITERS_DEFAULT = 200     # TTS_TUNE_WARM — warm-up iters
                                   # before a probe's measured window
 
+# Self-healing (service/remediate.py + serve --remediate).
+# TTS_REMEDIATE=1 lets the RemediationController EXECUTE its policy
+# table (stall -> preempt+exclude, repeated localized failures ->
+# submesh quarantine + canary readmit, cross-submesh failures ->
+# dead-letter, compile_storm -> pause admission, mem_headroom ->
+# shed + ladder demotion hint, audit -> checkpoint quarantine). The
+# default (off) is OBSERVE-ONLY: detection and journaling run, zero
+# actions are taken — the same bit-identical-off discipline as
+# overlap/ladder. Every executed action is capped per rule per sliding
+# window; the quarantine/dead-letter thresholds below are the
+# containment geometry (failures localized to ONE submesh = hardware,
+# quarantine it; failures FOLLOWING the request across >= K distinct
+# submeshes = the request, dead-letter it).
+REMEDIATE_FLAG = "TTS_REMEDIATE"              # default off (observe)
+REMEDIATE_WINDOW_S_DEFAULT = 300.0            # TTS_REMEDIATE_WINDOW_S
+REMEDIATE_MAX_PER_RULE_DEFAULT = 4            # TTS_REMEDIATE_MAX_PER_RULE
+REMEDIATE_QUARANTINE_FAILS_DEFAULT = 3        # TTS_REMEDIATE_QUARANTINE_FAILS
+REMEDIATE_DEADLETTER_SUBMESHES_DEFAULT = 3    # TTS_REMEDIATE_DEADLETTER_SUBMESHES
+REMEDIATE_PROBE_S_DEFAULT = 30.0              # TTS_REMEDIATE_PROBE_S —
+                                              # canary cooldown after a
+                                              # quarantine/failed probe
+
 
 # --------------------------------------------------------- knob registry
 #
@@ -418,6 +440,29 @@ KNOBS: dict[str, Knob] = _knob_table(
          "audit rule: how long a failure keeps the alert firing"),
     Knob("TTS_HEALTH_PERF_JSON", "str", None,
          "perf rule: path to a perf_sentry --json verdict file"),
+    # --- self-healing (service/remediate.py; semantics per README
+    #     "Self-healing")
+    Knob("TTS_REMEDIATE", "flag", False,
+         "execute the remediation policy table (default: observe-only "
+         "— detection and journaling run, zero actions taken)"),
+    Knob("TTS_REMEDIATE_WINDOW_S", "float", REMEDIATE_WINDOW_S_DEFAULT,
+         "sliding window for the action rate valve and the "
+         "localized-failure quarantine count"),
+    Knob("TTS_REMEDIATE_MAX_PER_RULE", "int",
+         REMEDIATE_MAX_PER_RULE_DEFAULT,
+         "executed actions allowed per rule per window (reversals "
+         "exempt); beyond it a flapping rule degrades to observe-only"),
+    Knob("TTS_REMEDIATE_QUARANTINE_FAILS", "int",
+         REMEDIATE_QUARANTINE_FAILS_DEFAULT,
+         "dispatch failures localized to one submesh inside the window "
+         "before it is quarantined (drained, held out, canary-probed)"),
+    Knob("TTS_REMEDIATE_DEADLETTER_SUBMESHES", "int",
+         REMEDIATE_DEADLETTER_SUBMESHES_DEFAULT,
+         "distinct submeshes a request may fail on before it "
+         "dead-letters as FAILED with its full failure_log"),
+    Knob("TTS_REMEDIATE_PROBE_S", "float", REMEDIATE_PROBE_S_DEFAULT,
+         "canary-probe cooldown: seconds after a quarantine (or a "
+         "failed probe) before the synthetic micro-request retries"),
     # --- XLA persistent compile cache
     Knob("TTS_NO_COMPILE_CACHE", "flag", False,
          "opt out of XLA's persistent compilation cache"),

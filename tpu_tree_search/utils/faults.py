@@ -31,6 +31,33 @@ programmatically via :func:`configure`:
                                              # many wall-clock segments so
                                              # preemption/deadline tests
                                              # have a window to act in)
+    TTS_FAULTS="kill_submesh=2:1@0"          # raise InjectedKill at the
+                                             # start of segment 2, at most
+                                             # 1 time, only on submesh 0 —
+                                             # a submesh dying mid-request
+                                             # (the thread-level analogue
+                                             # of kill_after_segment; the
+                                             # service retry/remediation
+                                             # tier is the recovery)
+    TTS_FAULTS="oom_segment=2"               # raise InjectedOOM (a
+                                             # RESOURCE_EXHAUSTED-shaped
+                                             # transient) at segment 2
+    TTS_FAULTS="wedge_executor=2:5.0"        # sleep 5 s at the start of
+                                             # segment 2, once — a wedged
+                                             # device dispatch: heartbeats
+                                             # stop, the health layer's
+                                             # stall rule fires, the
+                                             # remediation drill acts
+
+The chaos-drill kinds (kill_submesh / oom_segment / wedge_executor)
+accept an optional ``@SUBMESH`` suffix: the injection fires only in a
+thread whose ambient flight-recorder context (obs/tracelog) carries
+that submesh index — so a GLOBAL plan can target one submesh of a
+serving mesh while requests on the other submeshes run clean, which is
+exactly the failure geometry the quarantine path exists for.
+kill_submesh and oom_segment also take a fire budget
+(``kill_submesh=SEG:BUDGET``, default 1) counted on the plan like
+fail_host_fetch; wedge_executor fires at most once per plan.
 
 Specs compose: ``"delay_segment=2:0.1,kill_after_segment=4"``. Unknown
 names raise at parse time — a typo'd fault spec that silently injects
@@ -61,6 +88,18 @@ class InjectedFault(RuntimeError):
     """A deliberately injected transient fault (retryable by design)."""
 
 
+class InjectedKill(InjectedFault):
+    """A submesh 'died' under this request (kill_submesh): the dispatch
+    is gone, the thread survives. Transient-class on purpose — the
+    service retry/remediation tier redispatches elsewhere."""
+
+
+class InjectedOOM(InjectedFault):
+    """An injected device OOM (oom_segment) — the message mimics the
+    runtime's RESOURCE_EXHAUSTED wording so log-greppers treat drills
+    and real incidents alike."""
+
+
 # exit code used by the kill injection; distinct from Python tracebacks
 # (1) and the campaign's wrong-answer abort (3), and conventionally
 # SIGKILL's 128+9 — what a real preemption looks like to the supervisor
@@ -77,10 +116,19 @@ class FaultPlan:
     delay_segment: tuple[int, float] | None = None   # (segment, seconds)
     delay_every: float = 0.0                 # sleep before EVERY segment
     fail_host_fetch: int = 0                 # fail the first N fetches
+    # chaos-drill kinds (the self-healing service's reproducible fault
+    # geometry): (segment, budget, submesh|None) for the raisers,
+    # (segment, seconds, submesh|None) for the wedge
+    kill_submesh: tuple[int, int, int | None] | None = None
+    oom_segment: tuple[int, int, int | None] | None = None
+    wedge_executor: tuple[int, float, int | None] | None = None
     # fire count lives ON the plan (not module state): a thread-scoped
     # plan must have its own injection budget — concurrent requests with
     # scoped plans would otherwise spend each other's failures
     fetch_failures_fired: int = dataclasses.field(default=0, repr=False)
+    kills_fired: int = dataclasses.field(default=0, repr=False)
+    ooms_fired: int = dataclasses.field(default=0, repr=False)
+    wedges_fired: int = dataclasses.field(default=0, repr=False)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -102,10 +150,41 @@ class FaultPlan:
                 plan.delay_every = float(val)
             elif name == "fail_host_fetch":
                 plan.fail_host_fetch = int(val)
+            elif name == "kill_submesh":
+                plan.kill_submesh = _parse_drill(val, int, 1)
+            elif name == "oom_segment":
+                plan.oom_segment = _parse_drill(val, int, 1)
+            elif name == "wedge_executor":
+                plan.wedge_executor = _parse_drill(val, float, 5.0)
             else:
                 raise ValueError(
                     f"unknown fault {name!r} in TTS_FAULTS spec {spec!r}")
         return plan
+
+
+def _parse_drill(val: str, second_type, second_default):
+    """Parse a chaos-drill value ``SEG[:X][@SUBMESH]`` into
+    (segment, x, submesh|None) — x is the fire budget (kill/oom) or the
+    wedge seconds, submesh the optional ambient-context filter."""
+    body, _, submesh = val.partition("@")
+    seg, _, x = body.partition(":")
+    return (int(seg),
+            second_type(x) if x.strip() else second_type(second_default),
+            int(submesh) if submesh.strip() else None)
+
+
+def _ambient_submesh() -> int | None:
+    """The submesh index of the calling thread's flight-recorder
+    context (obs/tracelog) — how an @SUBMESH-filtered drill decides
+    whether THIS thread is on the targeted submesh. None outside any
+    service executor/canary context (the filter then never matches)."""
+    from ..obs import tracelog
+    sm = tracelog.current_context().get("submesh")
+    return int(sm) if sm is not None else None
+
+
+def _submesh_matches(target: int | None) -> bool:
+    return target is None or _ambient_submesh() == target
 
 
 # module state: the active global plan (fire counters live on the plan)
@@ -181,7 +260,14 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
 
     Points (all no-ops without a matching plan entry):
     - "segment_start"   (segment=k): sleep delay_every (every segment)
-      and/or the delay_segment sleep if it targets k.
+      and/or the delay_segment sleep if it targets k. The chaos-drill
+      kinds fire here too, before the segment dispatches: wedge_executor
+      sleeps its seconds (once per plan — a wedged dispatch), then
+      kill_submesh raises InjectedKill / oom_segment raises InjectedOOM
+      while their budgets last, each gated on the optional @SUBMESH
+      ambient-context filter. Raising BEFORE the dispatch keeps the
+      failure checkpoint-exact: segment k never ran, so a redispatch
+      resuming from segment k-1's snapshot repeats nothing.
     - "post_checkpoint" (segment=k, path=...): corrupt the just-written
       checkpoint file if corrupt_checkpoint targets k.
     - "post_segment"    (segment=k): os._exit(KILL_EXIT_CODE) if
@@ -204,6 +290,41 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
             _record(point, "delay_segment", segment=segment,
                     seconds=plan.delay_segment[1])
             time.sleep(plan.delay_segment[1])
+        if (plan.wedge_executor is not None
+                and segment == plan.wedge_executor[0]
+                and plan.wedges_fired < 1
+                and _submesh_matches(plan.wedge_executor[2])):
+            plan.wedges_fired += 1
+            seconds = plan.wedge_executor[1]
+            _record(point, "wedge_executor", segment=segment,
+                    seconds=seconds, submesh=_ambient_submesh())
+            # an uninterruptible sleep is the POINT: a wedged device
+            # dispatch does not honor stop flags either — recovery is
+            # the remediation tier acting from outside, never the
+            # wedge cooperating. Keep drill durations bounded.
+            time.sleep(seconds)
+        if (plan.kill_submesh is not None
+                and segment == plan.kill_submesh[0]
+                and plan.kills_fired < plan.kill_submesh[1]
+                and _submesh_matches(plan.kill_submesh[2])):
+            plan.kills_fired += 1
+            _record(point, "kill_submesh", segment=segment,
+                    fired=plan.kills_fired, budget=plan.kill_submesh[1],
+                    submesh=_ambient_submesh())
+            raise InjectedKill(
+                f"injected submesh kill at segment {segment} "
+                f"({plan.kills_fired}/{plan.kill_submesh[1]})")
+        if (plan.oom_segment is not None
+                and segment == plan.oom_segment[0]
+                and plan.ooms_fired < plan.oom_segment[1]
+                and _submesh_matches(plan.oom_segment[2])):
+            plan.ooms_fired += 1
+            _record(point, "oom_segment", segment=segment,
+                    fired=plan.ooms_fired, budget=plan.oom_segment[1],
+                    submesh=_ambient_submesh())
+            raise InjectedOOM(
+                f"RESOURCE_EXHAUSTED: injected device OOM at segment "
+                f"{segment} ({plan.ooms_fired}/{plan.oom_segment[1]})")
     elif point == "post_checkpoint":
         if (plan.corrupt_checkpoint is not None
                 and segment == plan.corrupt_checkpoint
