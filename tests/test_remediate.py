@@ -339,8 +339,8 @@ def test_spool_holds_backlog_when_pause_lands_mid_iteration(tmp_path):
         def admission_paused(self):
             return None     # the loop's upfront check sees "admitting"
 
-        def submit(self, request):
-            raise self.exc
+        def submit(self, request, **kw):   # kw: spool_id (the ledger's
+            raise self.exc                 # result-delivery reconnect key)
 
         def status(self, rid):
             raise AssertionError("nothing should be pending")

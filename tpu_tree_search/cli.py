@@ -253,6 +253,31 @@ def _serve_parser(sub):
                         "failures quarantine the bad checkpoint. "
                         "Default: observe-only — the controller logs "
                         "the action it WOULD take and touches nothing")
+    p.add_argument("--ledger", type=str, default=None,
+                   help="durable request-ledger directory (also via "
+                        "TTS_LEDGER; service/ledger.py): every request "
+                        "state transition is journaled (fsync'd, "
+                        "CRC-stamped JSONL) BEFORE it is acknowledged "
+                        "— a POST /submit 200 becomes a durability "
+                        "promise — and a restarted server REPLAYS the "
+                        "ledger at boot: queued/active requests "
+                        "re-admit with budgets/exclusions/failure "
+                        "logs intact and resume from their "
+                        "checkpoints, terminal results re-serve "
+                        "idempotently, quarantines and admission "
+                        "pauses are restored. Pairs with a persistent "
+                        "--workdir (default with --ledger: "
+                        "<ledger>/workdir). Default: off")
+    p.add_argument("--drain-timeout", type=float, default=None,
+                   help="graceful SIGTERM/SIGINT drain budget in "
+                        "seconds (also via TTS_DRAIN_TIMEOUT_S, "
+                        f"default {_cfg.DRAIN_TIMEOUT_S_DEFAULT:g}): "
+                        "stop admission, preempt running requests at "
+                        "segment boundaries (checkpointed), drain the "
+                        "checkpoint/AOT/ledger writers, exit 0; past "
+                        "the budget the process checkpoint-and-aborts "
+                        "(nonzero exit — with --ledger the abort is "
+                        "itself recoverable)")
     p.add_argument("--prewarm", type=str, nargs="?", const="",
                    default=None, metavar="SPEC",
                    help="boot pre-warm: ready compiled loops BEFORE "
@@ -291,7 +316,53 @@ def _client_parser(sub):
                    help="give up waiting for the result after N seconds")
 
 
+# exit code of the drain-timeout escalation (checkpoint-and-abort):
+# distinct from clean drains (0), tracebacks (1) and the injected hard
+# kill (137) so a supervisor's restart policy can tell them apart
+DRAIN_ESCALATE_EXIT_CODE = 70
+
+
+def _install_drain_handlers(drain_evt, timeout_s: float):
+    """SIGTERM/SIGINT -> graceful drain: set `drain_evt` (the serve
+    loop exits, the server close() preempts at segment boundaries and
+    drains every writer) and arm the escalation watchdog — a drain
+    that cannot finish inside `timeout_s` checkpoint-and-aborts
+    instead of hanging the pod's termination grace period. A second
+    signal escalates immediately. Returns False when handlers cannot
+    be installed (not the main thread — in-process tests)."""
+    import os as _os
+    import signal
+    import threading
+
+    def _escalate():
+        from .obs import tracelog
+        tracelog.event("server.drain_escalated", timeout_s=timeout_s)
+        print(f"drain exceeded {timeout_s:g}s: checkpoint-and-abort",
+              flush=True)
+        _os._exit(DRAIN_ESCALATE_EXIT_CODE)
+
+    def _handler(signum, frame):
+        if drain_evt.is_set():
+            _os._exit(DRAIN_ESCALATE_EXIT_CODE)
+        print(f"signal {signum}: draining (budget {timeout_s:g}s)",
+              flush=True)
+        drain_evt.set()
+        t = threading.Timer(timeout_s, _escalate)
+        t.daemon = True
+        t.start()
+        drain_evt.watchdog = t
+
+    try:
+        signal.signal(signal.SIGTERM, _handler)
+        signal.signal(signal.SIGINT, _handler)
+    except ValueError:      # not the main thread
+        return False
+    return True
+
+
 def run_serve(args) -> int:
+    import threading
+
     from .obs import tracelog
     from .service import SearchServer, spool
     from .utils import config as _cfg
@@ -314,6 +385,15 @@ def run_serve(args) -> int:
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
+    # --ledger passes straight through: SearchServer resolves the
+    # TTS_LEDGER env fallback itself (one resolution site) and, with a
+    # ledger and no explicit --workdir, defaults the workdir to
+    # <ledger>/workdir — checkpoints must survive the restart the
+    # ledger exists for
+    drain_evt = threading.Event()
+    drain_timeout = (args.drain_timeout if args.drain_timeout is not None
+                     else _cfg.env_float("TTS_DRAIN_TIMEOUT_S"))
+    _install_drain_handlers(drain_evt, drain_timeout)
     httpd = None
     try:
         with SearchServer(n_submeshes=args.submeshes,
@@ -330,11 +410,21 @@ def run_serve(args) -> int:
                           aot_cache_dir=args.aot_cache,
                           tune_cache_dir=args.tune_cache,
                           tune_at_boot=(True if args.tune else None),
-                          remediate=(True if args.remediate else None)
+                          remediate=(True if args.remediate else None),
+                          ledger_dir=args.ledger
                           ) as srv:
             print(f"remediation: "
                   f"{'ACT' if srv.remediation.enabled else 'observe'}"
                   f"-mode (TTS_REMEDIATE)", flush=True)
+            if srv.ledger is not None:
+                led = srv.ledger.snapshot()
+                rec = srv._recovered
+                print(f"ledger: {led['dir']} (restart "
+                      f"#{led['restarts']}, replayed "
+                      f"{led['replayed']} record(s), recovered "
+                      f"{rec['queued']}q/{rec['active']}a/"
+                      f"{rec['held']}h/{rec['terminal']}t, "
+                      f"truncated {led['truncated']})", flush=True)
             if srv.aot is not None:
                 print(f"aot cache: {srv.aot.root} "
                       f"({srv.aot.entries()} entr(y/ies))", flush=True)
@@ -394,7 +484,11 @@ def run_serve(args) -> int:
             served = spool.serve_spool(
                 srv, args.spool, idle_exit_s=args.idle_exit,
                 status_every_s=args.status_every or None,
-                emit=lambda s: print(s, flush=True))
+                emit=lambda s: print(s, flush=True),
+                should_exit=drain_evt.is_set)
+            # the `with` close() below IS the drain: stop at segment
+            # boundaries, checkpoint, flush the async checkpoint/AOT/
+            # ledger writers — the watchdog escalates if it wedges
     finally:
         if httpd is not None:
             httpd.close()
@@ -404,6 +498,11 @@ def run_serve(args) -> int:
                             endpoint=args.otel_endpoint)
             print(f"otel: exported {n} span(s) to "
                   f"{args.otel_endpoint}", flush=True)
+    watchdog = getattr(drain_evt, "watchdog", None)
+    if watchdog is not None:
+        watchdog.cancel()       # drained inside the budget: exit 0
+    if drain_evt.is_set():
+        print("drained cleanly", flush=True)
     print(f"served {served} request(s)", flush=True)
     return 0
 
@@ -559,11 +658,17 @@ def run_doctor(args) -> int:
             rem_col = (f" quarantined={s.get('quarantined')}"
                        if s.get("quarantined") else "") + (
                        f" PAUSED({paused})" if paused else "")
+            led_col = ""
+            if s.get("restarts") is not None:
+                led_col = (f" restarts={s.get('restarts')}"
+                           f" recovered={s.get('recovered_requests')}"
+                           f" ledger_lag_s={s.get('ledger_lag_s')}")
             print(f"{s['origin']:<24} {mark:<10} "
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
-                  f"requests={s.get('requests')}{aot_col}{rem_col}")
+                  f"requests={s.get('requests')}{aot_col}{rem_col}"
+                  f"{led_col}")
         print("healthy" if healthy else
               "UNHEALTHY:\n  " + "\n  ".join(reasons))
     return 0 if healthy else 1

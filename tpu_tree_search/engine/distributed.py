@@ -447,6 +447,17 @@ def _to_mesh(mesh, spec_leaf, x):
         sharding = NamedSharding(mesh, spec_leaf)
         return jax.make_array_from_callback(
             np.shape(x), sharding, lambda idx: np.asarray(x)[idx])
+    if np.asarray(x).size == 0:
+        # a zero-width leaf (the telemetry block with the flag off) is
+        # DEAD in the loop body, so sharding propagation cannot pin it:
+        # lowered from a plain host array it compiles REPLICATED, while
+        # every later segment passes the loop's P(AX)-sharded output —
+        # an AOT executable then rejects the second call and falls back
+        # to jit (one hidden recompile per served shape). Commit it on
+        # the worker axis explicitly, like abstract_state does for the
+        # pre-warm lowering, so call 1 and call N agree.
+        from jax.sharding import NamedSharding
+        return jax.device_put(x, NamedSharding(mesh, spec_leaf))
     return x
 
 

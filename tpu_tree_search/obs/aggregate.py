@@ -34,7 +34,17 @@ import urllib.error
 import urllib.request
 
 __all__ = ["parse_prometheus", "scrape_one", "scrape", "merge",
-           "fleet_to_prometheus", "verdict"]
+           "fleet_to_prometheus", "verdict", "recovered_live"]
+
+
+def recovered_live(ledger: dict | None) -> int:
+    """LIVE work brought back by a ledger replay (queued/active/held).
+    Replayed terminal snapshots are idempotency bookkeeping, not
+    recovered requests — counting them would make a routine restart
+    read as thousands recovered. THE definition for the doctor column
+    and the dashboard tile (obs/dashboard), so the two cannot drift."""
+    return sum(v for k, v in ((ledger or {}).get("recovered")
+                              or {}).items() if k != "terminal")
 
 
 def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
@@ -139,7 +149,11 @@ def merge(fleet: dict) -> dict:
                "firing": None, "queue_depth": None, "submeshes": None,
                "submeshes_busy": None, "requests": 0, "uptime_s": None,
                "aot_cache": None, "quarantined": 0,
-               "admission_paused": None}
+               "admission_paused": None,
+               # crash-safe serving (service/ledger): None on a server
+               # running without a ledger
+               "restarts": None, "recovered_requests": None,
+               "ledger_lag_s": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -158,6 +172,14 @@ def merge(fleet: dict) -> dict:
             rem = st.get("remediation") or {}
             row["quarantined"] = len(rem.get("quarantined") or [])
             row["admission_paused"] = rem.get("admission_paused")
+            # the durable-ledger facts: restart count, requests this
+            # lifetime recovered by replay, and journal staleness —
+            # the doctor's crash-recovery columns
+            led = st.get("ledger")
+            if led:
+                row["restarts"] = led.get("restarts")
+                row["recovered_requests"] = recovered_live(led)
+                row["ledger_lag_s"] = led.get("lag_s")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             for rid, snap in reqs.items():

@@ -216,6 +216,16 @@ def render_server(snapshot: dict | None, alerts: dict | None,
     rem = snapshot.get("remediation") or {}
     n_quar = len(rem.get("quarantined") or [])
     paused = rem.get("admission_paused")
+    led = snapshot.get("ledger") or {}
+    led_tiles = []
+    if led:
+        from .aggregate import recovered_live
+        led_tiles = [
+            _tile("restarts", led.get("restarts", 0)),
+            _tile("recovered", recovered_live(led)),
+            _tile("ledger lag s", led.get("lag_s")
+                  if led.get("lag_s") is not None else "—"),
+        ]
     tiles = "".join([
         _tile("firing alerts", firing, bad=firing > 0),
         _tile("queue depth", queue.get("depth", 0)),
@@ -229,7 +239,7 @@ def render_server(snapshot: dict | None, alerts: dict | None,
         _tile("preemptions", counters.get("preemptions", 0)),
         _tile("cache hit/miss", f"{cache.get('hits', 0)}/"
                                 f"{cache.get('misses', 0)}"),
-    ])
+    ] + led_tiles)
     sparks = []
     for name, points in sorted((history or {}).items()):
         svg = sparkline_svg(points)
@@ -289,6 +299,10 @@ def render_fleet(merged: dict) -> str:
         rem = ((f"{s.get('quarantined')} quarantined"
                 if s.get("quarantined") else "")
                + (" · paused" if s.get("admission_paused") else ""))
+        led = ("—" if s.get("restarts") is None else
+               f"{s.get('restarts')} restart(s) · "
+               f"{s.get('recovered_requests')} recovered · "
+               f"lag {s.get('ledger_lag_s')}s")
         srv_rows.append(
             f"<tr><td>{_esc(s['origin'])}</td><td>{mark}</td>"
             f'<td class="num">{_esc(s.get("firing", "-"))}</td>'
@@ -296,13 +310,14 @@ def render_fleet(merged: dict) -> str:
             f'<td class="num">{_esc(s.get("submeshes_busy", "-"))}/'
             f"{_esc(s.get('submeshes', '-'))}</td>"
             f"<td>{_esc(rem or '—')}</td>"
+            f"<td>{_esc(led)}</td>"
             f'<td class="num">{_esc(s.get("requests", 0))}</td>'
             f'<td class="num">{_esc(s.get("uptime_s", "-"))}</td></tr>')
     body = (
         f'<div class="tiles">{tiles}</div>'
         "<h2>Servers</h2><table><tr><th>origin</th><th>health</th>"
         "<th>firing</th><th>queue</th><th>busy</th>"
-        "<th>remediation</th><th>requests</th>"
+        "<th>remediation</th><th>ledger</th><th>requests</th>"
         f"<th>uptime s</th></tr>{''.join(srv_rows)}</table>"
         "<h2>Alerts</h2><table><tr><th>origin</th><th>severity</th>"
         "<th>rule</th><th>state</th><th>fired</th><th>detail</th></tr>"

@@ -287,8 +287,14 @@ class ObsHttpd:
             code = 503 if self._closing() else 429
             return code, json.dumps({"error": str(e)}) + "\n", \
                 "application/json"
+        # real state, not an assumed "QUEUED": the ledger's idempotent
+        # re-serve path can answer with an already-DONE request id
+        try:
+            state = self.server.status(rid)["state"]
+        except KeyError:
+            state = "QUEUED"
         return 200, json.dumps(
-            {"request_id": rid, "state": "QUEUED"}) + "\n", \
+            {"request_id": rid, "state": state}) + "\n", \
             "application/json"
 
     @property

@@ -162,10 +162,26 @@ REGISTRY: dict[str, Metric] = _table(
            "per-device memory limit"),
     Metric("tts_host_rss_bytes", "gauge", "",
            "host process resident set"),
+    # --- crash-safe serving (service/ledger.py)
+    Metric("tts_server_restarts_total", "counter", "",
+           "server boots that replayed prior request-ledger state "
+           "(fed from the ledger's boot count, so it survives the "
+           "registry reset a restart is)"),
+    Metric("tts_ledger_records_total", "counter", "kind",
+           "request-ledger records appended (each fsync'd before the "
+           "transition it journals is acknowledged)"),
+    Metric("tts_ledger_replayed_total", "counter", "",
+           "ledger records replayed at boot"),
+    Metric("tts_ledger_truncated_total", "counter", "",
+           "corrupt-tail ledger records discarded at replay "
+           "(truncate-to-last-good)"),
+    Metric("tts_ledger_errors_total", "counter", "",
+           "failed ledger appends (ENOSPC/IO): crash-durability "
+           "degraded until the disk recovers — alert on it"),
     # --- self-healing (service/remediate.py)
     Metric("tts_remediations_total", "counter", "rule,action,outcome",
            "remediation decisions (outcome: applied/observed/"
-           "rate_limited/noop/skipped/failed/error)"),
+           "rate_limited/noop/skipped/failed/error/restored)"),
     Metric("tts_quarantined_submeshes", "gauge", "",
            "submesh slots currently held out of the partition"),
     Metric("tts_admission_paused", "gauge", "",

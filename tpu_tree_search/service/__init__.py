@@ -13,17 +13,22 @@ Public surface:
   recompiling (aot_cache.py)
 - `spool` — file-based front-end used by the `serve`/`client` CLI
   (spool.py)
+- `RequestLedger` — durable write-ahead journal of request state
+  transitions: a hard-killed server replays it at boot and resumes
+  every request (ledger.py)
 """
 
 from .aot_cache import AOTCache
 from .executors import ExecutorCache
+from .ledger import RequestLedger
 from .queueing import AdmissionError, RequestQueue
 from .request import (CANCELLED, DEADLINE, DONE, FAILED, PREEMPTED, QUEUED,
                       RUNNING, TERMINAL_STATES, RequestRecord, SearchRequest)
 from .server import SearchServer
 
 __all__ = [
-    "AdmissionError", "AOTCache", "ExecutorCache", "RequestQueue",
+    "AdmissionError", "AOTCache", "ExecutorCache", "RequestLedger",
+    "RequestQueue",
     "RequestRecord",
     "SearchRequest", "SearchServer",
     "QUEUED", "RUNNING", "PREEMPTED", "DONE", "CANCELLED", "DEADLINE",

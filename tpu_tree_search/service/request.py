@@ -167,6 +167,11 @@ class RequestRecord:
     # re-arm model for respawned campaign workers.)
     fault_plan: object | None = None
     progress: dict = dataclasses.field(default_factory=dict)
+    # last time this request's cumulative spent_s was journaled to the
+    # request ledger (service/ledger) — the heartbeat hook throttles
+    # budget records to LEDGER_BUDGET_EVERY_S so a fast-heartbeating
+    # request does not fsync the journal at heartbeat rate
+    ledger_budget_t: float = 0.0
     result: object | None = None        # DistResult (final or partial)
     seq: int = 0                        # FIFO tiebreak within a priority
     stop_reason: str | None = None      # why the current stop was asked

@@ -141,12 +141,23 @@ class SearchServer:
                  aot_cache_dir: str | None = None,
                  tune_cache_dir: str | None = None,
                  tune_at_boot: bool | None = None,
-                 remediate: bool | None = None):
+                 remediate: bool | None = None,
+                 ledger_dir: str | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
                       enumerate(partition_submeshes(n_submeshes,
                                                     devices=devices))]
+        # resolved EARLY (construction happens later, it needs the
+        # metrics registry) because the workdir default depends on it:
+        # durability needs checkpoints that survive the restart, so a
+        # ledger server without an explicit workdir keeps them UNDER
+        # the ledger dir — a fresh temp dir per lifetime would replay
+        # budgets but restart every search from its root
+        if ledger_dir is None:
+            ledger_dir = cfg.env_str(cfg.LEDGER_ENV)
+        if workdir is None and ledger_dir:
+            workdir = os.path.join(ledger_dir, "workdir")
         self.workdir = pathlib.Path(
             workdir if workdir is not None
             else tempfile.mkdtemp(prefix="tts_service_"))
@@ -333,12 +344,37 @@ class SearchServer:
         from .remediate import RemediationController
         self.remediation = RemediationController(
             self, enabled=remediate, registry=self.metrics)
+        # crash-safe serving (service/ledger): a write-ahead journal of
+        # every request state transition, replayed here at boot so a
+        # hard-killed server's queued/active requests re-admit with
+        # budgets/exclusions/failure logs intact, terminal results
+        # re-serve idempotently, and standing quarantines/admission
+        # pauses survive. None -> the TTS_LEDGER env path; unset/empty
+        # -> off, and every ledger code path below is vacuous — the
+        # server is bit-identical to the pre-ledger one (test-pinned).
+        # An unusable ledger dir RAISES instead of degrading: the
+        # operator asked for durability, and serving without it would
+        # turn the HTTP 200 durability promise into a lie.
+        # (ledger_dir itself was resolved at the top of __init__ — the
+        # workdir default depends on it.)
+        self.ledger = None
+        self.replayed_spool: dict[str, str] = {}
+        self._recovered = {"queued": 0, "active": 0, "held": 0,
+                           "terminal": 0}
+        if ledger_dir:
+            from .ledger import RequestLedger
+            self.ledger = RequestLedger(ledger_dir,
+                                        registry=self.metrics)
+            self._replay_boot()
+            self.ledger.journal("boot", pid=os.getpid(),
+                               submeshes=len(self.slots))
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
                        overlap=self.overlap,
                        share_incumbent=self.incumbents is not None,
-                       remediate=self.remediation.enabled)
+                       remediate=self.remediation.enabled,
+                       ledger=ledger_dir or None)
         if autostart:
             self.start()
 
@@ -370,7 +406,10 @@ class SearchServer:
         """Stop serving: running requests are stopped at their next
         segment boundary and left PREEMPTED with a fresh checkpoint (a
         new server with the same workdir + tags resumes them); queued
-        requests are CANCELLED. Unblocks every `result()` waiter."""
+        requests are CANCELLED — except under a ledger, where they
+        stay QUEUED: a ledger server's shutdown is a DRAIN, and its
+        backlog re-admits on the next boot instead of being forgotten.
+        Unblocks every `result()` waiter either way."""
         if not self._closing.is_set():
             tracelog.event("server.close")
         self._closing.set()
@@ -390,7 +429,7 @@ class SearchServer:
                     th.join()
         with self._lock:
             for rec in self.records.values():
-                if rec.state == QUEUED:
+                if rec.state == QUEUED and self.ledger is None:
                     self._finalize(rec, CANCELLED, error="server shutdown")
                 rec.done_event.set()
         # stop the resource sampler and retire its gauge series — a
@@ -406,6 +445,12 @@ class SearchServer:
         # wait=False close paths lose only the persistence)
         if self.aot is not None:
             self.aot.close()
+        # the ledger closes LAST, after every executor thread's final
+        # preempt/terminal record landed: a `drain` marker stamps the
+        # shutdown as graceful (its absence at replay = a hard kill)
+        if self.ledger is not None:
+            self.ledger.journal("drain", pid=os.getpid())
+            self.ledger.close()
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -416,11 +461,21 @@ class SearchServer:
 
     # ------------------------------------------------------------ client API
 
-    def submit(self, request: SearchRequest) -> str:
+    def submit(self, request: SearchRequest, *,
+               spool_id: str | None = None) -> str:
         """Admit a request; returns its id. Raises AdmissionError (with
         `.reason`) when the queue is full, the request is invalid, or
         the server is closed — rejection is immediate and explicit, the
-        client never learns about overload from a timeout."""
+        client never learns about overload from a timeout.
+
+        With a ledger, admission is a DURABILITY promise: the admit
+        record is journaled (fsync'd) before this returns, so a request
+        acknowledged here — including over ``POST /submit`` — survives
+        an immediate hard kill. A tag whose recorded terminal is DONE
+        re-serves idempotently: the original request id is returned
+        with its recorded result instead of re-solving. `spool_id`
+        (the file-spool front-end's id) rides the admit record so a
+        restarted serve loop can reconnect result-file delivery."""
         if self._closing.is_set():
             self.queue.rejected += 1
             tracelog.event("request.reject", reason="server closed")
@@ -442,6 +497,32 @@ class SearchServer:
                            reason=f"invalid request: {reason}")
             raise AdmissionError(f"invalid request: {reason}")
         with self._lock:
+            if self.ledger is not None and request.tag:
+                # idempotent re-serve: a duplicate tag whose recorded
+                # terminal is DONE returns the recorded result instead
+                # of re-solving (crash-duplicated submissions and
+                # client retries are absorbed; DEADLINE/FAILED tags
+                # still resubmit-to-extend through the normal path).
+                # Only a SAME-PROBLEM duplicate qualifies: a reused
+                # tag carrying a different instance/bound must solve,
+                # not silently receive the old answer
+                done = next(
+                    (r for r in self.records.values()
+                     if r.state == DONE
+                     and (r.request.tag or r.id) == request.tag), None)
+                if done is not None:
+                    prior = done.request
+                    if (np.array_equal(np.asarray(prior.p_times),
+                                       np.asarray(request.p_times))
+                            and prior.lb_kind == request.lb_kind
+                            and prior.init_ub == request.init_ub):
+                        tracelog.event("request.reserved_terminal",
+                                       request_id=done.id,
+                                       tag=request.tag)
+                        return done.id
+                    tracelog.event(
+                        "request.tag_reused_different_problem",
+                        request_id=done.id, tag=request.tag)
             seq = next(self._seq)
             rid = f"req-{seq:04d}"
             tag = request.tag or rid
@@ -479,6 +560,16 @@ class SearchServer:
                 raise
             self.records[rid] = rec
             self._m_submitted.inc()
+            if self.ledger is not None:
+                # journaled BEFORE the id is returned: once the caller
+                # (or the HTTP 200 built on it) sees this admission,
+                # the request survives a hard kill
+                from .spool import payload_from_request
+                self.ledger.journal(
+                    "admit", rid=rid, tag=tag, seq=seq,
+                    payload=payload_from_request(request),
+                    spool_id=spool_id,
+                    spent_s=round(rec.spent_prev_s, 3))
             tracelog.event("request.admit", request_id=rid, tag=tag,
                            priority=request.priority,
                            deadline_s=request.deadline_s,
@@ -730,6 +821,11 @@ class SearchServer:
             if rec.state != PREEMPTED or not rec.hold:
                 return False
             rec.hold = False
+            if self.ledger is not None:
+                # journaled like every other transition: a crash after
+                # an operator released the request must not replay it
+                # back into the parked state
+                self.ledger.journal("release", rid=rec.id)
             self.queue.requeue(rec)
             return True
 
@@ -740,14 +836,20 @@ class SearchServer:
 
     def pause_admission(self, reason: str) -> None:
         """Reject new submissions with `reason` until resumed (the
-        spool front-end holds its backlog instead)."""
+        spool front-end holds its backlog instead). Ledger-journaled:
+        a crash while paused restarts PAUSED — a degraded valve must
+        not be laundered open by a reboot."""
         with self._lock:
             self._paused_reason = reason
+            if self.ledger is not None:
+                self.ledger.journal("pause", reason=reason)
         tracelog.event("server.admission_paused", reason=reason)
 
     def resume_admission(self) -> None:
         with self._lock:
             was, self._paused_reason = self._paused_reason, None
+            if was is not None and self.ledger is not None:
+                self.ledger.journal("resume")
         if was is not None:
             tracelog.event("server.admission_resumed")
 
@@ -796,6 +898,12 @@ class SearchServer:
             if len(rec.excluded_submeshes) >= len(self.slots):
                 rec.excluded_submeshes = (
                     {int(submesh)} if len(self.slots) > 1 else set())
+            if self.ledger is not None:
+                # journaled in ABSOLUTE form: the cap above can RESET
+                # the set, which a relative append would replay wrong
+                self.ledger.journal(
+                    "exclude", rid=rec.id,
+                    excluded=sorted(rec.excluded_submeshes))
 
     def lowest_priority_running(self) -> str | None:
         """The shed_memory action's victim: the lowest-priority,
@@ -811,12 +919,28 @@ class SearchServer:
                        key=lambda r: (r.request.priority,
                                       -(r.started_t or 0.0))).id
 
+    def quarantine_submesh(self, index: int, reason: str) -> None:
+        """Hold a slot out of the partition (the remediation
+        controller's containment decision executes here — and is
+        ledger-journaled, so a crash cannot launder a quarantined
+        submesh back into rotation)."""
+        with self._lock:
+            slot = self.slots[index]
+            slot.quarantined = True
+            slot.quarantined_since = time.time()
+            slot.quarantine_reason = reason
+            if self.ledger is not None:
+                self.ledger.journal("quarantine", submesh=int(index),
+                                   reason=reason)
+
     def readmit_submesh(self, index: int) -> None:
         """Clear a slot's quarantine (the canary probe passed)."""
         with self._lock:
             slot = self.slots[index]
             slot.quarantined = False
             slot.quarantine_reason = None
+            if self.ledger is not None:
+                self.ledger.journal("readmit", submesh=int(index))
 
     def heartbeat_ages(self) -> dict:
         """Seconds since each RUNNING request's last engine heartbeat —
@@ -853,6 +977,9 @@ class SearchServer:
                      "quarantined": s.quarantined}
                     for s in self.slots],
                 "remediation": self.remediation.snapshot(),
+                "ledger": ({**self.ledger.snapshot(),
+                            "recovered": dict(self._recovered)}
+                           if self.ledger is not None else None),
                 "executor_cache": self.cache.snapshot(),
                 "aot_cache": (self.aot.snapshot()
                               if self.aot is not None else None),
@@ -866,6 +993,141 @@ class SearchServer:
                 "requests": {rid: rec.snapshot()
                              for rid, rec in self.records.items()},
             }
+
+    # ------------------------------------------------------ crash recovery
+    # (service/ledger: replaying the write-ahead journal at boot)
+
+    def _replay_boot(self) -> None:
+        """Rebuild serving state from the replayed ledger: standing
+        admission pause + submesh quarantines first (a crash must not
+        launder a degraded configuration back to healthy), then every
+        journaled request — queued/active re-admitted with budgets,
+        exclusions and failure logs intact (their checkpoints make the
+        resume lossless), terminal snapshots kept for idempotent
+        re-serve."""
+        from . import spool as spool_mod
+        st = self.ledger.state
+        if st.boots:
+            # a monotone restart count fed from the ledger itself, so
+            # the doctor's column survives the registry reset a restart
+            # is
+            self.metrics.counter(
+                "tts_server_restarts_total",
+                "server boots that replayed prior ledger state"
+                ).inc(st.boots)
+        if st.paused:
+            with self._lock:
+                self._paused_reason = st.paused
+            self.remediation.restore_pause(st.paused)
+            tracelog.event("ledger.pause_restored", reason=st.paused)
+        for idx, reason in sorted(st.quarantined.items()):
+            if not 0 <= idx < len(self.slots):
+                continue        # journaled on a larger partition
+            if sum(1 for s in self.slots if not s.quarantined) <= 1:
+                # the last healthy slot stays in rotation — the same
+                # never-zero-capacity guard remediate._quarantine
+                # applies live; a shrunk partition must not replay
+                # itself into a server that can never dispatch
+                tracelog.event("ledger.quarantine_not_restored",
+                               submesh=idx,
+                               reason="last healthy submesh")
+                continue
+            slot = self.slots[idx]
+            slot.quarantined = True
+            slot.quarantined_since = time.time()
+            slot.quarantine_reason = reason or "restored from ledger"
+            self.remediation.restore_quarantine(idx)
+        max_seq = -1
+        for entry in sorted(st.requests.values(),
+                            key=lambda e: e.get("seq", 0)):
+            max_seq = max(max_seq, int(entry.get("seq", 0)))
+            try:
+                self._readmit_replayed(entry, spool_mod)
+            except Exception as e:  # noqa: BLE001 — one unparseable
+                # entry (schema drift, a hand-edited ledger) must not
+                # strand the rest of the recovery
+                tracelog.event("ledger.readmit_failed",
+                               request_id=entry.get("rid"),
+                               error=repr(e))
+        if max_seq >= 0:
+            self._seq = itertools.count(max_seq + 1)
+        if st.requests:
+            tracelog.event("ledger.recovered", restarts=st.boots,
+                           **self._recovered)
+
+    def _readmit_replayed(self, entry: dict, spool_mod) -> None:
+        rid = entry["rid"]
+        req = spool_mod.request_from_payload(entry.get("payload") or {})
+        tag = entry.get("tag") or rid
+        req.tag = tag
+        path = str(self.workdir / f"{tag}.ckpt.npz")
+        rec = RequestRecord(
+            id=rid, request=req, submitted_t=time.monotonic(),
+            seq=int(entry.get("seq", 0)), checkpoint_path=path,
+            # the budget clock is CUMULATIVE across the crash: the
+            # journaled spent_s (heartbeat-fresh) and the checkpoint's
+            # own meta both survive; trust whichever saw more
+            spent_prev_s=max(float(entry.get("spent_s") or 0.0),
+                             _prior_spent_s(path)),
+            dispatches=int(entry.get("dispatches") or 0),
+            preemptions=int(entry.get("preemptions") or 0),
+            failures=int(entry.get("failures") or 0))
+        rec.failure_log = [dict(f) for f in
+                           entry.get("failure_log") or []]
+        # restored exclusions are re-capped against THIS lifetime's
+        # partition (it may be smaller than the one that journaled
+        # them): indices past the partition drop, and a set that would
+        # cover every slot clears — the add_exclusion invariant that a
+        # request must always have somewhere left to run
+        excluded = {int(s) for s in entry.get("excluded") or []
+                    if 0 <= int(s) < len(self.slots)}
+        if len(excluded) >= len(self.slots):
+            excluded = set()
+        rec.excluded_submeshes = excluded
+        rec.error = entry.get("error")
+        state = entry.get("state")
+        if state in TERMINAL_STATES:
+            rec.state = state
+            snap = entry.get("terminal") or {}
+            if snap.get("result") is not None:
+                rec.result = _ReplayedResult(snap["result"])
+            rec.error = snap.get("error", rec.error)
+            rec.done_event.set()
+            self._recovered["terminal"] += 1
+        elif state == PREEMPTED and entry.get("hold"):
+            # an operator parked it (preempt(hold=True)); stay parked
+            # until release() — a restart is not a release
+            rec.state = PREEMPTED
+            rec.hold = True
+            self._recovered["held"] += 1
+        else:
+            rec.state = QUEUED
+            self._recovered["active" if state == RUNNING
+                            else "queued"] += 1
+            self.queue.requeue(rec)
+        with self._lock:
+            self.records[rid] = rec
+        if entry.get("spool_id"):
+            self.replayed_spool[str(entry["spool_id"])] = rid
+        tracelog.event("request.recovered", request_id=rid,
+                       state=rec.state, tag=tag,
+                       spent_s=round(rec.spent_prev_s, 3),
+                       dispatches=rec.dispatches,
+                       excluded=sorted(rec.excluded_submeshes))
+
+    def _ledger_budget(self, rec: RequestRecord) -> None:
+        """Journal the request's cumulative execution clock, throttled
+        to LEDGER_BUDGET_EVERY_S (every heartbeat would fsync at
+        heartbeat rate; this bounds what a hard kill can lose to a few
+        seconds of budget, never the request)."""
+        if self.ledger is None:
+            return
+        now = time.monotonic()
+        if now - rec.ledger_budget_t < cfg.LEDGER_BUDGET_EVERY_S_DEFAULT:
+            return
+        rec.ledger_budget_t = now
+        self.ledger.journal("budget", rid=rec.id,
+                           spent_s=round(rec.spent_s(), 3))
 
     # ------------------------------------------------------------ internals
 
@@ -888,6 +1150,12 @@ class SearchServer:
         rec.finished_t = time.monotonic()
         key = {DONE: "done", CANCELLED: "cancelled",
                DEADLINE: "deadline", FAILED: "failed"}[state]
+        if self.ledger is not None:
+            # the full snapshot rides the terminal record: it is the
+            # idempotent re-serve source for a duplicate tag after a
+            # restart (and the forensic record of HOW it ended)
+            self.ledger.journal("terminal", rid=rec.id, state=state,
+                               snapshot=rec.snapshot())
         self._m_terminal.inc(state=key)
         self._m_spent.observe(rec.spent_s())
         # live-attribution series are per-request labeled; retire them
@@ -1049,6 +1317,10 @@ class SearchServer:
         rec.dispatch_heartbeats = 0     # this dispatch warms afresh
         # (stall judges it against the warmup threshold until the
         # engine heartbeats — a resume on a cold submesh pays a compile)
+        if self.ledger is not None:
+            self.ledger.journal("dispatch", rid=rec.id,
+                               submesh=slot.index,
+                               dispatch=rec.dispatches)
         tracelog.event("request.dispatch", request_id=rec.id,
                        submesh=slot.index, dispatch=rec.dispatches,
                        queue_depth=len(self.queue))
@@ -1082,6 +1354,10 @@ class SearchServer:
         def hb(rep):
             rec.last_heartbeat_t = time.monotonic()
             rec.dispatch_heartbeats += 1
+            # durable budget clock: throttled inside (a hard kill loses
+            # at most LEDGER_BUDGET_EVERY_S of spent_s, never the
+            # request — the checkpoint meta is the second witness)
+            self._ledger_budget(rec)
             rec.progress = {
                 "segment": rep.segment, "iters": rep.iters,
                 "tree": rep.tree, "sol": rep.sol, "best": rep.best,
@@ -1256,6 +1532,12 @@ class SearchServer:
                 tracelog.event("request.dispatch_failure",
                                request_id=rec.id, submesh=slot.index,
                                attempt=rec.dispatches, error=error)
+                if self.ledger is not None:
+                    self.ledger.journal(
+                        "failure", rid=rec.id, submesh=slot.index,
+                        attempt=rec.dispatches, error=error,
+                        failures=rec.failures,
+                        spent_s=round(rec.spent_prev_s, 3))
                 # remediation verdict: exclude the failing submesh /
                 # quarantine it / dead-letter a request whose failures
                 # followed it across distinct submeshes. Observe-only
@@ -1301,6 +1583,12 @@ class SearchServer:
                     rec.state = PREEMPTED
                     rec.preemptions += 1
                     self._m_preempt.inc()
+                    if self.ledger is not None:
+                        self.ledger.journal(
+                            "preempt", rid=rec.id,
+                            preemptions=rec.preemptions,
+                            spent_s=round(rec.spent_prev_s, 3),
+                            hold=rec.hold)
                     tracelog.event("request.preempt", request_id=rec.id,
                                    reason=reason or "stop",
                                    preemptions=rec.preemptions,
@@ -1321,6 +1609,20 @@ class SearchServer:
             slot.record = None
             slot.stop_event = None
             slot.thread = None
+
+
+class _ReplayedResult:
+    """Duck-typed stand-in for a DistResult, rebuilt from a ledger
+    terminal snapshot — enough surface for RequestRecord.snapshot()
+    and in-process `result()` readers (per-worker spreads are not
+    journaled; `per_device` replays empty)."""
+
+    def __init__(self, d: dict):
+        self.best = int(d.get("best") or 0)
+        self.explored_tree = int(d.get("explored_tree") or 0)
+        self.explored_sol = int(d.get("explored_sol") or 0)
+        self.complete = bool(d.get("complete"))
+        self.per_device: dict = {}
 
 
 def evt_set(slot: _Slot) -> bool:

@@ -75,6 +75,8 @@ def request_from_payload(payload: dict) -> SearchRequest:
         kwargs["deadline_s"] = float(payload["deadline_s"])
     if payload.get("share_group") is not None:
         kwargs["share_group"] = str(payload["share_group"])
+    if payload.get("checkpoint_meta") is not None:
+        kwargs["checkpoint_meta"] = dict(payload["checkpoint_meta"])
     if payload.get("tuned"):
         # adaptive dispatch: leave the knobs OPEN (chunk=None /
         # balance_period=None) so the server resolves them from its
@@ -86,6 +88,49 @@ def request_from_payload(payload: dict) -> SearchRequest:
         p_times=p, lb_kind=int(payload.get("lb", 1)),
         init_ub=None if ub is None else int(ub),
         tag=payload.get("tag"), faults=payload.get("faults"), **kwargs)
+
+
+def payload_from_request(req: SearchRequest) -> dict:
+    """The inverse of :func:`request_from_payload`: serialize a
+    SearchRequest back into the spool payload schema (the request
+    ledger's admit-record body — `request_from_payload(
+    payload_from_request(r))` must rebuild an equivalent request).
+    Open tuned knobs (chunk/balance_period None) round-trip as
+    ``{"tuned": true}``; per-request ``faults`` specs are deliberately
+    NOT serialized (a drill fault must not follow a request across the
+    crash-restart it exists to prove); non-JSON-safe ``checkpoint_meta``
+    (the campaign driver stamps numpy arrays) is dropped with a trace
+    event rather than failing the admit."""
+    p = np.asarray(req.p_times)
+    payload: dict = {"p_times": p.tolist(), "lb": int(req.lb_kind),
+                     "ub": None if req.init_ub is None
+                     else int(req.init_ub),
+                     "priority": int(req.priority), "tag": req.tag}
+    if req.deadline_s is not None:
+        payload["deadline_s"] = float(req.deadline_s)
+    if req.chunk is None or req.balance_period is None:
+        payload["tuned"] = True
+    if req.chunk is not None:
+        payload["chunk"] = int(req.chunk)
+    if req.balance_period is not None:
+        payload["balance_period"] = int(req.balance_period)
+    for k in ("capacity", "min_seed", "segment_iters",
+              "checkpoint_every"):
+        v = getattr(req, k)
+        if v is not None:
+            payload[k] = int(v)
+    if req.share_group is not None:
+        payload["share_group"] = str(req.share_group)
+    if req.checkpoint_meta:
+        try:
+            json.dumps(req.checkpoint_meta)
+            payload["checkpoint_meta"] = req.checkpoint_meta
+        except (TypeError, ValueError):
+            from ..obs import tracelog
+            tracelog.event("ledger.meta_dropped", tag=req.tag,
+                           reason="checkpoint_meta is not JSON-safe; "
+                                  "not journaled")
+    return payload
 
 
 def submit_file(spool: str | pathlib.Path, payload: dict,
@@ -158,6 +203,16 @@ def serve_spool(server, spool: str | pathlib.Path,
     spool.mkdir(parents=True, exist_ok=True)
     pending: dict[str, str] = {}        # spool id -> request id
     seen: set[str] = set()
+    # crash recovery (service/ledger): requests this server REPLAYED at
+    # boot that originally arrived through a spool reconnect to their
+    # request files here — re-submitting them would either duplicate
+    # the work or bounce off their own still-active tag, and their
+    # clients are still polling for the result file
+    replayed = dict(getattr(server, "replayed_spool", None) or {})
+    if replayed:
+        pending.update(replayed)
+        seen.update(replayed)
+        emit(json.dumps({"spool_reconnected": len(replayed)}))
     served = 0
     last_work = time.monotonic()
     last_status = 0.0
@@ -173,7 +228,10 @@ def serve_spool(server, spool: str | pathlib.Path,
             seen.add(sid)
             try:
                 payload = json.loads(req_file.read_text())
-                rid = server.submit(request_from_payload(payload))
+                # spool_id rides the ledger's admit record so a
+                # restarted serve loop can reconnect result delivery
+                rid = server.submit(request_from_payload(payload),
+                                    spool_id=sid)
             except AdmissionPaused:
                 # the pause engaged between this loop's paused check
                 # and the submit: HOLD the file (back out of `seen` so

@@ -277,6 +277,28 @@ TUNE_WINDOW_ITERS_DEFAULT = 24    # TTS_TUNE_WINDOW — measured iters
 TUNE_WARM_ITERS_DEFAULT = 200     # TTS_TUNE_WARM — warm-up iters
                                   # before a probe's measured window
 
+# Crash-safe serving (service/ledger.py + serve --ledger). TTS_LEDGER
+# names the durable request-ledger directory: every request state
+# transition (admit, dispatch, budget, preempt, release, exclusion,
+# failure, quarantine/readmit, pause/resume, terminal) is journaled as an
+# fsync'd CRC-stamped JSONL record BEFORE it is acknowledged, and a
+# restarted server replays the ledger at boot — queued/active requests
+# are re-admitted with budgets/exclusions/failure logs intact and
+# resume from their checkpoints, terminal results re-serve
+# idempotently, standing quarantines and admission pauses are
+# restored. Unset = off (bit-identical to the pre-ledger server).
+# TTS_DRAIN_TIMEOUT_S bounds the SIGTERM/SIGINT graceful drain (stop
+# admission -> preempt at segment boundaries -> drain the checkpoint/
+# AOT/ledger writers -> exit 0); past it the serve entry escalates to
+# checkpoint-and-abort (the ledger makes even that abort recoverable).
+LEDGER_ENV = "TTS_LEDGER"
+DRAIN_TIMEOUT_S_DEFAULT = 30.0
+LEDGER_BUDGET_EVERY_S_DEFAULT = 5.0   # seconds between journaled
+#                                       budget heartbeats per RUNNING
+#                                       request (bounds the spent_s a
+#                                       hard kill can lose without
+#                                       fsyncing at heartbeat rate)
+
 # Self-healing (service/remediate.py + serve --remediate).
 # TTS_REMEDIATE=1 lets the RemediationController EXECUTE its policy
 # table (stall -> preempt+exclude, repeated localized failures ->
@@ -440,6 +462,14 @@ KNOBS: dict[str, Knob] = _knob_table(
          "audit rule: how long a failure keeps the alert firing"),
     Knob("TTS_HEALTH_PERF_JSON", "str", None,
          "perf rule: path to a perf_sentry --json verdict file"),
+    # --- crash-safe serving (service/ledger.py; semantics per README
+    #     "Crash recovery & deployment")
+    Knob("TTS_LEDGER", "str", None,
+         "serve: durable request-ledger directory (write-ahead JSONL, "
+         "replayed at boot; unset = off, bit-identical to today)"),
+    Knob("TTS_DRAIN_TIMEOUT_S", "float", DRAIN_TIMEOUT_S_DEFAULT,
+         "serve: SIGTERM/SIGINT graceful-drain budget before the "
+         "checkpoint-and-abort escalation"),
     # --- self-healing (service/remediate.py; semantics per README
     #     "Self-healing")
     Knob("TTS_REMEDIATE", "flag", False,

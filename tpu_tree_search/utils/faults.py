@@ -48,9 +48,27 @@ programmatically via :func:`configure`:
                                              # stop, the health layer's
                                              # stall rule fires, the
                                              # remediation drill acts
+    TTS_FAULTS="kill_server=3"               # os._exit(137) at the START
+                                             # of segment 3, before it
+                                             # dispatches — the WHOLE
+                                             # serving process dies hard
+                                             # (no flush, no handlers: a
+                                             # real kill -9/OOM). The
+                                             # request ledger + restart
+                                             # replay is the recovery
+                                             # (CI crash-restart leg)
+    TTS_FAULTS="sigterm_server=3"            # deliver SIGTERM to our own
+                                             # process at the start of
+                                             # segment 3, once — the
+                                             # graceful-drain drill: the
+                                             # serve entry stops
+                                             # admission, preempts at
+                                             # segment boundaries, drains
+                                             # every writer and exits 0
+                                             # inside TTS_DRAIN_TIMEOUT_S
 
-The chaos-drill kinds (kill_submesh / oom_segment / wedge_executor)
-accept an optional ``@SUBMESH`` suffix: the injection fires only in a
+The chaos-drill kinds (kill_submesh / oom_segment / wedge_executor /
+kill_server / sigterm_server) accept an optional ``@SUBMESH`` suffix: the injection fires only in a
 thread whose ambient flight-recorder context (obs/tracelog) carries
 that submesh index — so a GLOBAL plan can target one submesh of a
 serving mesh while requests on the other submeshes run clean, which is
@@ -122,6 +140,13 @@ class FaultPlan:
     kill_submesh: tuple[int, int, int | None] | None = None
     oom_segment: tuple[int, int, int | None] | None = None
     wedge_executor: tuple[int, float, int | None] | None = None
+    # crash-safe-serving drills: kill_server hard-kills the WHOLE
+    # process (os._exit, no flush — a real SIGKILL/OOM) at the start
+    # of the segment, BEFORE it dispatches, so the death is
+    # checkpoint-exact like kill_submesh; sigterm_server delivers
+    # SIGTERM to our own pid (the graceful-drain drill)
+    kill_server: tuple[int, int, int | None] | None = None
+    sigterm_server: tuple[int, int, int | None] | None = None
     # fire count lives ON the plan (not module state): a thread-scoped
     # plan must have its own injection budget — concurrent requests with
     # scoped plans would otherwise spend each other's failures
@@ -129,6 +154,7 @@ class FaultPlan:
     kills_fired: int = dataclasses.field(default=0, repr=False)
     ooms_fired: int = dataclasses.field(default=0, repr=False)
     wedges_fired: int = dataclasses.field(default=0, repr=False)
+    sigterms_fired: int = dataclasses.field(default=0, repr=False)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -156,6 +182,10 @@ class FaultPlan:
                 plan.oom_segment = _parse_drill(val, int, 1)
             elif name == "wedge_executor":
                 plan.wedge_executor = _parse_drill(val, float, 5.0)
+            elif name == "kill_server":
+                plan.kill_server = _parse_drill(val, int, 1)
+            elif name == "sigterm_server":
+                plan.sigterm_server = _parse_drill(val, int, 1)
             else:
                 raise ValueError(
                     f"unknown fault {name!r} in TTS_FAULTS spec {spec!r}")
@@ -325,6 +355,35 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
             raise InjectedOOM(
                 f"RESOURCE_EXHAUSTED: injected device OOM at segment "
                 f"{segment} ({plan.ooms_fired}/{plan.oom_segment[1]})")
+        if (plan.sigterm_server is not None
+                and segment == plan.sigterm_server[0]
+                and plan.sigterms_fired < plan.sigterm_server[1]
+                and _submesh_matches(plan.sigterm_server[2])):
+            plan.sigterms_fired += 1
+            _record(point, "sigterm_server", segment=segment,
+                    submesh=_ambient_submesh())
+            # our own pid: the graceful-drain drill — the serve entry's
+            # handler stops admission, preempts at segment boundaries,
+            # drains the writers and exits 0 (a process without that
+            # handler just terminates, the default SIGTERM disposition)
+            import signal
+            os.kill(os.getpid(), signal.SIGTERM)
+        if (plan.kill_server is not None
+                and segment == plan.kill_server[0]
+                and plan.kill_server[1] > 0
+                and _submesh_matches(plan.kill_server[2])):
+            # budget > 0 honored like the sibling drills (a fired kill
+            # needs no counter: the process does not survive it)
+            # the line-buffered recorder gets the record out before the
+            # exit below skips every flush
+            _record(point, "kill_server", segment=segment,
+                    submesh=_ambient_submesh())
+            # a hard host death runs no exit handlers and flushes no
+            # buffers; firing BEFORE the segment dispatches keeps the
+            # death checkpoint-exact (segment k never ran), and the
+            # request ledger + restart replay is the recovery the
+            # drill exists to prove
+            os._exit(KILL_EXIT_CODE)
     elif point == "post_checkpoint":
         if (plan.corrupt_checkpoint is not None
                 and segment == plan.corrupt_checkpoint
