@@ -202,6 +202,59 @@ def test_trace_safety_catches_hazards_in_traced_code(tmp_path):
     assert not any(f.symbol.startswith("host_only") for f in found)
 
 
+def test_trace_safety_problem_plugin_roots(tmp_path):
+    """The plugin rule: a `branch`/`bound` defined under problems/ is a
+    traced root even though no jit/lax call site names it (the generic
+    step reaches it through a dynamic plugin object) — and the same
+    hazard in a non-jittable host method stays clean."""
+    root = _tree(tmp_path, {"tpu_tree_search/problems/myprob.py": """
+        import os
+
+
+        class MyProblem:
+            def branch(self, tables, p_prmu, p_depth, p_aux, valid):
+                if os.environ.get("TTS_SOME_FLAG"):
+                    return p_prmu
+                return p_prmu
+
+            def bound(self, tables, lb_kind, br, best):
+                return best.item()
+
+            def validate(self, table):
+                # host-side: the identical hazard is NOT traced code
+                return os.environ.get("TTS_SOME_FLAG")
+    """})
+    found = lint_trace.check(root)
+    rules = {(f.rule, f.symbol.split(":")[0]) for f in found}
+    assert ("env_read", "MyProblem.branch") in rules, found
+    assert ("host_sync", "MyProblem.bound") in rules, found
+    assert not any(s.startswith("MyProblem.validate")
+                   for _, s in rules), found
+
+
+def test_trace_safety_registered_plugins_covered():
+    """Every registered problem's jittable callables are inside the
+    trace-safety walk: either the module defines the protocol's
+    jittable methods (root-by-rule) or the plugin overrides make_step
+    with an engine fast path that is itself under a traced dir."""
+    import inspect
+
+    from tpu_tree_search import problems
+    from tpu_tree_search.problems.base import Problem
+
+    for name in problems.names():
+        prob = problems.get(name)
+        mod = inspect.getmodule(type(prob)).__file__
+        assert "/problems/" in mod.replace("\\", "/")
+        own = type(prob).__dict__
+        has_jittable = any(m in own for m in lint_trace.PLUGIN_JITTABLE)
+        has_fast_path = own.get("make_step") is not None and \
+            own["make_step"] is not Problem.make_step
+        assert has_jittable or has_fast_path, (
+            f"problem {name!r} exposes no traced surface the "
+            "trace-safety walk can root")
+
+
 def test_trace_safety_clean_fixture_zero_findings(tmp_path):
     root = _tree(tmp_path, {"tpu_tree_search/engine/ok.py": """
         import jax

@@ -64,14 +64,14 @@ def test_dist_overflow_grows_and_resumes_losslessly(monkeypatch):
     any lost (or doubled) subtree would shift them. Balancing is
     disabled (huge min_transfer) and the warm-up stripe sized near the
     limit so the pools MUST overflow mid-run."""
-    from tpu_tree_search.engine import nqueens_device
+    from tpu_tree_search.problems import nqueens as nq
 
     calls = _counting_grow(monkeypatch)
     kw = dict(chunk=4, n_devices=2, min_seed=200, min_transfer=10**6)
-    small = nqueens_device.search_distributed(10, capacity=1 << 8, **kw)
+    small = nq.search_distributed(10, capacity=1 << 8, **kw)
     assert calls, "tiny pool never overflowed — capacity too generous " \
                   "for the test to exercise the grow path"
-    big = nqueens_device.search_distributed(10, capacity=1 << 15, **kw)
+    big = nq.search_distributed(10, capacity=1 << 15, **kw)
     assert (small.explored_tree, small.explored_sol) == \
            (big.explored_tree, big.explored_sol) == (35538, 724)
 

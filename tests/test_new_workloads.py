@@ -1,0 +1,129 @@
+"""Golden-optimum tests for the new workloads (TSP, 0/1 knapsack):
+pinned known-optimal instances plus brute-force/DP cross-derivation so
+the constants and the data cannot drift apart, through both the
+single-device generic engine and the distributed pipeline — and the
+service path (submit → solve → preempt → resume)."""
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import device, distributed
+from tpu_tree_search.problems.knapsack import (GOLDEN, KnapsackInstance,
+                                               KnapsackProblem,
+                                               _fractional_ub,
+                                               _sorted_items)
+from tpu_tree_search.problems.tsp import (GOLDEN_D, GOLDEN_OPTIMUM,
+                                          TSPInstance)
+
+# ------------------------------------------------------------------ TSP
+
+
+def test_tsp_golden_instance_pinned():
+    inst = TSPInstance(n=6, d=GOLDEN_D)
+    assert inst.brute_force_optimum() == GOLDEN_OPTIMUM
+    out = device.solve("tsp", GOLDEN_D, chunk=8, capacity=1 << 12)
+    assert out.best == GOLDEN_OPTIMUM and out.complete
+
+
+@pytest.mark.parametrize("n,seed", [(6, 0), (7, 1), (8, 2)])
+def test_tsp_matches_brute_force(n, seed):
+    inst = TSPInstance.synthetic(n, seed)
+    opt = inst.brute_force_optimum()
+    out = device.solve("tsp", inst.d, chunk=8, capacity=1 << 13)
+    assert out.best == opt and out.complete
+
+
+def test_tsp_distributed_matches_single():
+    inst = TSPInstance.synthetic(8, 3)
+    opt = inst.brute_force_optimum()
+    res = distributed.search(inst.d, problem="tsp", n_devices=4,
+                             chunk=8, capacity=1 << 14, min_seed=8)
+    assert res.best == opt and res.complete
+    # fixed-point incumbent: counts are exploration-order independent,
+    # so single-device and 4-worker trees must agree exactly
+    solo = device.solve("tsp", inst.d, init_ub=opt, chunk=8,
+                        capacity=1 << 14)
+    res2 = distributed.search(inst.d, problem="tsp", init_ub=opt,
+                              n_devices=4, chunk=8, capacity=1 << 14,
+                              min_seed=8)
+    assert (res2.explored_tree, res2.explored_sol) == \
+        (solo.explored_tree, solo.explored_sol)
+
+
+def test_tsp_bound_admissible_on_random_nodes():
+    """The NN-sum bound never exceeds the best completion of the node
+    (spot-checked by brute-forcing completions of random prefixes)."""
+    import itertools
+
+    inst = TSPInstance.synthetic(7, 5)
+    d = inst.d.astype(np.int64)
+    prob = __import__("tpu_tree_search.problems.tsp",
+                      fromlist=["PROBLEM"]).PROBLEM
+    rng = np.random.default_rng(0)
+    for _ in range(10):
+        rest = list(rng.permutation(np.arange(1, 7)))
+        depth = int(rng.integers(1, 6))
+        node = np.array([0] + rest, np.int16)
+        for child, cdepth, bound, is_leaf in prob.host_children(
+                inst.d, node, depth, 2**31 - 1):
+            fixed = [int(c) for c in child[:cdepth]]
+            free = [int(c) for c in child[cdepth:]]
+            best_completion = min(
+                inst.tour_length(np.array(fixed + list(tail)))
+                for tail in itertools.permutations(free)) \
+                if free else inst.tour_length(np.array(fixed))
+            assert bound <= best_completion, (node, depth, child)
+
+
+# ------------------------------------------------------------- knapsack
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN))
+def test_knapsack_golden_instances_pinned(name):
+    inst, pinned = GOLDEN[name]
+    assert inst.optimum() == pinned          # DP re-derivation
+    out = device.solve("knapsack", inst.table, chunk=8,
+                       capacity=1 << 12)
+    assert out.complete and -out.best == pinned
+    prob = KnapsackProblem()
+    assert prob.display_objective(out.best) == pinned
+
+
+@pytest.mark.parametrize("n,seed", [(10, 0), (14, 1), (18, 2)])
+def test_knapsack_matches_dp(n, seed):
+    inst = KnapsackInstance.synthetic(n, seed)
+    out = device.solve("knapsack", inst.table, chunk=8,
+                       capacity=1 << 13)
+    assert out.complete and -out.best == inst.optimum()
+
+
+def test_knapsack_distributed_matches_dp():
+    inst = KnapsackInstance.synthetic(16, 4)
+    res = distributed.search(inst.table, problem="knapsack",
+                             n_devices=4, chunk=8, capacity=1 << 14,
+                             min_seed=8)
+    assert res.complete and -res.best == inst.optimum()
+
+
+def test_knapsack_fractional_bound_dominates_dp():
+    """The traced bound's host oracle is a true upper bound on the
+    remaining subproblem's integer optimum (admissibility of the
+    Dantzig relaxation with floored fractional term)."""
+    inst = KnapsackInstance.synthetic(12, 7)
+    w, v, cap, _ = _sorted_items(inst.table)
+    for start in range(len(w)):
+        for rem in (0, cap // 3, cap):
+            ub = _fractional_ub(w, v, start, rem)
+            dp = KnapsackInstance(weights=w[start:], values=v[start:],
+                                  capacity=rem).optimum()
+            assert ub >= dp, (start, rem, ub, dp)
+
+
+def test_knapsack_infeasible_take_never_pushed():
+    """Zero-capacity instance: no item fits, optimum 0, and the tree
+    contains only skip chains."""
+    inst = KnapsackInstance(weights=np.array([5, 7, 9]),
+                            values=np.array([10, 20, 30]), capacity=0)
+    out = device.solve("knapsack", inst.table, chunk=4,
+                       capacity=1 << 10)
+    assert out.complete and -out.best == 0

@@ -1,10 +1,14 @@
-"""N-Queens: oracle vs known solution counts (OEIS A000170), device engines
-vs oracle (exact tree/sol counts — the search is unpruned, so counts are
-exploration-order independent)."""
+"""N-Queens: oracle vs known solution counts (OEIS A000170), the generic
+plugin engine vs oracle (exact tree/sol counts — the search is unpruned,
+so counts are exploration-order independent). The device engines run
+through the problem-plugin pipeline (problems/nqueens.NQueensProblem +
+engine/device.generic_step) that replaced the deleted
+engine/nqueens_device fork; matching the oracle exactly IS the
+bit-identical-counts parity pin (the fork matched the same oracle)."""
 
 import pytest
 
-from tpu_tree_search.engine import nqueens_device, sequential as seq
+from tpu_tree_search.engine import sequential as seq
 from tpu_tree_search.problems import nqueens as nq
 
 
@@ -17,22 +21,22 @@ def test_oracle_solution_counts(n):
 @pytest.mark.parametrize("n", [6, 8])
 def test_device_matches_oracle(n):
     want = seq.nqueens_search(n)
-    got = nqueens_device.search(n, chunk=16, capacity=1 << 14)
+    got = nq.search(n, chunk=16, capacity=1 << 14)
     assert (got.explored_tree, got.explored_sol) == \
            (want.explored_tree, want.explored_sol)
 
 
 def test_device_g_invariance():
-    a = nqueens_device.search(7, g=1, chunk=8)
-    b = nqueens_device.search(7, g=3, chunk=8)
+    a = nq.search(7, g=1, chunk=8)
+    b = nq.search(7, g=3, chunk=8)
     assert (a.explored_tree, a.explored_sol) == (b.explored_tree, b.explored_sol)
 
 
 @pytest.mark.parametrize("n_devices", [2, 8])
 def test_distributed_matches_oracle(n_devices):
     want = seq.nqueens_search(8)
-    got = nqueens_device.search_distributed(8, n_devices=n_devices,
-                                            chunk=8, capacity=1 << 14,
-                                            min_seed=8)
+    got = nq.search_distributed(8, n_devices=n_devices,
+                                chunk=8, capacity=1 << 14,
+                                min_seed=8)
     assert (got.explored_tree, got.explored_sol) == \
            (want.explored_tree, want.explored_sol)

@@ -150,9 +150,10 @@ def test_incumbent_ring_tracks_best():
 
 
 def test_nqueens_telemetry(telemetry_on):
-    from tpu_tree_search.engine import nqueens_device
+    from tpu_tree_search.problems import nqueens as nq
     st = device.init_state(6, 1 << 12, None)
-    out = nqueens_device.run(st, 6, 1, 8)
+    out = device.run_problem(nq.PROBLEM, nq.PROBLEM.make_tables(
+        nq.table(6)), st, 0, 8)
     s = tele.summarize(np.asarray(out.telemetry))
     assert sum(s["branched"]) == int(out.tree)
     assert sum(s["pruned"]) == int(out.evals) - int(out.tree)

@@ -35,10 +35,24 @@ import ast
 
 from .core import Finding, parse_many
 
-__all__ = ["check", "TRACED_DIRS"]
+__all__ = ["check", "TRACED_DIRS", "PLUGIN_JITTABLE"]
 
 # the subtrees whose jit entry points are the engine's compiled surface
-TRACED_DIRS = ("tpu_tree_search/engine", "tpu_tree_search/ops")
+# (problems/ holds the plugin protocol's jittable branch/bound
+# callables — traced code reached through a dynamic problem object the
+# call-graph walk cannot resolve, hence the explicit root rule below)
+TRACED_DIRS = ("tpu_tree_search/engine", "tpu_tree_search/ops",
+               "tpu_tree_search/problems")
+
+# every registered problem's jittable protocol methods
+# (problems/base.Problem): the generic step invokes them through a
+# plugin OBJECT (`problem.branch(...)`), which bare-name/module
+# resolution cannot see — so any function with one of these names
+# defined under problems/ is a traced root by rule. The conformance
+# suite (tests/test_problem_plugins.py) pins that each registered
+# plugin's methods are actually covered by this walk.
+PLUGIN_JITTABLE = ("branch", "bound", "is_leaf_cols")
+_PLUGIN_PKG = "tpu_tree_search.problems"
 
 _JIT_WRAPPERS = {"jit", "pjit", "vmap", "pmap", "shard_map", "remat",
                  "named_call", "custom_jvp", "custom_vjp"}
@@ -236,6 +250,13 @@ def check(root=None) -> list:
                         continue       # scanned with its enclosing fn
                     for tgt_mod, qual in _resolve(expr, mod, modules):
                         roots.add((tgt_mod.key, qual))
+        # plugin roots: the problem protocol's jittable callables are
+        # invoked through a dynamic plugin object inside the generic
+        # step — every definition of one under problems/ is traced
+        if key.startswith(_PLUGIN_PKG):
+            for qual in mod.functions:
+                if qual.split(".")[-1] in PLUGIN_JITTABLE:
+                    roots.add((key, qual))
 
     # --- reachability over repo-local calls
     reachable: set = set()

@@ -292,16 +292,143 @@ def _serve_parser(sub):
                         "compile exactly once across lifetimes")
 
 
+def _problem_instance_args(p, require_inst: bool = False):
+    """Shared instance-selection flags for `solve` and `client`: a
+    problem name plus ONE instance source — a Taillard id (PFSP only),
+    a synthetic --size/--seed, or a raw table from a JSON file."""
+    p.add_argument("--problem", type=str, default="pfsp",
+                   help="workload plugin (problems/base.py): pfsp | "
+                        "nqueens | tsp | knapsack")
+    p.add_argument("-i", "--inst", type=int,
+                   required=require_inst, default=None,
+                   help="Taillard instance id (PFSP only)")
+    p.add_argument("--size", type=int, default=None,
+                   help="synthetic instance size: jobs (pfsp), board "
+                        "n (nqueens), cities (tsp), items (knapsack)")
+    p.add_argument("--machines", type=int, default=5,
+                   help="machines for a synthetic PFSP --size instance")
+    p.add_argument("--seed", type=int, default=0,
+                   help="synthetic instance seed")
+    p.add_argument("--instance-json", type=str, default=None,
+                   help="path to a JSON 2-D instance table (the "
+                        "problem's p_times format, problems/base.py)")
+
+
+def _solve_instance_table(args):
+    """Resolve the instance table for `solve`/`client` from the flags
+    (--inst > --instance-json > --size synthetic)."""
+    import numpy as _np
+
+    if args.inst is not None:
+        if args.problem != "pfsp":
+            raise SystemExit("--inst (a Taillard id) is PFSP-only; "
+                             "use --size or --instance-json")
+        from .problems import taillard
+        return taillard.processing_times(args.inst)
+    if args.instance_json:
+        import json as _json
+        return _np.asarray(
+            _json.load(open(args.instance_json)), _np.int32)
+    if args.size is None:
+        raise SystemExit("pick an instance: -i (pfsp), --size or "
+                         "--instance-json")
+    n, seed = args.size, args.seed
+    if args.problem == "pfsp":
+        from .problems.pfsp import PFSPInstance
+        return PFSPInstance.synthetic(jobs=n, machines=args.machines,
+                                      seed=seed).p_times
+    if args.problem == "nqueens":
+        from .problems import nqueens as nq
+        return nq.table(n)
+    if args.problem == "tsp":
+        from .problems.tsp import TSPInstance
+        return TSPInstance.synthetic(n, seed).d
+    if args.problem == "knapsack":
+        from .problems.knapsack import KnapsackInstance
+        return KnapsackInstance.synthetic(n, seed).table
+    raise SystemExit(f"no synthetic builder for problem "
+                     f"{args.problem!r}; use --instance-json")
+
+
+def _solve_parser(sub):
+    p = sub.add_parser(
+        "solve",
+        help="one-shot solve of ANY registered problem through the "
+             "generic plugin engine (single-device or distributed)")
+    _problem_instance_args(p)
+    p.add_argument("-l", "--lb", type=int, default=None,
+                   help="bound kind (default: the problem's default)")
+    p.add_argument("-u", "--ub", type=int, default=None,
+                   help="seed incumbent value (objective units)")
+    p.add_argument("-D", type=int, default=1,
+                   help="devices (1 = single-device engine)")
+    p.add_argument("--chunk", type=int, default=64)
+    p.add_argument("--capacity", type=int, default=None)
+    p.add_argument("--max-iters", type=int, default=None,
+                   help="truncate the search (debugging)")
+
+
+def run_solve(args) -> int:
+    import json
+
+    from . import problems
+    from .engine import device, distributed
+
+    try:
+        prob = problems.get(args.problem)
+    except KeyError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    table = _solve_instance_table(args)
+    reason = prob.validate(table)
+    if reason is not None:
+        print(f"error: invalid instance: {reason}", file=sys.stderr)
+        return 2
+    lb = prob.default_lb if args.lb is None else args.lb
+    # --ub is in OBJECTIVE units; the engine's incumbent lives in the
+    # minimized domain (knapsack: -value)
+    init_ub = (None if args.ub is None
+               else prob.engine_objective(args.ub))
+    print("=" * 49)
+    print(f"TPU B&B problem={prob.name} shape="
+          f"{'x'.join(map(str, table.shape))} lb={lb} D={args.D}")
+    print("=" * 49)
+    t0 = time.perf_counter()
+    if args.D == 1:
+        out = device.solve(prob, table, lb_kind=lb, init_ub=init_ub,
+                           chunk=args.chunk, capacity=args.capacity,
+                           max_iters=args.max_iters)
+        tree, sol, best = out.explored_tree, out.explored_sol, out.best
+        complete = out.complete
+    else:
+        res = distributed.search(
+            table, problem=prob, lb_kind=lb, init_ub=init_ub,
+            n_devices=args.D, chunk=args.chunk,
+            capacity=args.capacity or prob.default_capacity(table),
+            max_rounds=args.max_iters)
+        tree, sol, best = (res.explored_tree, res.explored_sol,
+                           res.best)
+        complete = res.complete
+    elapsed = time.perf_counter() - t0
+    print(json.dumps({
+        "problem": prob.name, "explored_tree": tree,
+        "explored_sol": sol, "best": int(best),
+        "objective": prob.display_objective(best),
+        "complete": bool(complete), "elapsed_s": round(elapsed, 4)}))
+    return 0
+
+
 def _client_parser(sub):
     p = sub.add_parser(
         "client",
         help="submit one request to a running `serve` spool and wait")
     p.add_argument("--spool", type=str, required=True)
-    p.add_argument("-i", "--inst", type=int, required=True,
-                   help="Taillard instance id")
-    p.add_argument("-l", "--lb", type=int, default=1, choices=(0, 1, 2))
+    _problem_instance_args(p)
+    p.add_argument("-l", "--lb", type=int, default=None,
+                   help="bound kind (default: the problem's default)")
     p.add_argument("-u", "--ub", type=int, default=1, choices=(0, 1),
-                   help="1: seed the incumbent with the known optimum")
+                   help="1: seed the incumbent with the known optimum "
+                        "(applies to Taillard -i instances only)")
     p.add_argument("--priority", type=int, default=0,
                    help="higher preempts lower on a full mesh")
     p.add_argument("--deadline", type=float, default=None,
@@ -512,11 +639,17 @@ def run_client(args) -> int:
 
     from .service import spool
 
-    payload = {"inst": args.inst, "lb": args.lb,
-               "ub": "opt" if args.ub == 1 else None,
+    payload = {"problem": args.problem,
                "priority": args.priority, "deadline_s": args.deadline,
                "chunk": args.chunk, "capacity": args.capacity,
                "tag": args.tag}
+    if args.lb is not None:
+        payload["lb"] = args.lb
+    if args.problem == "pfsp" and args.inst is not None:
+        payload["inst"] = args.inst
+        payload["ub"] = "opt" if args.ub == 1 else None
+    else:
+        payload["p_times"] = _solve_instance_table(args).tolist()
     sid = spool.submit_file(args.spool, payload)
     print(f"submitted {sid}", flush=True)
     try:
@@ -1072,7 +1205,7 @@ def _run_pfsp_segmented(args, p, init_ub, host_fraction: int = 0,
 def run_nqueens(args) -> int:
     import jax
 
-    from .engine import nqueens_device
+    from .problems import nqueens as nq
 
     n_dev = args.D if args.D > 0 else len(jax.devices())
     print("=" * 49)
@@ -1082,10 +1215,10 @@ def run_nqueens(args) -> int:
     print("=" * 49)
     t0 = time.perf_counter()
     if n_dev == 1:
-        out = nqueens_device.search(args.N, g=args.g, chunk=args.chunk,
-                                    capacity=args.capacity)
+        out = nq.search(args.N, g=args.g, chunk=args.chunk,
+                        capacity=args.capacity)
     else:
-        out = nqueens_device.search_distributed(
+        out = nq.search_distributed(
             args.N, g=args.g, n_devices=n_dev, chunk=args.chunk,
             capacity=args.capacity)
     elapsed = time.perf_counter() - t0
@@ -1113,6 +1246,7 @@ def main(argv=None) -> int:
     sub = ap.add_subparsers(dest="cmd", required=True)
     _pfsp_parser(sub)
     _nq_parser(sub)
+    _solve_parser(sub)
     _serve_parser(sub)
     _client_parser(sub)
     _profile_parser(sub)
@@ -1151,6 +1285,8 @@ def main(argv=None) -> int:
     compile_cache.enable()
     if args.cmd == "pfsp":
         return run_pfsp(args)
+    if args.cmd == "solve":
+        return run_solve(args)
     if args.cmd == "serve":
         return run_serve(args)
     if args.cmd == "client":

@@ -131,13 +131,17 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
                prmu0: np.ndarray | None = None,
                depth0: np.ndarray | None = None,
                p_times: np.ndarray | None = None,
-               telemetry: bool | None = None) -> SearchState:
+               telemetry: bool | None = None,
+               aux0: np.ndarray | None = None) -> SearchState:
     """Pool with the given seed nodes (default: the root at depth 0).
 
-    `p_times` (PFSP) sizes and fills the per-node aux tables; without it the
-    aux width is 0 (problems like N-Queens that carry no per-node tables).
-    `telemetry` compiles the on-device search-telemetry block into the
-    state (None: the TTS_SEARCH_TELEMETRY env flag, engine/telemetry.py).
+    `p_times` (PFSP) sizes and fills the per-node aux tables; `aux0`
+    ((n, A) host rows, any problem) seeds them directly — the problem-
+    plugin path (problems/base.Problem.seed_aux). Without either the
+    aux width is 0 (problems like N-Queens that carry no per-node
+    tables). `telemetry` compiles the on-device search-telemetry block
+    into the state (None: the TTS_SEARCH_TELEMETRY env flag,
+    engine/telemetry.py).
     """
     if prmu0 is None:
         prmu0 = np.arange(jobs, dtype=np.int16)[None, :]
@@ -166,6 +170,9 @@ def init_state(jobs: int, capacity: int, init_ub: int | None,
         aux = seeded((m, capacity), aux_dtype(p_times),
                      ref.prefix_front_remain(p_times, prmu0,
                                              depth0)[:, :m].T)
+    elif aux0 is not None and aux0.shape[-1] > 0:
+        aux0 = np.asarray(aux0).reshape(len(depth0), -1)
+        aux = seeded((aux0.shape[1], capacity), aux0.dtype, aux0.T)
     else:
         aux = jnp.zeros((0, capacity), jnp.int32)
     best = 2**31 - 1 if init_ub is None else int(init_ub)
@@ -998,6 +1005,198 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
     return _run(tables, state, lb_kind, chunk,
                 jnp.asarray(ceiling, dtype=state.iters.dtype),
                 jnp.asarray(max(drain_min, 1), dtype=jnp.int32), tile=tile)
+
+
+def generic_step(problem, tables, lb_kind: int, chunk: int,
+                 state: SearchState, tile: int = 1024,
+                 limit: int | None = None) -> SearchState:
+    """One problem-generic pop -> branch -> bound -> prune -> compact
+    cycle, parameterized by the plugin protocol (problems/base.Problem):
+    the plugin supplies the dense child grid (`branch`) and the child
+    bound values (`bound`); everything else — pool pop, incumbent and
+    solution accounting, stable-partition compaction, the scratch-margin
+    overflow contract and the telemetry block — is shared engine code.
+
+    This is the default `Problem.make_step` pipeline (N-Queens, TSP,
+    knapsack); PFSP overrides the hook with the specialized two-phase
+    Pallas pipeline above (`step`). The N-Queens instantiation is
+    op-for-op the pipeline the deleted `engine/nqueens_device.nq_step`
+    ran (same pop, same stable argsort partition, same block write and
+    overflow guard), so node/sol/evals counts are bit-identical to the
+    pre-refactor fork — pinned by the parity suite.
+
+    `tile` is accepted for signature parity with the fast-path hook and
+    ignored (the generic pipeline has no kernel tiling)."""
+    del tile
+    J, capacity = state.prmu.shape
+    A = state.aux.shape[0]
+    B = chunk
+
+    n_pop = jnp.minimum(state.size, B)
+    start = state.size - n_pop
+    valid = jnp.arange(B) < n_pop
+    zero = jnp.zeros((), start.dtype)
+    p_prmu = jax.lax.dynamic_slice(state.prmu, (zero, start), (J, B))
+    depth = jnp.where(
+        valid,
+        jax.lax.dynamic_slice(state.depth, (start,), (B,)).astype(jnp.int32),
+        0)
+    p_aux = jax.lax.dynamic_slice(state.aux, (zero, start), (A, B)) \
+        .astype(jnp.int32)
+
+    sol = state.sol
+    if not problem.leaf_in_evals:
+        # N-Queens-style accounting: a popped complete node is a
+        # solution (reference: nqueens_c.c:104-106); children at full
+        # depth are pushed like any survivor
+        sol = sol + ((depth == J) & valid).sum(dtype=jnp.int64)
+
+    br = problem.branch(tables, p_prmu, depth, p_aux, valid)
+    C = br.children.shape[1]
+    assert C <= B * (problem.branch_factor or J), (
+        f"branch grid {C} wider than the chunk*branching scratch "
+        f"margin {B * (problem.branch_factor or J)}: the overflow "
+        "block write would run out of bounds")
+    bounds = problem.bound(tables, lb_kind, br, state.best).reshape(-1)
+    evaluated = br.evaluated.reshape(-1)
+    if problem.leaf_in_evals:
+        # PFSP-style: every evaluated leaf child counts, the incumbent
+        # tightens from leaf bounds (bound == objective at leaves), and
+        # leaves are never pushed
+        is_leaf = evaluated & problem.is_leaf_cols(tables, br).reshape(-1)
+        sol = sol + is_leaf.sum(dtype=jnp.int64)
+        leaf_best = jnp.where(is_leaf, bounds, I32_MAX).min()
+        best = jnp.minimum(state.best, leaf_best)
+        push = evaluated & ~is_leaf & (bounds < best)
+    else:
+        is_leaf = jnp.zeros_like(evaluated)
+        best = state.best
+        push = evaluated & (bounds < best)
+    n_push = push.sum(dtype=jnp.int32)
+    tree = state.tree + n_push.astype(jnp.int64)
+
+    # stable-partition survivors to the front, block-write at the
+    # cursor (scatter-free push; the same scheme as step/nq_step)
+    order = jnp.argsort(~push, stable=True)
+    children = jnp.take(br.children, order, axis=1)
+    child_depth = jnp.take(br.child_depth, order)
+    child_aux = jnp.take(br.child_aux, order, axis=1)
+
+    if limit is None:
+        limit = problem.usable_rows(capacity, B, J)
+    new_size = start + n_push
+    overflow = new_size > limit
+    write_at = jnp.where(overflow, jnp.asarray(limit, start.dtype), start)
+    keep = lambda new, old: jnp.where(overflow, old, new)  # noqa: E731
+    evals = state.evals + evaluated.sum(dtype=jnp.int64)
+    telem = state.telemetry
+    if telem.shape[-1] > 0:
+        # child buckets bin by PARENT depth (= child_depth - 1), the
+        # same convention as step()/the deleted nq_step; the bound
+        # histograms bin every pruned/surviving child so the audit's
+        # bound_hist_exact invariant holds for every problem (unbounded
+        # problems' 0 / I32_MAX sentinel bounds land in fixed bins)
+        cb = tele.depth_bucket(br.child_depth.astype(jnp.int32) - 1, J)
+        pruned_m = evaluated & ~is_leaf & ~push
+        delta = tele.step_delta(
+            tele.bucket_counts(tele.depth_bucket(depth, J), valid),
+            tele.bucket_counts(cb, push),
+            tele.bucket_counts(cb, pruned_m),
+            tele.bound_hist(bounds, pruned_m, best),
+            tele.bound_hist(bounds, push, best))
+        telem = keep(tele.commit(telem, delta, new_size, best,
+                                 state.best, state.iters), telem)
+    return state._replace(
+        prmu=jax.lax.dynamic_update_slice(state.prmu, children,
+                                          (zero, write_at)),
+        depth=jax.lax.dynamic_update_slice(state.depth, child_depth,
+                                           (write_at,)),
+        aux=jax.lax.dynamic_update_slice(
+            state.aux, child_aux.astype(state.aux.dtype),
+            (zero, write_at)),
+        size=keep(new_size, state.size),
+        best=keep(best, state.best),
+        tree=keep(tree, state.tree),
+        sol=keep(sol, state.sol),
+        iters=state.iters + 1,
+        evals=keep(evals, state.evals),
+        overflow=state.overflow | overflow,
+        telemetry=telem,
+    )
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("problem", "lb_kind", "chunk", "tile"))
+def _run_problem(tables, state: SearchState, problem, lb_kind: int,
+                 chunk: int, max_iters: jax.Array, drain_min: jax.Array,
+                 tile: int = 1024) -> SearchState:
+    def cond(s: SearchState):
+        return (s.size >= drain_min) & ~s.overflow & (s.iters < max_iters)
+
+    body = problem.make_step(tables, lb_kind, chunk, tile, None)
+    return jax.lax.while_loop(cond, lambda s: body(s), state)
+
+
+def run_problem(problem, tables, state: SearchState, lb_kind: int,
+                chunk: int, max_iters: int | None = None,
+                tile: int = 1024, drain_min: int = 1) -> SearchState:
+    """Problem-generic `run`: the plugin's step (fast-path hook or
+    generic_step) to exhaustion in one compiled loop. `max_iters` is a
+    traced scalar like run()'s — segmented drivers hit the compile
+    cache across ceilings."""
+    jobs, capacity = state.prmu.shape[-2:]
+    if int(np.asarray(state.size).max()) > \
+            problem.usable_rows(capacity, chunk, jobs):
+        # as in run(): flag overflow without touching anything — the
+        # caller grows the pool and resumes losslessly (same margin
+        # rule as generic_step's default limit: the two must agree, or
+        # a seeded state could sit past the scratch rows a step writes)
+        return state._replace(overflow=jnp.asarray(True))
+    ceiling = (jnp.iinfo(state.iters.dtype).max if max_iters is None
+               else max_iters)
+    return _run_problem(tables, state, problem, lb_kind, chunk,
+                        jnp.asarray(ceiling, dtype=state.iters.dtype),
+                        jnp.asarray(max(drain_min, 1), dtype=jnp.int32),
+                        tile=tile)
+
+
+def solve(problem, table: np.ndarray, lb_kind: int | None = None,
+          init_ub: int | None = None, chunk: int = 64,
+          capacity: int | None = None, max_iters: int | None = None,
+          tile: int = 1024) -> SearchResult:
+    """Single-device host entry for ANY registered problem: build the
+    plugin's tables, seed the pool from its root, run to exhaustion
+    with lossless grow-on-overflow (checkpoint.grow — the same recovery
+    path search() uses). `problem` is a plugin object or a registry
+    name."""
+    from . import checkpoint
+
+    if isinstance(problem, str):
+        from .. import problems as problems_pkg
+        problem = problems_pkg.get(problem)
+    table = np.asarray(table)
+    if lb_kind is None:
+        lb_kind = problem.default_lb
+    tables = problem.make_tables(table)
+    jobs = problem.slots(table)
+    if capacity is None:
+        capacity = problem.default_capacity(table)
+    prmu0, depth0 = problem.root(table)
+    state = init_state(jobs, capacity, init_ub, prmu0=prmu0,
+                       depth0=depth0,
+                       aux0=problem.seed_aux(table, prmu0, depth0))
+    while True:
+        out = run_problem(problem, tables, state, lb_kind, chunk,
+                          max_iters, tile=tile)
+        if not bool(out.overflow):
+            return SearchResult(
+                explored_tree=int(out.tree), explored_sol=int(out.sol),
+                best=int(out.best), iters=int(out.iters),
+                evals=int(out.evals), overflow=False,
+                complete=int(out.size) == 0,
+            )
+        capacity *= 2
+        state = checkpoint.grow(out, capacity)
 
 
 def default_capacity(jobs: int, machines: int, floor: int = 1 << 18) -> int:

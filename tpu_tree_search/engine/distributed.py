@@ -37,14 +37,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..obs import audit as obs_audit
 from ..obs import tracelog
-from ..ops import batched, reference as ref
+from ..ops import reference as ref
 from ..ops.batched import BoundTables
 from ..parallel import balance as bal
 from ..parallel.mesh import WORKER_AXIS, shard_map, worker_mesh
 from . import sequential as seq
 from . import telemetry as tele
-from .device import I32_MAX, SearchState, row_limit as device_row_limit, \
-    step
+from .device import I32_MAX, SearchState
 
 AX = WORKER_AXIS
 
@@ -372,7 +371,8 @@ def build_dist_loop(mesh, tables, make_local_step,
 
 class DistResult:
     def __init__(self, explored_tree, explored_sol, best, per_device,
-                 warmup_tree, warmup_sol, complete=True, telemetry=None):
+                 warmup_tree, warmup_sol, complete=True, telemetry=None,
+                 problem: str = "pfsp"):
         self.explored_tree = explored_tree
         self.explored_sol = explored_sol
         self.best = best
@@ -382,6 +382,10 @@ class DistResult:
         self.complete = complete            # all pools drained
         self.telemetry = telemetry          # telemetry.summarize dict
                                             # (None when the block is off)
+        self.problem = problem              # registry name; the audit
+                                            # keys its conservation
+                                            # identity off the plugin's
+                                            # accounting semantics
 
 
 def _shard_frontier(fr: Frontier, n_dev: int, capacity: int, jobs: int,
@@ -662,33 +666,49 @@ class _DistDriver:
         return warm_fn(abs_tables, max_iters, bound_cap, *state, via=via)
 
 
-def _pfsp_driver(mesh, tables, p_times, lb_kind: int, chunk: int,
-                 balance_period: int, transfer_cap: int,
-                 min_transfer: int, adt, loop_cache,
-                 limit_fn=None) -> "_DistDriver":
+def _resolve_problem(problem):
+    """Registry-name-or-plugin-object -> plugin object (lazy import:
+    the problems package imports engine modules from inside methods)."""
+    if isinstance(problem, str):
+        from .. import problems as problems_pkg
+        return problems_pkg.get(problem)
+    return problem
+
+
+def _problem_driver(problem, mesh, tables, table, lb_kind: int,
+                    chunk: int, balance_period: int, transfer_cap: int,
+                    min_transfer: int, adt, loop_cache,
+                    limit_fn=None) -> "_DistDriver":
     """ONE construction shared by the serving path (search) and the
-    boot pre-warm (prewarm): the loop key and every trace-specializing
-    knob come from here, so a pre-warmed executable is key-identical to
-    the one a real request at the same knobs builds — a warm that
-    readied a different key would be pure waste. `limit_fn` overrides
-    the usable-row bound (the chunk-ladder passes the unified
-    across-rung limit; None = this chunk's own row_limit)."""
-    jobs = p_times.shape[1]
+    boot pre-warm (prewarm), for ANY registered problem: the loop key
+    and every trace-specializing knob come from here, so a pre-warmed
+    executable is key-identical to the one a real request at the same
+    knobs builds — a warm that readied a different key would be pure
+    waste. The key leads with the problem's registry name plus the pool
+    width and the table's leading dimension — together they pin the
+    instance-table SHAPE (the trace specialization; values are runtime
+    arguments) for every registered problem, and PFSP keys keep their
+    pre-plugin ``pfsp/jobs/machines/...`` layout (test-pinned; persisted
+    AOT entries stay addressable), so two problems can never alias one
+    executable. `limit_fn` overrides the usable-row bound (the
+    chunk-ladder passes the unified across-rung limit; None = this
+    chunk's own row_limit)."""
+    jobs = problem.slots(table)
 
     def make_local_step(t, limit):
-        return functools.partial(step, t, lb_kind, chunk, limit=limit)
+        return problem.make_step(t, lb_kind, chunk, 1024, limit)
 
     return _DistDriver(
         mesh, tables, make_local_step, balance_period, transfer_cap,
         min_transfer,
-        limit_fn=limit_fn or (lambda cap: device_row_limit(cap, chunk,
-                                                           jobs)),
+        limit_fn=limit_fn or (lambda cap: problem.usable_rows(cap, chunk,
+                                                              jobs)),
         loop_cache=loop_cache,
-        loop_key=("pfsp", jobs, p_times.shape[0], lb_kind, chunk,
-                  str(adt)))
+        loop_key=(problem.name, jobs, int(np.asarray(table).shape[0]),
+                  lb_kind, chunk, str(adt)))
 
 
-def _ladder_plan(mesh, tables, p_times, lb_kind: int, chunk: int,
+def _ladder_plan(problem, mesh, tables, table, lb_kind: int, chunk: int,
                  balance_period: int, transfer_cap: int | None,
                  min_transfer: int | None, adt, loop_cache
                  ) -> tuple[tuple, dict]:
@@ -711,24 +731,25 @@ def _ladder_plan(mesh, tables, p_times, lb_kind: int, chunk: int,
     is key-identical to the one a ladder search builds."""
     from .ladder import min_rung_for, rungs_for
 
-    jobs, machines = p_times.shape[1], p_times.shape[0]
+    jobs, aux_rows = problem.slots(table), problem.aux_rows(table)
     n_dev = mesh.devices.size
     cfgs = []
     for c in rungs_for(chunk, min_chunk=min_rung_for(lb_kind)):
         tc = (transfer_cap if transfer_cap is not None
-              else default_transfer_cap(c, jobs, machines, n_dev,
+              else default_transfer_cap(c, jobs, aux_rows, n_dev,
                                         aux_itemsize=adt.itemsize))
         mt = min_transfer if min_transfer is not None else 2 * c
         cfgs.append((c, tc, mt))
 
     def unified_limit(cap: int) -> int:
-        return min(min(device_row_limit(cap, c, jobs), cap - n_dev * tc)
+        return min(min(problem.usable_rows(cap, c, jobs),
+                       cap - n_dev * tc)
                    for c, tc, _ in cfgs)
 
     drivers = {
-        c: _pfsp_driver(mesh, tables, p_times, lb_kind, c,
-                        balance_period, tc, mt, adt, loop_cache,
-                        limit_fn=unified_limit)
+        c: _problem_driver(problem, mesh, tables, table, lb_kind, c,
+                           balance_period, tc, mt, adt, loop_cache,
+                           limit_fn=unified_limit)
         for c, tc, mt in cfgs}
     return tuple(sorted(drivers)), drivers
 
@@ -738,7 +759,8 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
             min_seed: int = 32, n_devices: int | None = None,
             mesh=None, transfer_cap: int | None = None,
             min_transfer: int | None = None, loop_cache=None,
-            donate: bool = False, ladder: bool | None = None) -> str:
+            donate: bool = False, ladder: bool | None = None,
+            problem="pfsp") -> str:
     """Ready the distributed loop's executable for this shape WITHOUT
     running a search — the serve-boot pre-warm entry (cli `serve
     --prewarm` / SearchServer.prewarm_boot drive it per submesh and
@@ -765,18 +787,19 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
         # coordination (the pod-scale arc, ROADMAP item 1)
     if mesh is None:
         mesh = worker_mesh(n_devices)
-    from .device import aux_dtype as _aux_dtype, default_capacity
-    jobs, machines = p_times.shape[1], p_times.shape[0]
+    prob = _resolve_problem(problem)
+    table = np.asarray(p_times)
+    jobs, aux_rows = prob.slots(table), prob.aux_rows(table)
     if capacity is None:
-        capacity = default_capacity(jobs, machines)
-    tables = batched.make_tables(p_times)
-    adt = _aux_dtype(p_times)
+        capacity = prob.default_capacity(table)
+    tables = prob.make_tables(table)
+    adt = prob.aux_dtype(table)
     if ladder is None:
         ladder = _cfg.env_flag(_cfg.LADDER_FLAG)
     drivers = None
     if ladder:
         rungs, drivers = _ladder_plan(
-            mesh, tables, p_times, lb_kind, chunk, balance_period,
+            prob, mesh, tables, table, lb_kind, chunk, balance_period,
             transfer_cap, min_transfer, adt, loop_cache)
         if len(rungs) < 2:
             drivers = None             # single rung: plain path
@@ -785,47 +808,30 @@ def prewarm(p_times: np.ndarray, lb_kind: int = 1, chunk: int = 64,
     else:
         if transfer_cap is None:
             transfer_cap = default_transfer_cap(
-                chunk, jobs, machines, mesh.devices.size,
+                chunk, jobs, aux_rows, mesh.devices.size,
                 aux_itemsize=adt.itemsize)
         min_transfer = min_transfer or 2 * chunk
-        driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
-                              balance_period, transfer_cap,
-                              min_transfer, adt, loop_cache)
+        driver = _problem_driver(prob, mesh, tables, table, lb_kind,
+                                 chunk, balance_period, transfer_cap,
+                                 min_transfer, adt, loop_cache)
     # mirror seed()'s capacity pre-grow rule with the warm-up target as
     # the stripe estimate: at production capacities the loop never
     # fires (limit >> min_seed); at toy capacities it keeps the warmed
     # key aligned with what a fresh request would actually build
     while driver.limit(capacity) < max(min_seed, 1):
         capacity *= 2
-    with tracelog.span("executor.prewarm", jobs=jobs,
-                       machines=machines, lb_kind=lb_kind, chunk=chunk,
+    with tracelog.span("executor.prewarm", problem=prob.name, jobs=jobs,
+                       machines=aux_rows, lb_kind=lb_kind, chunk=chunk,
                        capacity=capacity, donate=donate,
                        ladder=bool(drivers)) as sp:
-        how = driver.warm(capacity, jobs, machines, adt, donate=donate)
+        how = driver.warm(capacity, jobs, aux_rows, adt, donate=donate)
         if drivers is not None:
             for c, d in drivers.items():
                 if d is not driver:
-                    d.warm(capacity, jobs, machines, adt,
+                    d.warm(capacity, jobs, aux_rows, adt,
                            donate=donate, via="ladder")
         sp.set(how=how)
     return how
-
-
-def run_with_retry(mesh, tables, make_local_step, frontier: Frontier,
-                   capacity: int, jobs: int, init_best: int,
-                   balance_period: int, transfer_cap: int,
-                   min_transfer: int, max_rounds: int | None,
-                   limit_fn) -> SearchState:
-    """Seed the mesh from a frontier and run the SPMD loop to exhaustion,
-    growing the pools and RESUMING on overflow (shared by the PFSP and
-    N-Queens distributed engines). `max_rounds` bounds the number of
-    balance rounds (debug truncation)."""
-    driver = _DistDriver(mesh, tables, make_local_step, balance_period,
-                         transfer_cap, min_transfer, limit_fn)
-    state = driver.seed(frontier, capacity, jobs, init_best)
-    max_iters = (None if max_rounds is None
-                 else max_rounds * balance_period)
-    return driver.run(state, max_iters)
 
 
 def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
@@ -843,7 +849,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
            loop_cache=None, checkpoint_meta_extra=None,
            overlap: bool | None = None,
            incumbent_board=None, incumbent_key=None,
-           ladder: bool | None = None, tuner=None) -> DistResult:
+           ladder: bool | None = None, tuner=None,
+           problem="pfsp") -> DistResult:
     """Distributed B&B over all available devices (the flagship engine;
     capability parity with pfsp_dist_multigpu_cuda.c's pfsp_search).
 
@@ -935,25 +942,44 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     when segmented execution runs — it switches at segment
     boundaries, and a one-shot exhaustion run has none. A rung's loop
     grown past its pre-warmed capacity (overflow recovery) recompiles
-    lazily on its next use, booked as a normal unplanned compile."""
+    lazily on its next use, booked as a normal unplanned compile.
+
+    `problem` (registry name or plugin object, default "pfsp") selects
+    the workload: `p_times` is then the problem's 2-D instance table
+    (problems/base.py documents the per-problem format), the plugin
+    supplies the step pipeline / warm-up / aux seeding, and every
+    executable/tuning/checkpoint key carries the problem name. A
+    checkpoint records its problem and a cross-problem resume is
+    REFUSED — a pool of TSP tours re-homed under a PFSP step would be
+    silent garbage. The `-C` host tier is a PFSP-only capability
+    (plugin.supports_host_tier); passing host_fraction > 0 for another
+    problem raises."""
     from ..utils import config as _cfg
     from . import checkpoint, hybrid, incumbent as inc_mod
 
+    prob = _resolve_problem(problem)
+    table = np.asarray(p_times)
     if mesh is None:
         mesh = worker_mesh(n_devices)
     n_dev = mesh.devices.size
-    jobs = p_times.shape[1]
+    jobs = prob.slots(table)
+    if host_fraction > 0 and not prob.supports_host_tier:
+        raise ValueError(
+            f"the -C host tier is not supported for problem "
+            f"{prob.name!r} (native host kernels are PFSP-only)")
     if chunk is None or balance_period is None:
         # adaptive-dispatch resolution for the knobs the caller left
         # open: tuned cache entry (zero probes — the hot path must
         # never probe) else the measured-defaults table
         from ..tune import defaults as tune_defaults
         if tuner is not None:
-            params = tuner.resolve(jobs, p_times.shape[0], lb_kind,
-                                   n_workers=n_dev, allow_probe=False)
+            params = tuner.resolve(jobs, table.shape[0], lb_kind,
+                                   n_workers=n_dev, allow_probe=False,
+                                   problem=prob.name)
         else:
             params = tune_defaults.params_for("serving", jobs,
-                                              p_times.shape[0])
+                                              table.shape[0],
+                                              problem=prob.name)
         if chunk is None:
             chunk = params.chunk
             if transfer_cap is None and params.transfer_cap:
@@ -965,9 +991,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                        source=params.source,
                        evals_per_s=params.evals_per_s)
     if tables is None:
-        tables = batched.make_tables(p_times)
-    from .device import aux_dtype as _aux_dtype
-    adt = _aux_dtype(p_times)
+        tables = prob.make_tables(table)
+    adt = prob.aux_dtype(table)
     resumed = None
     if checkpoint_path and checkpoint.resume_path(checkpoint_path):
         # load BEFORE sizing the balance buffers: resume keeps the
@@ -975,8 +1000,20 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         # int32, and a pre-aux legacy file is RECONSTRUCTED as int32 by
         # checkpoint.load), so the byte budget must be priced off the
         # loaded state, not the fresh-run dtype
-        resumed = checkpoint.load_resilient(checkpoint_path,
-                                            p_times=p_times)[:2]
+        resumed = checkpoint.load_resilient(
+            checkpoint_path,
+            p_times=table if prob.name == "pfsp" else None)[:2]
+        # a snapshot records its problem (pre-stamp legacy snapshots
+        # are all PFSP); a cross-problem resume is refused — the pool
+        # rows only mean anything under the problem that wrote them
+        saved_prob = resumed[1].get("problem")
+        saved_prob = ("pfsp" if saved_prob is None
+                      else str(np.asarray(saved_prob)))
+        if saved_prob != prob.name:
+            raise ValueError(
+                f"checkpoint {checkpoint_path} was written by problem "
+                f"{saved_prob!r}; refusing to resume it as "
+                f"{prob.name!r} (pick a fresh tag/checkpoint path)")
         adt = np.asarray(resumed[0].aux).dtype
     if ladder is None:
         ladder = _cfg.env_flag(_cfg.LADDER_FLAG)
@@ -996,13 +1033,14 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         # rung drivers get the caller's EXPLICIT transfer knobs (None
         # derives per rung) and one unified limit — see _ladder_plan
         rungs, ladder_drivers = _ladder_plan(
-            mesh, tables, p_times, lb_kind, chunk, balance_period,
+            prob, mesh, tables, table, lb_kind, chunk, balance_period,
             transfer_cap, min_transfer, adt, loop_cache)
         if len(rungs) < 2:
             ladder_drivers = None      # chunk too small to ladder:
             #                            plain single-driver path
     if transfer_cap is None:
-        transfer_cap = default_transfer_cap(chunk, jobs, p_times.shape[0],
+        transfer_cap = default_transfer_cap(chunk, jobs,
+                                            prob.aux_rows(table),
                                             mesh.devices.size,
                                             aux_itemsize=adt.itemsize)
     min_transfer = min_transfer or 2 * chunk
@@ -1011,9 +1049,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         driver = ladder_drivers[chunk]   # the tuned top rung — also
         #   the seed/resume/commit driver (all rungs share its limit)
     else:
-        driver = _pfsp_driver(mesh, tables, p_times, lb_kind, chunk,
-                              balance_period, transfer_cap, min_transfer,
-                              adt, loop_cache)
+        driver = _problem_driver(prob, mesh, tables, table, lb_kind,
+                                 chunk, balance_period, transfer_cap,
+                                 min_transfer, adt, loop_cache)
 
     session = None
     meta_rung = None          # the checkpoint's recorded ladder rung
@@ -1068,12 +1106,12 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                     host_state, host_fraction)
             if len(h_depth):
                 session = hybrid.HostSession(
-                    p_times, h_prmu, h_depth, lb_kind,
+                    table, h_prmu, h_depth, lb_kind,
                     int(np.asarray(host_state.best).min()),
                     n_threads=host_threads)
         elif len(saved_d):
             host_state = hybrid.restore_host_share(
-                host_state, saved_p, saved_d, p_times)
+                host_state, saved_p, saved_d, table)
         fr = Frontier(prmu=np.zeros((0, jobs), np.int16),
                       depth=np.zeros(0, np.int16),
                       tree=int(meta.get("warmup_tree", 0)),
@@ -1081,21 +1119,21 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                       best=int(np.asarray(host_state.best).min()))
         state = driver.commit(host_state)
     else:
-        with tracelog.span("bfs_warmup", target=min_seed * n_dev) as ws:
-            fr = bfs_warmup(p_times, lb_kind, init_ub,
-                            target=min_seed * n_dev)
+        with tracelog.span("bfs_warmup", problem=prob.name,
+                           target=min_seed * n_dev) as ws:
+            fr = prob.warmup(table, lb_kind, init_ub,
+                             target=min_seed * n_dev)
             ws.set(frontier=len(fr.depth), tree=fr.tree)
         init_best = (fr.best if init_ub is None
                      else min(fr.best, int(init_ub)))
         dmask, h_prmu, h_depth = hybrid.split_host_share(
             fr.prmu, fr.depth, host_fraction)
         if len(h_depth):
-            session = hybrid.HostSession(p_times, h_prmu, h_depth,
+            session = hybrid.HostSession(table, h_prmu, h_depth,
                                          lb_kind, init_best,
                                          n_threads=host_threads)
             fr.prmu, fr.depth = fr.prmu[dmask], fr.depth[dmask]
-        fr.aux = ref.prefix_front_remain(
-            p_times, fr.prmu, fr.depth)[:, :p_times.shape[0]].astype(adt)
+        fr.aux = prob.seed_aux(table, fr.prmu, fr.depth)
         state = driver.seed(fr, capacity, jobs, init_best)
 
     if overlap is None:
@@ -1131,14 +1169,15 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         # fallback, correct but a silent perf and accounting loss.
         cap_now = int(state.prmu.shape[-1])
         for c, d in ladder_drivers.items():
-            d.warm(cap_now, jobs, p_times.shape[0], adt,
+            d.warm(cap_now, jobs, prob.aux_rows(table), adt,
                    donate=use_overlap, via="ladder")
 
     client = None
     if incumbent_board is not None:
         client = inc_mod.BoardClient(
             incumbent_board,
-            incumbent_key or inc_mod.instance_key(p_times))
+            incumbent_key or inc_mod.share_key(table,
+                                               problem=prob.name))
         # seed the exchange with this search's starting incumbent (a
         # resumed checkpoint's best, or the warm-up/init_ub bound) so
         # same-instance peers tighten before our first segment lands
@@ -1160,6 +1199,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                              bound_cap=client.cap() if client else None)
     else:
         ckpt_meta = {"warmup_tree": fr.tree, "warmup_sol": fr.sol,
+                     # the snapshot's problem stamp: resume refuses a
+                     # cross-problem re-home (checked above)
+                     "problem": prob.name,
                      # the host tier's seed rides every checkpoint so a
                      # killed -C run can be resumed without losing the
                      # carved subtrees (re-exploring the share from its
@@ -1297,6 +1339,7 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         },
         warmup_tree=fr.tree, warmup_sol=fr.sol,
         complete=int(sizes.sum()) == 0,
+        problem=prob.name,
     )
     if obs_audit.enabled():
         # node-conservation audit on every result (host-side sums over

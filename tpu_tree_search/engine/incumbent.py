@@ -72,6 +72,20 @@ def instance_key(p_times, group: str | None = None) -> str:
     return f"{group}/{digest}" if group else digest
 
 
+def share_key(table, problem: str = "pfsp",
+              group: str | None = None) -> str:
+    """THE cross-request share-key rule, problem-aware: PFSP keys keep
+    their pre-plugin form (bare digest / group-namespaced), every other
+    problem is namespaced by its registry name so two problems with
+    bit-identical tables can never exchange bounds. The server's
+    dispatch and engine/distributed.search's default both resolve keys
+    HERE — two call sites deriving the namespace independently would
+    drift and silently stop sharing."""
+    if problem != "pfsp":
+        group = f"{problem}:{group}" if group else problem
+    return instance_key(table, group=group)
+
+
 class IncumbentBoard:
     """Thread-safe best-bound map; values only ever decrease (min-fold).
 

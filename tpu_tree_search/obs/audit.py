@@ -167,10 +167,25 @@ def _json_safe(v):
 # ------------------------------------------------------------- the checks
 
 
+def _sol_in_evals(problem: str) -> bool:
+    """Whether the problem's accounting counts solutions among the
+    evaluated children (PFSP-style: branched + pruned + sol == evals)
+    or among popped nodes (N-Queens-style: branched + pruned == evals)
+    — problems/base.Problem.leaf_in_evals, resolved by registry name
+    so the auditor and the engine cannot drift."""
+    try:
+        from ..problems import get
+        return bool(get(problem).leaf_in_evals)
+    except Exception:  # noqa: BLE001 — unknown/legacy name: PFSP rule
+        return True
+
+
 def check_result(res) -> list[Finding]:
     """Audit a DistResult: telemetry-vs-counter exactness and total
     node conservation (engine/distributed.search calls this on every
-    result when `enabled()`)."""
+    result when `enabled()`). The conservation identity is problem-
+    parameterized via the result's `problem` name (see _sol_in_evals);
+    everything else is problem-blind."""
     out = []
     pd = res.per_device
     dev_tree = int(np.asarray(pd.get("tree", [0])).sum())
@@ -195,30 +210,34 @@ def check_result(res) -> list[Finding]:
             pool=int(np.asarray(final).sum())))
     t = res.telemetry
     if t is not None:
-        out.extend(_check_telemetry(t, tree=dev_tree, sol=dev_sol,
-                                    evals=dev_evals,
-                                    sent=int(np.asarray(
-                                        pd.get("sent", [0])).sum()),
-                                    recv=int(np.asarray(
-                                        pd.get("recv", [0])).sum())))
+        out.extend(_check_telemetry(
+            t, tree=dev_tree, sol=dev_sol, evals=dev_evals,
+            sent=int(np.asarray(pd.get("sent", [0])).sum()),
+            recv=int(np.asarray(pd.get("recv", [0])).sum()),
+            sol_in_evals=_sol_in_evals(
+                getattr(res, "problem", "pfsp"))))
     return out
 
 
 def _check_telemetry(summary: dict, tree: int, sol: int, evals: int,
                      sent: int | None = None,
-                     recv: int | None = None) -> list[Finding]:
+                     recv: int | None = None,
+                     sol_in_evals: bool = True) -> list[Finding]:
     """Telemetry bucket sums vs. engine counters (the ISSUE's
     popped = pruned + branched-consumed identity, in this engine's
-    terms: every evaluated child is branched, pruned or a leaf)."""
+    terms: every evaluated child is branched, pruned or a leaf —
+    leaves counting toward `evals` only under PFSP-style accounting,
+    see _sol_in_evals)."""
     out = []
     branched = int(sum(summary["branched"]))
     pruned = int(sum(summary["pruned"]))
     out.append(record("branched_is_tree", branched == tree,
                       branched=branched, tree=tree))
+    want_evals = branched + pruned + (sol if sol_in_evals else 0)
     out.append(record("children_conservation",
-                      branched + pruned + sol == evals,
+                      want_evals == evals,
                       branched=branched, pruned=pruned, sol=sol,
-                      evals=evals))
+                      sol_in_evals=sol_in_evals, evals=evals))
     out.append(record(
         "bound_hist_exact",
         sum(summary["bound_hist_pruned"]) == pruned
@@ -308,7 +327,8 @@ def check_incumbent_fold(key: str, prev_cap, new_cap) -> Finding:
                   new_cap=int(new_cap))
 
 
-def check_state(state, edge: str = "segment") -> list[Finding]:
+def check_state(state, edge: str = "segment",
+                problem: str = "pfsp") -> list[Finding]:
     """Audit a host-side state's internal telemetry/counter exactness
     (per-segment hook; no-op without the telemetry block)."""
     tele_w = int(state.telemetry.shape[-1])
@@ -319,7 +339,8 @@ def check_state(state, edge: str = "segment") -> list[Finding]:
     sums = state_sums(state)
     out = _check_telemetry(summary, tree=sums["tree"], sol=sums["sol"],
                            evals=sums["evals"], sent=sums["sent"],
-                           recv=sums["recv"])
+                           recv=sums["recv"],
+                           sol_in_evals=_sol_in_evals(problem))
     for f in out:
         f.detail["edge"] = edge
     return out

@@ -1,3 +1,13 @@
-from . import taillard, pfsp, nqueens
+"""Problem plugins: the workload layer of the generic B&B engine.
 
-__all__ = ["taillard", "pfsp", "nqueens"]
+Importing this package registers the built-in plugins (PFSP, N-Queens,
+TSP, 0/1 knapsack) in the registry; `get(name)` is the single
+resolution point the engine, service, spool and CLI share. See
+problems/base.py for the protocol.
+"""
+
+from . import base, knapsack, nqueens, pfsp, taillard, tsp
+from .base import BranchOut, Problem, get, names, register
+
+__all__ = ["base", "taillard", "pfsp", "nqueens", "tsp", "knapsack",
+           "BranchOut", "Problem", "get", "names", "register"]

@@ -1,0 +1,276 @@
+"""0/1 knapsack problem plugin with the fractional-relaxation bound.
+
+A node is a decision prefix: items `0..depth-1` (in density-sorted
+order — `make_tables` sorts once, and every host helper uses the same
+deterministic order) are decided, `prmu[i]` ∈ {0, 1} records the
+choice. Branching factor is 2 (skip / take), so the child grid is
+(chunk, 2) instead of the permutation problems' (chunk, n). `aux`
+carries two rows: accumulated weight and accumulated value.
+
+The engine minimizes, so the objective is the NEGATED total value:
+``bound = -(value + fractional_ub(remaining))``. The fractional
+relaxation (Dantzig bound) greedily fills the residual capacity in
+density order and takes a fraction of the first item that does not
+fit; the floor of the fractional term keeps the bound integral AND
+admissible (the integer optimum is an integer below the real-valued
+relaxation). An over-capacity "take" child is infeasible and bounds to
+I32_MAX. A child at depth n is a leaf whose bound is exactly -value.
+
+Instance table (3, n) int32: row 0 weights (>= 1), row 1 values
+(>= 0), row 2 is [capacity, 0, ...].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import numpy as np
+
+from . import base
+
+I32_MAX = base.I32_MAX
+
+
+class KnapsackTables(NamedTuple):
+    w: object        # (n,) int32 weights, density-sorted descending
+    v: object        # (n,) int32 values, same order
+    cap: object      # () int32 capacity
+    cumw: object     # (n+1,) int32 prefix weight sums over the order
+
+
+def make_table(weights, values, capacity: int) -> np.ndarray:
+    """Assemble the (3, n) instance table."""
+    w = np.asarray(weights, np.int32)
+    v = np.asarray(values, np.int32)
+    assert w.shape == v.shape and w.ndim == 1
+    cap_row = np.zeros_like(w)
+    cap_row[0] = int(capacity)
+    return np.stack([w, v, cap_row])
+
+
+def _sorted_items(table: np.ndarray):
+    """(weights, values, capacity, order) in density-descending order —
+    THE deterministic order every traced and host-side helper shares
+    (stable index tie-break, so equal densities cannot reorder between
+    builds)."""
+    t = np.asarray(table)
+    w = t[0].astype(np.int64)
+    v = t[1].astype(np.int64)
+    cap = int(t[2, 0])
+    order = np.lexsort((np.arange(len(w)), -(v / np.maximum(w, 1))))
+    return w[order].astype(np.int32), v[order].astype(np.int32), cap, \
+        order
+
+
+def _fractional_ub(w: np.ndarray, v: np.ndarray, start: int,
+                   rem_cap: int) -> int:
+    """Host-side Dantzig bound over sorted items[start:] at `rem_cap`
+    residual capacity (the oracle the traced bound must match)."""
+    total = 0
+    r = int(rem_cap)
+    for i in range(start, len(w)):
+        if int(w[i]) <= r:
+            r -= int(w[i])
+            total += int(v[i])
+        else:
+            total += (r * int(v[i])) // max(int(w[i]), 1)
+            break
+    return total
+
+
+@dataclasses.dataclass(frozen=True)
+class KnapsackInstance:
+    """A knapsack instance plus test helpers."""
+
+    weights: np.ndarray
+    values: np.ndarray
+    capacity: int
+
+    @property
+    def table(self) -> np.ndarray:
+        return make_table(self.weights, self.values, self.capacity)
+
+    @staticmethod
+    def synthetic(n: int, seed: int = 0) -> "KnapsackInstance":
+        rng = np.random.default_rng(seed)
+        w = rng.integers(1, 50, size=n, dtype=np.int32)
+        v = rng.integers(1, 100, size=n, dtype=np.int32)
+        return KnapsackInstance(weights=w, values=v,
+                                capacity=int(w.sum()) // 2)
+
+    def optimum(self) -> int:
+        """Exact optimal value by dynamic programming (test oracle)."""
+        dp = np.zeros(self.capacity + 1, np.int64)
+        for w, v in zip(self.weights, self.values):
+            w, v = int(w), int(v)
+            if w <= self.capacity:
+                dp[w:] = np.maximum(dp[w:], dp[:-w] + v)
+        return int(dp.max())
+
+
+# Pinned golden instances of known optimum (Kreher & Stinson's classic
+# P01/P02 test set; the tests ALSO re-derive each optimum by DP so the
+# constants cannot drift from the data).
+GOLDEN = {
+    "p01": (KnapsackInstance(
+        weights=np.array([23, 31, 29, 44, 53, 38, 63, 85, 89, 82]),
+        values=np.array([92, 57, 49, 68, 60, 43, 67, 84, 87, 72]),
+        capacity=165), 309),
+    "p02": (KnapsackInstance(
+        weights=np.array([12, 7, 11, 8, 9]),
+        values=np.array([24, 13, 23, 15, 16]),
+        capacity=26), 51),
+}
+
+
+class KnapsackProblem(base.Problem):
+    name = "knapsack"
+    leaf_in_evals = True
+    supports_host_tier = False
+    lb_kinds = (1,)
+    default_lb = 1
+    telemetry_labels = {"objective": "neg_value"}
+
+    def validate(self, table: np.ndarray) -> str | None:
+        t = np.asarray(table)
+        if t.ndim != 2 or t.shape[0] != 3 or not 2 <= t.shape[1] <= 4096:
+            return (f"knapsack table must be (3, 2<=n<=4096) "
+                    f"[weights; values; capacity row], got shape "
+                    f"{t.shape}")
+        if (t[0] < 1).any():
+            return "knapsack weights must be >= 1"
+        if (t[1] < 0).any() or int(t[1].max()) > 2**20:
+            return "knapsack values must be in [0, 2^20]"
+        if int(t[2, 0]) < 0:
+            return "knapsack capacity must be >= 0"
+        # the traced bound accumulates weight/value sums in int32
+        # (cumw prefix sums, int_val, ub = V + int_val + frac): totals
+        # past 2^30 would wrap silently and turn the 'proven' optimum
+        # into garbage — refuse at admission instead
+        if int(t[0].astype(np.int64).sum()) > 2**30:
+            return "knapsack weights must sum to <= 2^30 (int32 bound)"
+        if int(t[1].astype(np.int64).sum()) > 2**30:
+            return "knapsack values must sum to <= 2^30 (int32 bound)"
+        return None
+
+    def slots(self, table: np.ndarray) -> int:
+        return int(np.asarray(table).shape[1])
+
+    def aux_rows(self, table: np.ndarray) -> int:
+        return 2             # [accumulated weight, accumulated value]
+
+    branch_factor = 2        # skip / take (the engine sizes the pool's
+    #                          scratch margin off this, not off slots)
+
+    def make_tables(self, table: np.ndarray) -> KnapsackTables:
+        import jax.numpy as jnp
+        w, v, cap, _ = _sorted_items(table)
+        cumw = np.zeros(len(w) + 1, np.int32)
+        np.cumsum(w, out=cumw[1:])
+        return KnapsackTables(w=jnp.asarray(w), v=jnp.asarray(v),
+                              cap=jnp.asarray(np.int32(cap)),
+                              cumw=jnp.asarray(cumw))
+
+    def root(self, table: np.ndarray):
+        n = self.slots(table)
+        return (np.zeros((1, n), np.int16), np.zeros(1, np.int16))
+
+    def seed_aux(self, table: np.ndarray, prmu: np.ndarray,
+                 depth: np.ndarray) -> np.ndarray:
+        w, v, _, _ = _sorted_items(table)
+        out = np.zeros((len(depth), 2), np.int32)
+        for k, (p, dep) in enumerate(zip(np.asarray(prmu, np.int64),
+                                         np.asarray(depth))):
+            taken = p[:dep] > 0
+            out[k, 0] = int(w[:dep][taken].sum())
+            out[k, 1] = int(v[:dep][taken].sum())
+        return out
+
+    def host_children(self, table: np.ndarray, node: np.ndarray,
+                      depth: int, best: int):
+        w, v, cap, _ = _sorted_items(table)
+        n = len(w)
+        taken = node[:depth] > 0
+        weight = int(w[:depth][taken].sum())
+        value = int(v[:depth][taken].sum())
+        is_leaf = depth + 1 == n
+        for take in (0, 1):
+            child = node.copy()
+            child[depth] = take
+            cw = weight + take * int(w[depth])
+            cv = value + take * int(v[depth])
+            if cw > cap:
+                bound = I32_MAX
+            else:
+                bound = -(cv + _fractional_ub(w, v, depth + 1,
+                                              cap - cw))
+            yield child, depth + 1, bound, is_leaf
+
+    # ------------------------------------------------ jittable engine
+
+    def branch(self, tables: KnapsackTables, p_prmu, p_depth, p_aux,
+               valid):
+        import jax.numpy as jnp
+        n = tables.w.shape[0]
+        B = p_prmu.shape[1]
+        d = jnp.clip(p_depth, 0, n - 1)
+        w_it = jnp.take(tables.w, d)
+        v_it = jnp.take(tables.v, d)
+        weight, value = p_aux[0], p_aux[1]
+        pos = jnp.arange(n, dtype=jnp.int32)[:, None]
+        skip_b = jnp.where(pos == p_depth[None, :], 0, p_prmu) \
+            .astype(jnp.int16)
+        take_b = jnp.where(pos == p_depth[None, :], 1, p_prmu) \
+            .astype(jnp.int16)
+        # column order b*2 + s (s=0 skip, s=1 take): LIFO pops explore
+        # "take" first, finding greedy-ish incumbents early
+        children = jnp.stack([skip_b, take_b], axis=2).reshape(n, 2 * B)
+        child_depth = jnp.broadcast_to((p_depth + 1)[:, None], (B, 2)) \
+            .reshape(-1).astype(jnp.int16)
+        new_w = jnp.stack([weight, weight + w_it], axis=1).reshape(-1)
+        new_v = jnp.stack([value, value + v_it], axis=1).reshape(-1)
+        evaluated = jnp.broadcast_to(valid[:, None], (B, 2)).reshape(-1)
+        return base.BranchOut(
+            children=children, child_depth=child_depth,
+            child_aux=jnp.stack([new_w, new_v], axis=0),
+            evaluated=evaluated, extras=new_w <= tables.cap)
+
+    def bound(self, tables: KnapsackTables, lb_kind: int, br, best):
+        import jax.numpy as jnp
+        n = tables.w.shape[0]
+        feasible = br.extras
+        s = br.child_depth.astype(jnp.int32)          # first undecided
+        W, V = br.child_aux[0], br.child_aux[1]
+        r = tables.cap - W                            # (C,) residual
+        base_w = jnp.take(tables.cumw, jnp.minimum(s, n))
+        rel = tables.cumw[None, 1:] - base_w[:, None]  # (C, n) incl-i
+        idx = jnp.arange(n, dtype=jnp.int32)[None, :]
+        # weights >= 1 make `rel` strictly increasing over the suffix,
+        # so the fit mask is a prefix of items s..n-1 (Dantzig greedy)
+        can = (idx >= s[:, None]) & (rel <= r[:, None])
+        int_val = jnp.sum(jnp.where(can, tables.v[None, :], 0), axis=1)
+        taken_w = jnp.sum(jnp.where(can, tables.w[None, :], 0), axis=1)
+        k = s + can.sum(axis=1, dtype=jnp.int32)      # first overflow
+        has_frac = k < n
+        kc = jnp.clip(k, 0, n - 1)
+        wk = jnp.take(tables.w, kc)
+        vk = jnp.take(tables.v, kc)
+        frac = jnp.where(
+            has_frac,
+            ((r - taken_w).astype(jnp.int64) * vk.astype(jnp.int64))
+            // jnp.maximum(wk, 1).astype(jnp.int64),
+            0).astype(jnp.int32)
+        ub = V + int_val + frac
+        return jnp.where(feasible, -ub, I32_MAX).astype(jnp.int32)
+
+    def display_objective(self, best: int) -> int:
+        """The engine minimizes -value; report the value."""
+        return -int(best)
+
+    def engine_objective(self, value: int) -> int:
+        """A user-facing value bound seeds the incumbent as -value."""
+        return -int(value)
+
+
+PROBLEM = base.register(KnapsackProblem())

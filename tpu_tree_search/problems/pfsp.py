@@ -21,10 +21,11 @@ Device layout is struct-of-arrays: a pool of N nodes is
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
-from . import taillard
+from . import base, taillard
 
 
 @dataclasses.dataclass(frozen=True)
@@ -86,3 +87,92 @@ def root_node(jobs: int) -> tuple[np.ndarray, int]:
 
 
 ROOT_DEPTH = 0
+
+
+class PFSPProblem(base.Problem):
+    """PFSP as a plugin of the generic engine.
+
+    The flagship workload keeps its specialized pipeline: `make_step`
+    is the Pallas fast-path hook onto engine/device.step (the two-phase
+    LB2 prefilter, tiered compaction, feature-major kernels) — the
+    protocol's `branch`/`bound` decomposition is deliberately NOT used
+    on the hot path, which is exactly what the hook exists for. Host
+    seeding (root/seed_aux/warmup) and the static spec route through
+    the same single functions the engine always used, so a search
+    driven through the plugin is op-identical to the pre-refactor one.
+    """
+
+    name = "pfsp"
+    leaf_in_evals = True
+    supports_host_tier = True
+    lb_kinds = (0, 1, 2)
+    default_lb = 1
+    telemetry_labels = {"objective": "makespan"}
+
+    def validate(self, table: np.ndarray) -> str | None:
+        p = np.asarray(table)
+        if p.ndim != 2 or p.shape[0] < 1 or p.shape[1] < 2:
+            return (f"p_times must be a (machines, jobs>=2) table, "
+                    f"got shape {p.shape}")
+        return None
+
+    def slots(self, table: np.ndarray) -> int:
+        return int(np.asarray(table).shape[1])
+
+    def aux_rows(self, table: np.ndarray) -> int:
+        return int(np.asarray(table).shape[0])
+
+    def aux_dtype(self, table: np.ndarray) -> np.dtype:
+        from ..engine.device import aux_dtype
+        return aux_dtype(np.asarray(table))
+
+    def default_capacity(self, table: np.ndarray) -> int:
+        from ..engine.device import default_capacity
+        t = np.asarray(table)
+        return default_capacity(t.shape[1], t.shape[0])
+
+    def make_tables(self, table: np.ndarray):
+        from ..ops import batched
+        return batched.make_tables(np.asarray(table))
+
+    def root(self, table: np.ndarray):
+        n = self.slots(table)
+        return (np.arange(n, dtype=np.int16)[None, :],
+                np.zeros(1, np.int16))
+
+    def seed_aux(self, table: np.ndarray, prmu: np.ndarray,
+                 depth: np.ndarray) -> np.ndarray:
+        from ..ops import reference as ref
+        t = np.asarray(table)
+        m = t.shape[0]
+        adt = self.aux_dtype(t)
+        if len(depth) == 0:
+            return np.zeros((0, m), adt)
+        return ref.prefix_front_remain(t, prmu, depth)[:, :m].astype(adt)
+
+    def warmup(self, table: np.ndarray, lb_kind: int,
+               init_ub: int | None, target: int):
+        from ..engine import distributed
+        return distributed.bfs_warmup(np.asarray(table), lb_kind,
+                                      init_ub, target)
+
+    def host_children(self, table: np.ndarray, node: np.ndarray,
+                      depth: int, best: int):
+        from ..ops import reference as ref
+        p = np.asarray(table)
+        jobs = p.shape[1]
+        lb1 = ref.make_lb1_data(p)
+        for i in range(depth, jobs):
+            child = node.copy()
+            child[depth], child[i] = child[i], child[depth]
+            bound = ref.lb1_bound(lb1, child, depth, jobs)
+            yield child, depth + 1, int(bound), depth + 1 == jobs
+
+    def make_step(self, tables, lb_kind: int, chunk: int, tile: int,
+                  limit: int | None):
+        from ..engine.device import step
+        return functools.partial(step, tables, lb_kind, chunk,
+                                 tile=tile, limit=limit)
+
+
+PROBLEM = base.register(PFSPProblem())

@@ -65,9 +65,16 @@ class SearchRequest:
     `faults` is a TEST-ONLY per-request fault-injection spec
     (utils/faults syntax), applied thread-scoped so it fires only in
     this request's executor — the deterministic-service-test hook.
+
+    `problem` names the registered workload plugin (problems/base.py);
+    `p_times` is then that problem's 2-D instance table (the name is
+    kept for wire/schema compatibility — every transport already
+    carries it). The default keeps the server a drop-in for every
+    existing PFSP client.
     """
 
     p_times: np.ndarray
+    problem: str = "pfsp"
     lb_kind: int = 1
     init_ub: int | None = None
     priority: int = 0            # higher preempts lower
@@ -98,13 +105,24 @@ class SearchRequest:
     share_group: str | None = None
 
     def validate(self) -> str | None:
-        """Admission-side validation; returns a rejection reason or None."""
+        """Admission-side validation; returns a rejection reason or
+        None. Table-shape and lb rules come from the problem plugin —
+        the single place each workload's instance format is defined."""
+        from .. import problems
+        try:
+            prob = problems.get(self.problem)
+        except KeyError:
+            return (f"unknown problem {self.problem!r} "
+                    f"(registered: {problems.names()})")
         p = np.asarray(self.p_times)
-        if p.ndim != 2 or p.shape[0] < 1 or p.shape[1] < 2:
-            return (f"p_times must be a (machines, jobs>=2) table, "
-                    f"got shape {p.shape}")
-        if self.lb_kind not in (0, 1, 2):
-            return f"lb_kind must be 0, 1 or 2, got {self.lb_kind}"
+        if p.ndim != 2:
+            return f"p_times must be a 2-D table, got shape {p.shape}"
+        reason = prob.validate(p)
+        if reason is not None:
+            return reason
+        if self.lb_kind not in prob.lb_kinds:
+            return (f"lb_kind must be one of {prob.lb_kinds} for "
+                    f"problem {prob.name!r}, got {self.lb_kind}")
         if self.deadline_s is not None and self.deadline_s <= 0:
             return f"deadline_s must be positive, got {self.deadline_s}"
         if self.chunk is not None and self.chunk < 1:
@@ -195,6 +213,7 @@ class RequestRecord:
         out = {
             "id": self.id,
             "state": self.state,
+            "problem": self.request.problem,
             "priority": self.request.priority,
             "deadline_s": self.request.deadline_s,
             "lb_kind": self.request.lb_kind,

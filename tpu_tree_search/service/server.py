@@ -512,8 +512,10 @@ class SearchServer:
                      and (r.request.tag or r.id) == request.tag), None)
                 if done is not None:
                     prior = done.request
-                    if (np.array_equal(np.asarray(prior.p_times),
-                                       np.asarray(request.p_times))
+                    if (prior.problem == request.problem
+                            and np.array_equal(
+                                np.asarray(prior.p_times),
+                                np.asarray(request.p_times))
                             and prior.lb_kind == request.lb_kind
                             and prior.init_ub == request.init_ub):
                         tracelog.event("request.reserved_terminal",
@@ -623,8 +625,9 @@ class SearchServer:
 
         def add(jobs, machines, lb=1, chunk=chunk_default,
                 capacity=None, p_times=None, balance_period=4,
-                min_seed=32):
-            k = (jobs, machines, lb, chunk, capacity, balance_period)
+                min_seed=32, problem="pfsp"):
+            k = (problem, jobs, machines, lb, chunk, capacity,
+                 balance_period)
             if k in seen:
                 return
             seen.add(k)
@@ -632,7 +635,7 @@ class SearchServer:
                            "lb": lb, "chunk": chunk,
                            "capacity": capacity, "p_times": p_times,
                            "balance_period": balance_period,
-                           "min_seed": min_seed})
+                           "min_seed": min_seed, "problem": problem})
 
         for token in (t.strip().lower() for t in spec.split(",")):
             if not token:
@@ -654,9 +657,11 @@ class SearchServer:
                         # lookup replays this boot's winner) else the
                         # serving defaults tier
                         tk = self._tuned_kwargs(p.shape[1], p.shape[0],
-                                                lb=req.lb_kind)
+                                                lb=req.lb_kind,
+                                                problem=req.problem)
                         dflt = tune_defaults.params_for(
-                            "serving", p.shape[1], p.shape[0])
+                            "serving", p.shape[1], p.shape[0],
+                            problem=req.problem)
                         if bchunk is None:
                             bchunk = tk.get("chunk", dflt.chunk)
                         if bperiod is None:
@@ -665,7 +670,7 @@ class SearchServer:
                     add(p.shape[1], p.shape[0], lb=req.lb_kind,
                         chunk=bchunk, capacity=req.capacity,
                         p_times=p, balance_period=bperiod,
-                        min_seed=req.min_seed)
+                        min_seed=req.min_seed, problem=req.problem)
             elif "x" in token:
                 jobs, _, machines = token.partition("x")
                 add(int(jobs), int(machines))
@@ -694,6 +699,7 @@ class SearchServer:
                 balance_period=shape["balance_period"],
                 min_seed=shape["min_seed"], mesh=mesh,
                 loop_cache=self.cache,
+                problem=shape.get("problem", "pfsp"),
                 # the pipelined driver dispatches the donated-pool
                 # variant; warm the one this server will actually run
                 donate=self.overlap)
@@ -728,7 +734,7 @@ class SearchServer:
         return summary
 
     def _tuned_kwargs(self, jobs: int, machines: int,
-                      lb: int = 1) -> dict:
+                      lb: int = 1, problem: str = "pfsp") -> dict:
         """Tuned dispatch knobs for a pre-warm family shape: the
         tuning cache when warm, a PROBE at boot when `tune_at_boot`
         (persisted — the next boot replays it with zero probes), else
@@ -740,7 +746,8 @@ class SearchServer:
             n_workers = self.slots[0].mesh.devices.size
             params = self.tuner.resolve(jobs, machines, lb,
                                         n_workers=n_workers,
-                                        allow_probe=self.tune_at_boot)
+                                        allow_probe=self.tune_at_boot,
+                                        problem=problem)
         except Exception as e:  # noqa: BLE001 — tuning is an
             # optimization; the default-knob warm still happens
             tracelog.event("tuner.boot_failed", jobs=jobs,
@@ -1341,15 +1348,21 @@ class SearchServer:
     # ----------------------------------------------------------- executor
 
     def _execute(self, slot: _Slot, rec: RequestRecord) -> None:
-        from ..engine import checkpoint, device, distributed
+        from ..engine import checkpoint, distributed
 
         req = rec.request
         p = np.asarray(req.p_times)
-        jobs, machines = p.shape[1], p.shape[0]
-        capacity = req.capacity or device.default_capacity(jobs, machines)
+        from .. import problems
+        prob = problems.get(req.problem)
+        jobs, machines = prob.slots(p), p.shape[0]
+        capacity = req.capacity or prob.default_capacity(p)
         evt = slot.stop_event
+        # phase attribution prices the PFSP kernels; other problems
+        # skip it rather than publish numbers measured on the wrong
+        # pipeline
         unit_costs = (self._unit_costs(req)
-                      if self.phase_profile is not None else None)
+                      if self.phase_profile is not None
+                      and req.problem == "pfsp" else None)
 
         def hb(rep):
             rec.last_heartbeat_t = time.monotonic()
@@ -1399,15 +1412,21 @@ class SearchServer:
             try:
                 with scope, tracelog.span(
                         "request.execute", dispatch=rec.dispatches,
+                        problem=req.problem,
                         jobs=jobs, machines=machines,
                         lb_kind=req.lb_kind) as ex_span:
                     inc_key = None
                     if self.incumbents is not None:
                         from ..engine import incumbent as inc_mod
-                        inc_key = inc_mod.instance_key(
-                            p, group=req.share_group)
+                        # problem-aware namespacing lives in ONE place
+                        # (incumbent.share_key): two problems with
+                        # bit-identical tables never exchange bounds
+                        inc_key = inc_mod.share_key(
+                            p, problem=req.problem,
+                            group=req.share_group)
                     res = distributed.search(
-                        p, lb_kind=req.lb_kind, init_ub=req.init_ub,
+                        p, problem=req.problem,
+                        lb_kind=req.lb_kind, init_ub=req.init_ub,
                         mesh=slot.mesh, chunk=req.chunk,
                         capacity=capacity,
                         balance_period=req.balance_period,

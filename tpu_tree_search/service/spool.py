@@ -11,6 +11,9 @@ ROADMAP follow-on; it would sit exactly where this module sits.)
 Request JSON::
 
     {"inst": 21,                 # Taillard id — OR "p_times": [[...]]
+     "problem": "pfsp",          # workload plugin (problems/base.py):
+                                 # pfsp | nqueens | tsp | knapsack;
+                                 # p_times is that problem's table
      "lb": 1, "ub": "opt",       # ub: "opt" | integer | null
      "priority": 0, "deadline_s": null,
      "chunk": 64, "capacity": null, "tag": null,
@@ -50,10 +53,17 @@ def _atomic_write_json(path: pathlib.Path, payload: dict) -> None:
 
 
 def request_from_payload(payload: dict) -> SearchRequest:
-    """Build a SearchRequest from a spool request dict."""
+    """Build a SearchRequest from a spool request dict. `problem`
+    (default "pfsp") names the workload plugin; `p_times` is that
+    problem's 2-D instance table (problems/base.py documents the
+    per-problem format). `inst` (a Taillard id) is PFSP-only."""
+    problem = str(payload.get("problem") or "pfsp")
     if "p_times" in payload:
         p = np.asarray(payload["p_times"], np.int32)
     elif "inst" in payload:
+        if problem != "pfsp":
+            raise ValueError("'inst' (a Taillard id) is PFSP-only; "
+                             f"problem {problem!r} needs 'p_times'")
         from ..problems import taillard
         p = taillard.processing_times(int(payload["inst"]))
     else:
@@ -84,8 +94,14 @@ def request_from_payload(payload: dict) -> SearchRequest:
         # keys in the same payload win (they were set above)
         kwargs.setdefault("chunk", None)
         kwargs.setdefault("balance_period", None)
+    from .. import problems
+    try:
+        default_lb = problems.get(problem).default_lb
+    except KeyError:
+        default_lb = 1        # validate() rejects with the real reason
     return SearchRequest(
-        p_times=p, lb_kind=int(payload.get("lb", 1)),
+        p_times=p, problem=problem,
+        lb_kind=int(payload.get("lb", default_lb)),
         init_ub=None if ub is None else int(ub),
         tag=payload.get("tag"), faults=payload.get("faults"), **kwargs)
 
@@ -103,6 +119,7 @@ def payload_from_request(req: SearchRequest) -> dict:
     event rather than failing the admit."""
     p = np.asarray(req.p_times)
     payload: dict = {"p_times": p.tolist(), "lb": int(req.lb_kind),
+                     "problem": str(req.problem),
                      "ub": None if req.init_ub is None
                      else int(req.init_ub),
                      "priority": int(req.priority), "tag": req.tag}

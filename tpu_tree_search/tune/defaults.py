@@ -64,9 +64,14 @@ class Params:
     #                                    source is cache/probe
 
 
-def shape_class(jobs: int, machines: int) -> str:
-    """The Taillard-style shape-class label table rows key on."""
-    return f"{int(jobs)}x{int(machines)}"
+def shape_class(jobs: int, machines: int, problem: str = "pfsp") -> str:
+    """The shape-class label table rows key on. PFSP keeps the legacy
+    Taillard-style ``JxM`` label (persisted tuning caches and the
+    MEASURED rows predate the problem prefix); every other problem is
+    namespaced ``problem:JxM`` so two workloads can never alias one
+    measured row."""
+    label = f"{int(jobs)}x{int(machines)}"
+    return label if problem == "pfsp" else f"{problem}:{label}"
 
 
 # (context, shape_class) -> Params. Contexts: "bench" (single-chip
@@ -90,15 +95,19 @@ _FALLBACK: dict[str, Params] = {
 
 
 def params_for(context: str, jobs: int | None = None,
-               machines: int | None = None) -> Params:
-    """Resolve the default dispatch params for a context and shape —
-    the tuner's fallback tier and the single source config/bench/serve
-    read their chunk/balance_period defaults from."""
+               machines: int | None = None,
+               problem: str = "pfsp") -> Params:
+    """Resolve the default dispatch params for a context, problem and
+    shape — the tuner's fallback tier and the single source
+    config/bench/serve read their chunk/balance_period defaults from.
+    Only PFSP has measured rows today; other problems resolve through
+    the per-context fallback until their own perf rounds land."""
     if context not in _FALLBACK:
         raise ValueError(f"unknown defaults context {context!r} "
                          f"(want one of {sorted(_FALLBACK)})")
     if jobs is not None and machines is not None:
-        row = MEASURED.get((context, shape_class(jobs, machines)))
+        row = MEASURED.get((context, shape_class(jobs, machines,
+                                                 problem)))
         if row is not None:
             return row
     return _FALLBACK[context]

@@ -109,8 +109,10 @@ class Autotuner:
 
     @staticmethod
     def key(jobs: int, machines: int, lb_kind: int,
-            n_workers: int) -> tuple:
-        return ("pfsp", int(jobs), int(machines), int(lb_kind),
+            n_workers: int, problem: str = "pfsp") -> tuple:
+        # the problem name LEADS the key (PFSP entries keep their
+        # pre-plugin cache identity — persisted caches stay valid)
+        return (str(problem), int(jobs), int(machines), int(lb_kind),
                 int(n_workers))
 
     # --------------------------------------------------------- resolve
@@ -118,12 +120,17 @@ class Autotuner:
     def resolve(self, jobs: int, machines: int, lb_kind: int = 1,
                 n_workers: int = 1, allow_probe: bool = False,
                 p_times: np.ndarray | None = None,
-                context: str = "serving") -> Params:
+                context: str = "serving",
+                problem: str = "pfsp") -> Params:
         """The three-tier lookup. ``allow_probe=False`` is the request
         hot path (cache else defaults — never seconds of probing while
         a client waits); ``allow_probe=True`` is the boot/bench path
-        (cache else probe+persist else defaults)."""
-        key = self.key(jobs, machines, lb_kind, n_workers)
+        (cache else probe+persist else defaults). Probing is PFSP-only
+        for now (the probe harness drives the PFSP step); other
+        problems resolve cache-else-defaults."""
+        key = self.key(jobs, machines, lb_kind, n_workers, problem)
+        if problem != "pfsp":
+            allow_probe = False
         with self._lock:
             memo = self._memo.get(key)
         if memo is not None:
@@ -147,7 +154,8 @@ class Autotuner:
                 tracelog.event("tuner.probe_failed", jobs=jobs,
                                machines=machines, lb_kind=lb_kind,
                                error=repr(e))
-        return defaults.params_for(context, jobs, machines)
+        return defaults.params_for(context, jobs, machines,
+                                   problem=problem)
 
     # ------------------------------------------------------------ tune
 
