@@ -291,6 +291,75 @@ def bench_ramp_drain(inst: int):
           f"segments={len(segs)}", file=sys.stderr)
 
 
+def bench_serve_rps():
+    """Serving throughput on a small-instance mix: N synthetic 8x5
+    PFSP instances submitted to ONE serve session, reported as
+    requests/s — the megabatch acceptance row (HIGHER is better, the
+    rate default). The row carries a ``megabatch`` mode channel (the
+    TTS_MEGABATCH flag it ran under) so tools/perf_sentry.py never
+    judges a batched rate against solo history or vice versa
+    (cross-mode = SKIP, the overlap/cache_mode/ladder rule). A warm-up
+    round of the same shape class pays the compile outside the timed
+    window (both modes), so the row measures steady serving, not
+    trace+compile. TTS_BENCH_SERVE_RPS=0 skips; TTS_BENCH_SERVE_N
+    sizes the mix."""
+    from tpu_tree_search.problems.pfsp import PFSPInstance
+    from tpu_tree_search.service.server import (SearchRequest,
+                                                SearchServer)
+    from tpu_tree_search.utils import config as cfg
+
+    n = max(cfg.env_int("TTS_BENCH_SERVE_N"), 1)
+    mb = cfg.env_flag(cfg.MEGABATCH_FLAG)
+    batch_max = min(cfg.env_int("TTS_BATCH_MAX"), n)
+
+    def req(seed):
+        return SearchRequest(
+            p_times=PFSPInstance.synthetic(8, 5, seed=seed).p_times,
+            lb_kind=1, chunk=64, capacity=1 << 14, min_seed=32,
+            segment_iters=64)
+
+    # NOT a `with` block: __enter__ would start() the scheduler before
+    # the warm-up batch is fully enqueued, and an age-close could then
+    # warm a partial batch's executable instead of the full-size one
+    # the timed window runs
+    srv = SearchServer(n_submeshes=1, autostart=False,
+                       megabatch=mb, batch_max=batch_max,
+                       batch_age_s=0.05)
+    try:
+        # warm-up: one full batch's worth of the class so the timed
+        # window replays the (solo or batched) executable
+        warm = [srv.submit(req(1000 + s)) for s in range(batch_max)]
+        srv.start()
+        for rid in warm:
+            srv.result(rid, timeout=600)
+        t0 = time.perf_counter()
+        ids = [srv.submit(req(s)) for s in range(n)]
+        for rid in ids:
+            rec = srv.result(rid, timeout=600)
+            if rec.state != "DONE":
+                print(f"# serve-rps bench SKIPPED: request {rid} "
+                      f"ended {rec.state} ({rec.error})",
+                      file=sys.stderr)
+                return
+        dt = time.perf_counter() - t0
+    finally:
+        srv.close()
+    rate = n / dt
+    row = {
+        "metric": "pfsp_serve_rps",
+        "value": round(rate, 3),
+        "unit": "requests_per_sec",
+        "requests": n,
+        "megabatch": int(mb),
+        "platform": PLATFORM,
+    }
+    if DEGRADED:
+        row["degraded"] = True
+    print(json.dumps(row))
+    print(f"# serve_rps megabatch={int(mb)} n={n} wall={dt:.3f}s "
+          f"rate={rate:.3f} req/s", file=sys.stderr)
+
+
 def main():
     from tpu_tree_search.utils import config as cfg
     inst = cfg.env_int("TTS_BENCH_INSTANCE")
@@ -402,6 +471,8 @@ def main():
         bench_cold_start(p, inst)
     if cfg.env_flag("TTS_BENCH_RAMPDRAIN"):
         bench_ramp_drain(inst)
+    if cfg.env_flag("TTS_BENCH_SERVE_RPS"):
+        bench_serve_rps()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,580 @@
+"""Request megabatching: the vmapped instance-axis loop and the
+service batch-former (engine/megabatch.py, service/batching.py).
+
+The load-bearing contract is BIT-PARITY: a request served in a batch
+must produce byte-identical node counts, optimum, per-worker counters
+and telemetry block to the same request served solo — pinned here per
+workload and bound, under TTS_AUDIT_HARD, and across preempt→resume
+and hard-kill ledger replay (slow-marked; the CI ``megabatch-serve``
+leg drives the real-process variant).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import distributed, megabatch
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+from tpu_tree_search.service.batching import BatchFormer
+from tpu_tree_search.service.request import (CANCELLED, QUEUED,
+                                             RequestRecord)
+from tpu_tree_search.tune import defaults as tune_defaults
+from tpu_tree_search.tune.tuner import Autotuner
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4, segment_iters=16)
+
+
+def small(seed, jobs=7):
+    return PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+
+
+def tsp_table(n, seed):
+    r = np.random.default_rng(seed)
+    d = r.integers(1, 50, size=(n, n)).astype(np.int32)
+    d = (d + d.T) // 2
+    np.fill_diagonal(d, 0)
+    return d
+
+
+def res_tuple(res):
+    return (res.explored_tree, res.explored_sol, res.best,
+            res.complete)
+
+
+# ------------------------------------------------------------- former
+
+
+def _rec(i, prio=0):
+    req = SearchRequest(p_times=small(i).p_times)
+    return RequestRecord(id=f"r{i}", request=req, state=QUEUED, seq=i)
+
+
+def test_former_closes_on_size():
+    f = BatchFormer(max_size=2, age_s=60.0)
+    f.offer(("k",), _rec(0))
+    assert f.pop_ready() is None          # below size, below age
+    f.offer(("k",), _rec(1))
+    batch, reason = f.pop_ready()
+    assert reason == "size" and [r.id for r in batch] == ["r0", "r1"]
+    assert f.pop_ready() is None and len(f) == 0
+
+
+def test_former_closes_on_age_and_lone_request():
+    f = BatchFormer(max_size=8, age_s=0.05)
+    f.offer(("k",), _rec(0))
+    assert f.pop_ready() is None
+    time.sleep(0.06)
+    batch, reason = f.pop_ready()
+    assert reason == "age" and len(batch) == 1
+
+
+def test_former_separate_keys_never_mix():
+    f = BatchFormer(max_size=2, age_s=60.0)
+    f.offer(("pfsp",), _rec(0))
+    f.offer(("tsp",), _rec(1))
+    assert f.pop_ready() is None          # neither group at size
+    f.offer(("pfsp",), _rec(2))
+    batch, _ = f.pop_ready()
+    assert [r.id for r in batch] == ["r0", "r2"]
+    assert f.waiting_ids() == ["r1"]
+
+
+def test_former_prunes_stale_members():
+    f = BatchFormer(max_size=2, age_s=60.0)
+    a, b = _rec(0), _rec(1)
+    f.offer(("k",), a)
+    f.offer(("k",), b)
+    a.state = CANCELLED                   # cancelled while held
+    time.sleep(0.0)
+    assert f.pop_ready() is None          # b alone is below size
+    assert f.waiting_ids() == ["r1"]
+    assert f.drain() == [b]
+
+
+# ---------------------------------------------------- tuning-key layer
+
+
+def test_shape_class_and_defaults_batched():
+    assert tune_defaults.shape_class(20, 5) == "20x5"
+    assert tune_defaults.shape_class(20, 5, batch=8) == "20x5@b8"
+    assert tune_defaults.shape_class(6, 6, "tsp", batch=4) \
+        == "tsp:6x6@b4"
+    # batch=1 is a solo dispatch: no suffix, solo rows apply
+    assert tune_defaults.shape_class(20, 5, batch=1) == "20x5"
+    # a batched lookup without a measured row lands on the EXPLICIT
+    # batched fallback, never the solo serving row silently
+    solo = tune_defaults.params_for("serving", 33, 7)
+    batched = tune_defaults.params_for("serving", 33, 7, batch=4)
+    assert batched == tune_defaults._FALLBACK_BATCHED
+    assert batched.chunk == tune_defaults.SERVING_BATCH_CHUNK_DEFAULT
+    assert solo is tune_defaults._FALLBACK["serving"]
+    # the measured batched rows this PR lands resolve explicitly
+    row = tune_defaults.params_for("serving", 8, 5, batch=8)
+    assert row is tune_defaults.MEASURED[("serving", "8x5@b8")]
+
+
+def test_tuner_key_carries_batch_dim():
+    k_solo = Autotuner.key(20, 5, 1, 8)
+    k_b = Autotuner.key(20, 5, 1, 8, batch=4)
+    assert k_b[:len(k_solo)] == k_solo and k_b[-2:] == ("batch", 4)
+    assert Autotuner.key(20, 5, 1, 8, batch=1) == k_solo
+    # batched resolution never probes and falls to the batched row
+    t = Autotuner()
+    p = t.resolve(8, 5, 1, n_workers=8, allow_probe=True, batch=8)
+    assert p.source == "default"
+    assert p.chunk == tune_defaults.params_for(
+        "serving", 8, 5, batch=8).chunk
+
+
+# ------------------------------------------------------ engine parity
+
+
+def test_engine_batched_parity_pfsp_telemetry_audit(monkeypatch):
+    """Per-member bit-parity against solo distributed.search: counts,
+    optimum, per-worker counter arrays and the full telemetry summary,
+    with the auditor in raise mode."""
+    monkeypatch.setenv("TTS_SEARCH_TELEMETRY", "1")
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+    tables = [small(s).p_times for s in (1, 2)]
+    solos = [distributed.search(t, problem="pfsp", lb_kind=1, **KW)
+             for t in tables]
+    out = megabatch.serve_batch(
+        [megabatch.MemberSpec(table=t) for t in tables],
+        problem="pfsp", lb_kind=1, **KW)
+    for s, r in zip(solos, out):
+        assert res_tuple(r) == res_tuple(s)
+        for k in ("tree", "sol", "iters", "evals", "sent", "recv",
+                  "steals", "final_size"):
+            assert np.array_equal(np.asarray(s.per_device[k]),
+                                  np.asarray(r.per_device[k])), k
+        assert s.telemetry is not None and r.telemetry == s.telemetry
+
+
+@pytest.mark.slow
+def test_engine_batched_parity_generic_step(monkeypatch):
+    """The problem-generic pipeline (TSP) under the batch axis."""
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+    tables = [tsp_table(6, s) for s in (1, 2, 3)]
+    solos = [distributed.search(t, problem="tsp", lb_kind=1, **KW)
+             for t in tables]
+    out = megabatch.serve_batch(
+        [megabatch.MemberSpec(table=t) for t in tables],
+        problem="tsp", lb_kind=1, **KW)
+    assert [res_tuple(r) for r in out] == [res_tuple(s) for s in solos]
+
+
+@pytest.mark.slow
+def test_engine_batched_parity_lb2_and_knapsack(monkeypatch):
+    monkeypatch.setenv("TTS_SEARCH_TELEMETRY", "1")
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+    tables = [small(s, jobs=8).p_times for s in (3, 4)]
+    solos = [distributed.search(t, problem="pfsp", lb_kind=2, **KW)
+             for t in tables]
+    out = megabatch.serve_batch(
+        [megabatch.MemberSpec(table=t) for t in tables],
+        problem="pfsp", lb_kind=2, **KW)
+    for s, r in zip(solos, out):
+        assert res_tuple(r) == res_tuple(s)
+        assert r.telemetry == s.telemetry
+
+    def ks(n, seed):
+        rng = np.random.default_rng(seed)
+        w = rng.integers(1, 20, n)
+        v = rng.integers(1, 30, n)
+        row3 = np.zeros(n, np.int64)
+        row3[0] = int(w.sum() // 2)
+        return np.stack([w, v, row3]).astype(np.int32)
+
+    kt = [ks(10, s) for s in (5, 6)]
+    solos = [distributed.search(t, problem="knapsack", lb_kind=1, **KW)
+             for t in kt]
+    out = megabatch.serve_batch(
+        [megabatch.MemberSpec(table=t) for t in kt],
+        problem="knapsack", lb_kind=1, **KW)
+    assert [res_tuple(r) for r in out] == [res_tuple(s) for s in solos]
+
+
+@pytest.mark.slow
+def test_engine_batched_termination_masks():
+    """Members of very different sizes: the small one drains (complete,
+    callback fires) segments before the big one — its lanes idle, its
+    counters freeze, the batch keeps exploring."""
+    # same-shape members with very different tree sizes: a bound seed
+    # of 1 collapses member 0's tree to almost nothing while member 1
+    # explores fully
+    t0 = small(3).p_times
+    t1 = small(4).p_times
+    s0 = distributed.search(t0, problem="pfsp", lb_kind=1, init_ub=1,
+                            **KW)
+    done_order = []
+    out = megabatch.serve_batch(
+        [megabatch.MemberSpec(table=t0, init_ub=1),
+         megabatch.MemberSpec(table=t1)],
+        problem="pfsp", lb_kind=1,
+        on_member_done=lambda b, res: done_order.append(b), **KW)
+    assert sorted(done_order) == [0, 1]
+    assert res_tuple(out[0]) == res_tuple(s0)
+    assert out[1].complete
+
+
+# ----------------------------------------------------------- service
+
+
+@pytest.fixture(scope="module")
+def solo_served():
+    """Solo-serving control results for three small instances."""
+    tables = [small(s).p_times for s in (1, 2, 3)]
+    out = {}
+    with SearchServer(n_submeshes=1) as srv:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1, **KW))
+               for t in tables]
+        for i, rid in enumerate(ids):
+            rec = srv.result(rid, timeout=600)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            out[i] = (rec.result.explored_tree,
+                      rec.result.explored_sol, rec.result.best)
+    return tables, out
+
+
+def test_service_batch_forms_and_results_match_solo(solo_served):
+    tables, solo = solo_served
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=3,
+                       batch_age_s=0.05, autostart=False)
+    try:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1, **KW))
+               for t in tables]
+        srv.start()
+        for i, rid in enumerate(ids):
+            rec = srv.result(rid, timeout=600)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            assert (rec.result.explored_tree, rec.result.explored_sol,
+                    rec.result.best) == solo[i]
+            assert srv.status(rid)["batch"] is not None
+        snap = srv.status_snapshot()
+        assert snap["megabatch"]["enabled"]
+        m = snap["metrics"]
+        assert m["tts_batches_formed_total"]['{reason="size"}'] == 1
+        assert m["tts_batch_requests_total"] == 3
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_service_lone_request_age_closes_and_wait_observed(solo_served):
+    """A lone request age-closes onto the solo path, and its
+    tts_queue_wait_seconds observation lands at batch-close — the held
+    wait is counted, not just the post-close dispatch hop."""
+    tables, solo = solo_served
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=8,
+                       batch_age_s=0.2)
+    try:
+        rid = srv.submit(SearchRequest(p_times=tables[0], lb_kind=1,
+                                       **KW))
+        rec = srv.result(rid, timeout=600)
+        assert rec.state == "DONE"
+        assert (rec.result.explored_tree, rec.result.explored_sol,
+                rec.result.best) == solo[0]
+        hist = srv.metrics.to_json()["tts_queue_wait_seconds"]
+        assert hist["count"] == 1
+        # the observed wait includes the full former hold (~age_s) —
+        # an at-dispatch observation would also include it here, but
+        # only the batch-close rule keeps that true for members that
+        # keep waiting for a slot after their group closed
+        assert hist["sum"] >= 0.2 - 1e-3
+        assert rec.batch_closed_t is not None
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_service_mixed_problems_form_separate_batches(solo_served):
+    tables, solo = solo_served
+    tsp = [tsp_table(6, s) for s in (7, 8)]
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                       batch_age_s=0.05, autostart=False)
+    try:
+        pf = [srv.submit(SearchRequest(p_times=t, lb_kind=1, **KW))
+              for t in tables[:2]]
+        ts = [srv.submit(SearchRequest(p_times=t, problem="tsp",
+                                       lb_kind=1, **KW)) for t in tsp]
+        srv.start()
+        recs = {rid: srv.result(rid, timeout=600) for rid in pf + ts}
+        assert all(r.state == "DONE" for r in recs.values())
+        pf_b = {recs[r].batch_id for r in pf}
+        ts_b = {recs[r].batch_id for r in ts}
+        assert len(pf_b) == 1 and len(ts_b) == 1
+        assert pf_b.isdisjoint(ts_b)      # never one batch across
+        #                                   problems
+        for i, rid in enumerate(pf):
+            assert (recs[rid].result.explored_tree,
+                    recs[rid].result.explored_sol,
+                    recs[rid].result.best) == solo[i]
+        # two multi-request closures total: the first closes on size;
+        # the second may close on size OR age (it can age past the
+        # bound while waiting for the lone submesh, and age-ready
+        # outranks size-ready)
+        m = srv.metrics.to_json()["tts_batches_formed_total"]
+        assert sum(m.values()) == 2
+    finally:
+        srv.close()
+
+
+def test_service_admission_bound_counts_former_held():
+    """Backpressure survives megabatching: requests the scheduler has
+    drained into the batch-former still count against the admission
+    bound (and the queue-depth gauge), so an overloaded megabatch
+    server rejects loudly instead of buffering unboundedly while its
+    queue reads empty."""
+    from tpu_tree_search.service.queueing import AdmissionError
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=8,
+                       batch_age_s=60.0, max_queue_depth=2)
+    try:
+        for s in (1, 2):
+            srv.submit(SearchRequest(p_times=small(s).p_times, **KW))
+        deadline = time.time() + 30
+        while time.time() < deadline and len(srv.former) < 2:
+            time.sleep(0.01)     # scheduler drains heap -> former
+        assert len(srv.former) == 2
+        assert srv.metrics.to_json()["tts_queue_depth"] == 2
+        with pytest.raises(AdmissionError):
+            srv.submit(SearchRequest(p_times=small(3).p_times, **KW))
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_service_batched_preempt_resume_bit_parity(tmp_path,
+                                                   monkeypatch):
+    """close() mid-batch preempts every member at the boundary with a
+    checkpoint; a new megabatch server re-forms the batch from those
+    checkpoints and finishes to totals bit-identical to uninterrupted
+    solo serving — under TTS_AUDIT_HARD."""
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+    tables = [PFSPInstance.synthetic(10, 5, seed=s).p_times
+              for s in (11, 12)]
+    kw = dict(chunk=16, capacity=1 << 12, min_seed=8, segment_iters=16)
+    solo = {}
+    with SearchServer(n_submeshes=1) as srv:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1,
+                                        tag=f"s-{i}", **kw))
+               for i, t in enumerate(tables)]
+        for i, rid in enumerate(ids):
+            rec = srv.result(rid, timeout=600)
+            assert rec.state == "DONE"
+            solo[i] = (rec.result.explored_tree,
+                       rec.result.explored_sol, rec.result.best)
+
+    wd = str(tmp_path / "wd")
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                       batch_age_s=0.05, workdir=wd, autostart=False)
+    ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1,
+                                    tag=f"mb-{i}", **kw))
+           for i, t in enumerate(tables)]
+    srv.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        snaps = [srv.status(r) for r in ids]
+        if all(s["state"] == "RUNNING"
+               and s["progress"].get("segment", 0) >= 1 for s in snaps):
+            break
+        assert not any(s["state"] == "DONE" for s in snaps), \
+            "solved before the preempt window; shrink segment_iters"
+        time.sleep(0.005)
+    srv.close()
+    assert all(srv.status(r)["state"] == "PREEMPTED" for r in ids)
+
+    srv2 = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                        batch_age_s=0.05, workdir=wd, autostart=False)
+    ids2 = [srv2.submit(SearchRequest(p_times=t, lb_kind=1,
+                                      tag=f"mb-{i}", **kw))
+            for i, t in enumerate(tables)]
+    srv2.start()
+    try:
+        for i, rid in enumerate(ids2):
+            rec = srv2.result(rid, timeout=600)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            assert (rec.result.explored_tree, rec.result.explored_sol,
+                    rec.result.best) == solo[i]
+    finally:
+        srv2.close()
+
+
+@pytest.mark.slow
+def test_service_mid_batch_cancel_finalizes_at_boundary():
+    """Cancelling one batched member finalizes it at the NEXT segment
+    boundary — result() unblocks and the spent clock stops — while its
+    batchmate keeps running to DONE (the member must not stay RUNNING
+    until the whole batch drains, or the stall rule would misread its
+    frozen lanes)."""
+    tables = [PFSPInstance.synthetic(10, 5, seed=s).p_times
+              for s in (21, 22)]
+    kw = dict(chunk=16, capacity=1 << 12, min_seed=8, segment_iters=16)
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                       batch_age_s=0.05, autostart=False)
+    try:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1, **kw))
+               for t in tables]
+        srv.start()
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            if all(srv.status(r)["state"] == "RUNNING" for r in ids):
+                break
+            time.sleep(0.005)
+        assert srv.cancel(ids[0])
+        rec0 = srv.result(ids[0], timeout=120)
+        assert rec0.state == "CANCELLED"
+        # the batchmate is unaffected: still being served (or already
+        # done), and it completes normally
+        assert srv.status(ids[1])["state"] in ("RUNNING", "DONE")
+        rec1 = srv.result(ids[1], timeout=600)
+        assert rec1.state == "DONE", (rec1.state, rec1.error)
+        assert rec1.result.complete
+    finally:
+        srv.close()
+
+
+@pytest.mark.slow
+def test_incompatible_member_demotes_to_solo_not_batch_failure(
+        tmp_path, monkeypatch):
+    """A member whose RESUME checkpoint cannot join the batch (here: a
+    telemetry-width mismatch from a flag flip across lifetimes) is
+    demoted to the solo path; its innocent batchmate requeues and both
+    finish DONE — a batch-wide FAILED would dead-letter requests that
+    never even ran."""
+    wd = tmp_path / "wd"
+    wd.mkdir()
+    t_legacy = small(31).p_times
+    t_fresh = small(32).p_times
+    kw = dict(chunk=8, capacity=1 << 12, min_seed=4, segment_iters=8)
+    # lifetime 1 (telemetry ON): preempt mid-solve so a telemetry-width
+    # checkpoint exists under the tag
+    monkeypatch.setenv("TTS_SEARCH_TELEMETRY", "1")
+    srv = SearchServer(n_submeshes=1, workdir=str(wd))
+    rid = srv.submit(SearchRequest(p_times=t_legacy, tag="legacy",
+                                   **kw))
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        s = srv.status(rid)
+        if s["state"] == "RUNNING" and s["progress"].get("segment"):
+            break
+        assert s["state"] != "DONE", "solved before preempt window"
+        time.sleep(0.005)
+    srv.close()       # PREEMPTED with a width-60 telemetry checkpoint
+    assert srv.status(rid)["state"] == "PREEMPTED"
+    monkeypatch.delenv("TTS_SEARCH_TELEMETRY")
+    # lifetime 2 (telemetry OFF, megabatch): the resumed tag groups
+    # with a fresh request; stacking must demote it, not fail the batch
+    srv2 = SearchServer(n_submeshes=1, workdir=str(wd),
+                        megabatch=True, batch_max=2, batch_age_s=0.05,
+                        autostart=False)
+    ids = [srv2.submit(SearchRequest(p_times=t_legacy, tag="legacy",
+                                     **kw)),
+           srv2.submit(SearchRequest(p_times=t_fresh, **kw))]
+    srv2.start()
+    try:
+        for rid2 in ids:
+            rec = srv2.result(rid2, timeout=600)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            assert rec.failures == 0
+        assert srv2.records[ids[0]].solo_only
+    finally:
+        srv2.close()
+
+
+def _crash(srv):
+    """Hard-death simulation (tests/test_ledger.crash's discipline):
+    stop the daemons WITHOUT close()'s bookkeeping — no queued-request
+    cancellation, no ledger drain marker; executors stop at their
+    segment boundary. The ledger needs no flush (appends fsync'd)."""
+    srv._closing.set()
+    with srv._lock:
+        for slot in srv.slots:
+            for rec in (slot.batch
+                        or ([slot.record] if slot.record else [])):
+                if rec.stop_reason is None:
+                    rec.stop_reason = "shutdown"
+            if slot.stop_event is not None:
+                slot.stop_event.set()
+    if srv._scheduler is not None:
+        srv._scheduler.join()
+    for slot in srv.slots:
+        if slot.thread is not None:
+            slot.thread.join()
+    srv.resources.close()
+    srv.health.close()
+    srv.remediation.close()
+    if srv.aot is not None:
+        srv.aot.close()
+    if srv.ledger is not None:
+        srv.ledger.close()
+
+
+@pytest.mark.slow
+def test_service_batched_hard_kill_ledger_replay(tmp_path,
+                                                 monkeypatch):
+    """Hard-death mid-batch (no drain marker): the ledger replays both
+    members at the next boot, they re-batch, resume from their
+    checkpoints and finish bit-identical to solo — the DONE terminal
+    then re-serves idempotently."""
+    monkeypatch.setenv("TTS_AUDIT_HARD", "1")
+
+    tables = [PFSPInstance.synthetic(10, 5, seed=s).p_times
+              for s in (13, 14)]
+    kw = dict(chunk=16, capacity=1 << 12, min_seed=8, segment_iters=16)
+    solo = {}
+    with SearchServer(n_submeshes=1) as srv:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1,
+                                        tag=f"s-{i}", **kw))
+               for i, t in enumerate(tables)]
+        for i, rid in enumerate(ids):
+            rec = srv.result(rid, timeout=600)
+            assert rec.state == "DONE"
+            solo[i] = (rec.result.explored_tree,
+                       rec.result.explored_sol, rec.result.best)
+
+    led = str(tmp_path / "ledger")
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                       batch_age_s=0.05, ledger_dir=led,
+                       autostart=False)
+    ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1,
+                                    tag=f"mb-{i}", **kw))
+           for i, t in enumerate(tables)]
+    srv.start()
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        snaps = [srv.status(r) for r in ids]
+        if all(s["state"] == "RUNNING"
+               and s["progress"].get("segment", 0) >= 1 for s in snaps):
+            break
+        assert not any(s["state"] == "DONE" for s in snaps)
+        time.sleep(0.005)
+    _crash(srv)                      # kill -9 stand-in: no drain, no
+    #                                  queued-request cancellation
+
+    srv2 = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                        batch_age_s=0.05, ledger_dir=led)
+    try:
+        # the in-process crash lets executors reach their boundary, so
+        # members journal a preempt first and replay as queued; a real
+        # kill -9 mid-segment replays them as active (the CI leg's
+        # territory) — either way both re-admit
+        rec_c = srv2._recovered
+        assert rec_c["queued"] + rec_c["active"] == 2
+        out = {}
+        for i, tag in enumerate(["mb-0", "mb-1"]):
+            rid = next(r for r, rec in srv2.records.items()
+                       if (rec.request.tag or r) == tag)
+            rec = srv2.result(rid, timeout=600)
+            assert rec.state == "DONE", (rec.state, rec.error)
+            out[i] = (rec.result.explored_tree,
+                      rec.result.explored_sol, rec.result.best)
+        assert out == solo
+        # DONE idempotency survives the batch path: a duplicate
+        # same-table submission under the tag re-serves the terminal
+        dup = srv2.submit(SearchRequest(p_times=tables[0], lb_kind=1,
+                                        tag="mb-0", **kw))
+        assert srv2.records[dup].state == "DONE"
+    finally:
+        srv2.close()

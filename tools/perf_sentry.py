@@ -94,6 +94,11 @@ def row_mode(row: dict):
         return ("cache", row["cache_mode"])
     if row.get("ladder") is not None:
         return ("ladder", row["ladder"])
+    if row.get("megabatch") is not None:
+        # the serve-rps family (HIGHER is better, the rate default):
+        # a batched requests/s figure must never rate-judge against
+        # solo serving history — different execution modes entirely
+        return ("megabatch", row["megabatch"])
     if row.get("tuned") is not None:
         return ("tuned", row["tuned"])
     return None

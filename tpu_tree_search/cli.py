@@ -240,6 +240,26 @@ def _serve_parser(sub):
                         "so ramp/drain run small-chunk steps "
                         "(engine/ladder.py; off-mode is bit-identical "
                         "to the fixed-chunk driver)")
+    p.add_argument("--megabatch", action="store_true",
+                   help="request megabatching (also via "
+                        "TTS_MEGABATCH=1; engine/megabatch.py): the "
+                        "admission queue becomes a batch-former — "
+                        "same-shape-class requests stack into ONE "
+                        "vmapped compiled loop per submesh (close on "
+                        "size TTS_BATCH_MAX or age TTS_BATCH_AGE_S; a "
+                        "lone request age-closes onto the solo path). "
+                        "Every batched request's counts/optimum/"
+                        "telemetry are bit-identical to its solo run; "
+                        "default off = the solo scheduler exactly")
+    p.add_argument("--batch-max", type=int, default=None,
+                   help="megabatch: close a forming batch at this "
+                        "many members (also via TTS_BATCH_MAX, "
+                        f"default {_cfg.BATCH_MAX_DEFAULT})")
+    p.add_argument("--batch-age-s", type=float, default=None,
+                   help="megabatch: close a forming batch once its "
+                        "oldest member has waited this long (also via "
+                        "TTS_BATCH_AGE_S, default "
+                        f"{_cfg.BATCH_AGE_S_DEFAULT:g})")
     p.add_argument("--remediate", action="store_true",
                    help="EXECUTE the self-healing policy table (also "
                         "via TTS_REMEDIATE=1; service/remediate.py): "
@@ -509,6 +529,8 @@ def run_serve(args) -> int:
         _cfg.set_env(_cfg.LADDER_FLAG, "1")
     if args.remediate:
         _cfg.set_env(_cfg.REMEDIATE_FLAG, "1")
+    if args.megabatch:
+        _cfg.set_env(_cfg.MEGABATCH_FLAG, "1")
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -538,8 +560,14 @@ def run_serve(args) -> int:
                           tune_cache_dir=args.tune_cache,
                           tune_at_boot=(True if args.tune else None),
                           remediate=(True if args.remediate else None),
-                          ledger_dir=args.ledger
+                          ledger_dir=args.ledger,
+                          megabatch=(True if args.megabatch else None),
+                          batch_max=args.batch_max,
+                          batch_age_s=args.batch_age_s
                           ) as srv:
+            if srv.megabatch:
+                print(f"megabatch: ON (max {srv.former.max_size}, "
+                      f"age {srv.former.age_s:g}s)", flush=True)
             print(f"remediation: "
                   f"{'ACT' if srv.remediation.enabled else 'observe'}"
                   f"-mode (TTS_REMEDIATE)", flush=True)

@@ -302,6 +302,27 @@ def _expand(s: SearchState):
     return tuple(x[None, ...] for x in s)
 
 
+def member_body(tables, make_local_step, balance_period: int,
+                transfer_cap: int, min_transfer: int, limit: int):
+    """One macro-iteration of the SPMD loop for ONE instance:
+    `balance_period` local steps, the pmin incumbent exchange, one
+    balance round. Shared by :func:`build_dist_loop` (the solo loop)
+    and engine/megabatch.build_batched_loop (the same body vmapped over
+    a leading instance axis), so the batched member semantics can never
+    drift from the solo loop — the bit-parity contract between a
+    megabatched request and its solo run rests on this being ONE
+    function."""
+    local_step = make_local_step(tables, limit)
+
+    def body(s: SearchState) -> SearchState:
+        s = jax.lax.fori_loop(0, balance_period,
+                              lambda _, x: local_step(x), s)
+        s = s._replace(best=jax.lax.pmin(s.best, AX))
+        return _balance_round(s, transfer_cap, min_transfer, limit)
+
+    return body
+
+
 def build_dist_loop(mesh, tables, make_local_step,
                     balance_period: int, transfer_cap: int,
                     min_transfer: int, limit: int,
@@ -341,13 +362,8 @@ def build_dist_loop(mesh, tables, make_local_step,
             ok = jax.lax.psum(s.overflow.astype(jnp.int32), AX) == 0
             return has_work & ok & (s.iters < max_iters)
 
-        local_step = make_local_step(tables, limit)
-
-        def body(s: SearchState):
-            s = jax.lax.fori_loop(0, balance_period,
-                                  lambda _, x: local_step(x), s)
-            s = s._replace(best=jax.lax.pmin(s.best, AX))
-            return _balance_round(s, transfer_cap, min_transfer, limit)
+        body = member_body(tables, make_local_step, balance_period,
+                           transfer_cap, min_transfer, limit)
 
         return _expand(jax.lax.while_loop(cond, body, s))
 

@@ -56,7 +56,15 @@ REGISTRY: dict[str, Metric] = _table(
     Metric("tts_request_spent_seconds", "histogram", "",
            "per-request accumulated execution time"),
     Metric("tts_queue_wait_seconds", "histogram", "",
-           "admission-to-dispatch wait"),
+           "admission-to-dispatch wait (under megabatching: observed "
+           "at batch-close, so held batch members are counted)"),
+    # --- request megabatching (engine/megabatch + the batch-former)
+    Metric("tts_batches_formed_total", "counter", "reason",
+           "batches closed by the former (reason=size|age)"),
+    Metric("tts_batch_size", "histogram", "",
+           "requests per closed batch"),
+    Metric("tts_batch_requests_total", "counter", "",
+           "requests dispatched through a multi-request batch"),
     Metric("tts_queue_depth", "gauge", "", "live admission-queue depth"),
     Metric("tts_queue_peak_depth", "gauge", "",
            "high-water queue depth since server start"),

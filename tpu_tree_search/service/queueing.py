@@ -101,6 +101,16 @@ class RequestQueue:
                            (-rec.request.priority, rec.seq, rec))
             self.peak_depth = max(self.peak_depth, self._depth())
 
+    def observe_backlog(self, held: int) -> None:
+        """Fold externally-held waiting work into the peak-depth
+        high-water mark — the megabatch scheduler drains the heap into
+        its batch-former every tick, so the heap alone would record a
+        near-zero peak while the real wait line lives in the former."""
+        with self._lock:
+            self._prune()
+            self.peak_depth = max(self.peak_depth,
+                                  self._depth() + int(held))
+
     def pop_best(self, eligible=None) -> RequestRecord | None:
         """Highest-priority waiting request, or None if empty.
 

@@ -184,6 +184,22 @@ class RequestRecord:
     # dispatch. (The GLOBAL TTS_FAULTS plan keeps the per-process
     # re-arm model for respawned campaign workers.)
     fault_plan: object | None = None
+    # megabatching (service/batching + engine/megabatch): the id of the
+    # batch this request last dispatched in (None = solo), and the
+    # batch-close timestamp — the moment the former released it. The
+    # tts_queue_wait_seconds observation happens AT close (so the
+    # health engine's queue_wait p99 sees the full held wait, not just
+    # the post-close dispatch hop); the snapshot keeps the raw
+    # admit->dispatch wait separately (dispatch_wait_s)
+    batch_id: str | None = None
+    batch_closed_t: float | None = None
+    # set when a batch dispatch found this request's RESUME STATE
+    # incompatible with batching (legacy checkpoint dtype/telemetry
+    # width, cross-problem tag): the batch key never groups it again —
+    # it age-closes onto the solo path, which handles (or properly
+    # rejects) the legacy snapshot. In-memory only: a restart
+    # re-discovers the incompatibility at the first re-batch
+    solo_only: bool = False
     progress: dict = dataclasses.field(default_factory=dict)
     # last time this request's cumulative spent_s was journaled to the
     # request ledger (service/ledger) — the heartbeat hook throttles
@@ -240,6 +256,15 @@ class RequestRecord:
                 if self.state == RUNNING
                 and self.last_heartbeat_t is not None else None),
             "dispatch_heartbeats": self.dispatch_heartbeats,
+            "batch": self.batch_id,
+            # the raw admit/requeue -> dispatch wait of the CURRENT
+            # dispatch (None until dispatched). Under megabatching the
+            # histogram observes at batch-close instead, so this is
+            # the snapshot's per-request witness of the full wait
+            "dispatch_wait_s": (
+                round(self.started_t - self.queued_t, 3)
+                if self.started_t is not None and self.queued_t
+                else None),
             "progress": dict(self.progress),
         }
         res = self.result

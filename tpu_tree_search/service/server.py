@@ -98,6 +98,10 @@ class _Slot:
         self.index = index
         self.mesh = mesh
         self.record: RequestRecord | None = None
+        # megabatch occupancy: the full member list of a batched
+        # dispatch (record stays the first member so single-request
+        # readers keep working); None for a solo dispatch
+        self.batch: list | None = None
         self.thread: threading.Thread | None = None
         self.stop_event: threading.Event | None = None
         # submesh quarantine (service/remediate): a quarantined slot is
@@ -110,6 +114,16 @@ class _Slot:
     @property
     def device_ids(self) -> list[int]:
         return [int(d.id) for d in self.mesh.devices.flat]
+
+    @property
+    def records(self) -> list:
+        """Every request occupying this slot — the batch member list
+        under a batched dispatch, the single record solo, [] free.
+        THE slot-occupancy enumeration (close/deadline/heartbeat paths
+        all iterate it; hand-rolled copies drift)."""
+        if self.batch is not None:
+            return self.batch
+        return [self.record] if self.record is not None else []
 
 
 class SearchServer:
@@ -142,7 +156,10 @@ class SearchServer:
                  tune_cache_dir: str | None = None,
                  tune_at_boot: bool | None = None,
                  remediate: bool | None = None,
-                 ledger_dir: str | None = None):
+                 ledger_dir: str | None = None,
+                 megabatch: bool | None = None,
+                 batch_max: int | None = None,
+                 batch_age_s: float | None = None):
         from ..parallel.mesh import partition_submeshes
 
         self.slots = [_Slot(i, m) for i, m in
@@ -185,9 +202,16 @@ class SearchServer:
             "tts_queue_wait_seconds",
             "admit/requeue -> dispatch wait (the health layer's "
             "queue_wait SLO reads its windowed p99)")
+        # under megabatching, requests waiting in the batch-former are
+        # still WAITING — the depth gauge (and the admission bound in
+        # submit()) must count them, or an overloaded megabatch server
+        # would read as idle while its former grows without bound
         self.metrics.gauge(
             "tts_queue_depth", "requests waiting for a submesh"
-            ).set_fn(lambda: len(self.queue))
+            ).set_fn(lambda: len(self.queue)
+                     + (len(self.former)
+                        if getattr(self, "former", None) is not None
+                        else 0))
         # a gauge (callback over queue.rejected), so no `_total` suffix:
         # the counter convention would promise rate()-safe reset
         # detection this scrape-time mirror cannot give
@@ -304,6 +328,33 @@ class SearchServer:
         if share_incumbent:
             from ..engine.incumbent import IncumbentBoard
             self.incumbents = IncumbentBoard()
+        # Request megabatching (engine/megabatch + service/batching):
+        # the admission queue becomes a batch-former — same-shape-class
+        # requests stack into ONE vmapped compiled loop per submesh.
+        # Default off (TTS_MEGABATCH) = the solo scheduler exactly;
+        # every batched request is bit-identical to its solo run.
+        self.megabatch = (cfg.env_flag(cfg.MEGABATCH_FLAG)
+                          if megabatch is None else bool(megabatch))
+        self.former = None
+        if self.megabatch:
+            from .batching import BatchFormer
+            self.former = BatchFormer(
+                batch_max if batch_max is not None
+                else cfg.env_int("TTS_BATCH_MAX"),
+                batch_age_s if batch_age_s is not None
+                else cfg.env_float("TTS_BATCH_AGE_S"))
+        self._batch_seq = itertools.count()
+        self._m_batches = self.metrics.counter(
+            "tts_batches_formed_total",
+            "batches closed by the former (reason=size|age)")
+        self._m_batch_size = self.metrics.histogram(
+            "tts_batch_size", "requests per closed batch",
+            # integer-size buckets: the latency default (0.001..300 s)
+            # would fold every size 3..8 batch into one le=10 bucket
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+        self._m_batch_req = self.metrics.counter(
+            "tts_batch_requests_total",
+            "requests dispatched through a multi-request batch")
         self.segment_iters = segment_iters
         self.checkpoint_every = checkpoint_every
         self.poll_s = poll_s
@@ -371,6 +422,7 @@ class SearchServer:
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
+                       megabatch=self.megabatch,
                        overlap=self.overlap,
                        share_incumbent=self.incumbents is not None,
                        remediate=self.remediation.enabled,
@@ -415,11 +467,16 @@ class SearchServer:
         self._closing.set()
         with self._lock:
             for slot in self.slots:
-                rec = slot.record
-                if rec is not None and slot.stop_event is not None:
+                for rec in slot.records:
                     if rec.stop_reason is None:
                         rec.stop_reason = "shutdown"
+                if slot.records and slot.stop_event is not None:
                     slot.stop_event.set()
+            if self.former is not None:
+                # held batch members are live admitted requests: hand
+                # them back to the record loop below (CANCELLED without
+                # a ledger, kept QUEUED for replay with one)
+                self.former.drain()
         if wait:
             if self._scheduler is not None:
                 self._scheduler.join()
@@ -545,6 +602,20 @@ class SearchServer:
                     f"tag {tag!r} is already active on request "
                     f"{holder.id} ({holder.state}); wait for it to "
                     "finish or cancel it first")
+            if self.former is not None:
+                # the admission bound covers the WHOLE wait line: heap
+                # + former-held members (the scheduler drains the heap
+                # into the former every tick, so the heap alone would
+                # never fill and backpressure would silently vanish)
+                held = len(self.former)
+                if held + len(self.queue) >= self.queue.max_depth:
+                    self.queue.rejected += 1
+                    reason = (f"queue full: {held} batching + "
+                              f"{len(self.queue)} queued at the "
+                              f"admission bound {self.queue.max_depth};"
+                              " retry later or raise the bound")
+                    tracelog.event("request.reject", reason=reason)
+                    raise AdmissionError(reason)
             rec = RequestRecord(
                 id=rid, request=request, submitted_t=time.monotonic(),
                 seq=seq, checkpoint_path=path,
@@ -890,7 +961,20 @@ class SearchServer:
             rec.hold = False
             if rec.stop_reason is None:
                 rec.stop_reason = "preempt"
-            self._stop_slot_of(rec)
+            for slot in self.slots:
+                if slot.batch is not None and rec in slot.batch:
+                    # a REMEDIATION preempt of a batched member stops
+                    # the WHOLE batch: memory shedding frees nothing
+                    # until the shared (D,B,...) pools release, and a
+                    # stalled batch executor has stalled every member
+                    # alike — all members checkpoint at the boundary
+                    # and requeue (member-level stops stay the rule
+                    # for cancel/deadline, see _stop_slot_of)
+                    if slot.stop_event is not None:
+                        slot.stop_event.set()
+                    break
+            else:
+                self._stop_slot_of(rec)
             return True, (submesh if exclude_submesh else None)
 
     def add_exclusion(self, rec: RequestRecord, submesh: int) -> None:
@@ -916,10 +1000,9 @@ class SearchServer:
         """The shed_memory action's victim: the lowest-priority,
         youngest RUNNING request not already stopping."""
         with self._lock:
-            cands = [s.record for s in self.slots
-                     if s.record is not None
-                     and s.record.state == RUNNING
-                     and s.record.stop_reason is None]
+            cands = [rec for s in self.slots for rec in s.records
+                     if rec.state == RUNNING
+                     and rec.stop_reason is None]
             if not cands:
                 return None
             return min(cands,
@@ -957,8 +1040,8 @@ class SearchServer:
         with self._lock:
             return {rec.id: now - rec.last_heartbeat_t
                     for slot in self.slots
-                    if (rec := slot.record) is not None
-                    and rec.state == RUNNING
+                    for rec in slot.records
+                    if rec.state == RUNNING
                     and rec.last_heartbeat_t is not None}
 
     def status_snapshot(self) -> dict:
@@ -981,8 +1064,15 @@ class SearchServer:
                 "submeshes": [
                     {"index": s.index, "devices": s.device_ids,
                      "running": s.record.id if s.record else None,
+                     "batch": ([r.id for r in s.batch]
+                               if s.batch is not None else None),
                      "quarantined": s.quarantined}
                     for s in self.slots],
+                "megabatch": ({"enabled": True,
+                               "held": self.former.waiting_ids(),
+                               "max": self.former.max_size,
+                               "age_s": self.former.age_s}
+                              if self.former is not None else None),
                 "remediation": self.remediation.snapshot(),
                 "ledger": ({**self.ledger.snapshot(),
                             "recovered": dict(self._recovered)}
@@ -1146,8 +1236,85 @@ class SearchServer:
 
     def _stop_slot_of(self, rec: RequestRecord) -> None:
         for slot in self.slots:
-            if slot.record is rec and slot.stop_event is not None:
+            if slot.batch is not None:
+                # member-level stop: the batched engine honors the
+                # record's stop_reason at the next segment boundary;
+                # setting the slot event would stop the WHOLE batch
+                if rec in slot.batch:
+                    return
+            elif slot.record is rec and slot.stop_event is not None:
                 slot.stop_event.set()
+
+    def _handle_dispatch_failure(self, rec: RequestRecord, submesh: int,
+                                 error: str,
+                                 no_retry: bool = False) -> bool:
+        """Dispatch-failure bookkeeping shared by the solo and batched
+        finish paths (failure log/journal/event, remediation verdict,
+        requeue-vs-deadletter-vs-FAILED arbitration — two hand-rolled
+        copies would drift, the _record_preempt lesson). Returns True
+        when the caller should requeue the record with backoff;
+        otherwise it was finalized FAILED here. Caller holds the lock
+        and has rolled `spent_prev_s` forward."""
+        if no_retry:
+            rec.failures = self.service_retry_attempts + 1
+        rec.failures += 1
+        rec.error = error
+        rec.failure_log.append(
+            {"t": time.time(), "submesh": submesh,
+             "attempt": rec.dispatches, "error": error})
+        del rec.failure_log[:-FAILURE_LOG_CAP]
+        tracelog.event("request.dispatch_failure", request_id=rec.id,
+                       submesh=submesh, attempt=rec.dispatches,
+                       error=error)
+        if self.ledger is not None:
+            self.ledger.journal(
+                "failure", rid=rec.id, submesh=submesh,
+                attempt=rec.dispatches, error=error,
+                failures=rec.failures,
+                spent_s=round(rec.spent_prev_s, 3))
+        verdict = self.remediation.on_dispatch_failure(rec, submesh,
+                                                       error)
+        if (verdict == "requeue"
+                and rec.failures <= self.service_retry_attempts
+                and not self._closing.is_set()):
+            rec.state = QUEUED
+            self._m_redispatch.inc()
+            tracelog.event("request.redispatch", request_id=rec.id,
+                           failures=rec.failures, error=error)
+            return True
+        if verdict == "deadletter":
+            self._finalize(
+                rec, FAILED,
+                error=f"dead-lettered: failed on "
+                      f"{len({f['submesh'] for f in rec.failure_log})} "
+                      f"distinct submeshes (the fault follows the "
+                      f"request); last: {error}")
+        else:
+            self._finalize(rec, FAILED, error=error)
+        return False
+
+    def _record_preempt(self, rec: RequestRecord,
+                        reason: str | None) -> bool:
+        """PREEMPTED bookkeeping — state, counter, ledger journal,
+        trace event — shared by the solo executor, the batched
+        mid-batch stop handler and the batched finish path (three
+        hand-rolled copies had already started to drift). Returns
+        whether the caller should requeue the record (not on
+        shutdown, not while parked, not while closing). Caller holds
+        the lock and has already rolled `spent_prev_s` forward."""
+        rec.state = PREEMPTED
+        rec.preemptions += 1
+        self._m_preempt.inc()
+        if self.ledger is not None:
+            self.ledger.journal("preempt", rid=rec.id,
+                               preemptions=rec.preemptions,
+                               spent_s=round(rec.spent_prev_s, 3),
+                               hold=rec.hold)
+        tracelog.event("request.preempt", request_id=rec.id,
+                       reason=reason or "stop",
+                       preemptions=rec.preemptions, hold=rec.hold)
+        return (reason != "shutdown" and not rec.hold
+                and not self._closing.is_set())
 
     def _finalize(self, rec: RequestRecord, state: str,
                   error: str | None = None) -> None:
@@ -1218,14 +1385,20 @@ class SearchServer:
                 # close(wait=True) would then block on the full solve
                 return
             now = time.monotonic()
-            # 1. deadline enforcement on running requests
+            # 1. deadline enforcement on running requests. A batched
+            # member stops ALONE (the engine honors its stop_reason at
+            # the next boundary; the slot event would stop the batch)
             for slot in self.slots:
-                rec = slot.record
-                if (rec is not None and rec.state == RUNNING
-                        and rec.stop_reason is None
-                        and rec.over_deadline(now)):
-                    rec.stop_reason = "deadline"
-                    slot.stop_event.set()
+                for rec in slot.records:
+                    if (rec.state == RUNNING
+                            and rec.stop_reason is None
+                            and rec.over_deadline(now)):
+                        rec.stop_reason = "deadline"
+                        if slot.batch is None:
+                            slot.stop_event.set()
+            if self.megabatch:
+                self._tick_megabatch(now)
+                return
             # 2. dispatch to free submeshes. Quarantined slots are held
             # out of the partition; each pop honors the request's
             # excluded-submesh set FOR THIS SLOT (skipped entries stay
@@ -1309,6 +1482,337 @@ class SearchServer:
             victim.stop_reason = "preempt"
             self._stop_slot_of(victim)
 
+    # ------------------------------------------------------- megabatch
+    # (TTS_MEGABATCH: the admission queue becomes a batch-former and a
+    # closed batch dispatches to one submesh as ONE vmapped compiled
+    # loop — engine/megabatch. The strict-priority preemption pass is
+    # a solo-mode feature; megabatch is the throughput mode.)
+
+    def _batch_key(self, rec: RequestRecord) -> tuple:
+        """Everything the batched compiled loop specializes on (and the
+        segment geometry that must agree for lockstep boundaries) —
+        two requests batch together iff these match. Fault-injected
+        requests never batch: their injection is scoped to one
+        request's executor, and a batch shares one."""
+        req = rec.request
+        if req.faults is not None or rec.solo_only:
+            return ("solo", rec.id)
+        return (req.problem, np.asarray(req.p_times).shape,
+                req.lb_kind, req.chunk, req.capacity,
+                req.balance_period, req.min_seed,
+                req.segment_iters or self.segment_iters,
+                req.checkpoint_every or self.checkpoint_every)
+
+    def _tick_megabatch(self, now: float) -> None:
+        """Steps 2+ of the scheduler tick in megabatch mode (lock
+        held): drain the wait line into the former, close ready
+        batches onto free healthy submeshes. Submesh exclusions are a
+        remediation refinement the batched dispatcher does not honor
+        per-slot (a batch of one — the age-closed lone request — goes
+        through the ordinary solo path and keeps every solo
+        semantic)."""
+        while True:
+            rec = self.queue.pop_best()
+            if rec is None:
+                break
+            self.former.offer(self._batch_key(rec), rec)
+        # the peak-depth high-water must see the former-held wait line
+        # (the heap is drained every tick, so it alone would record ~0)
+        self.queue.observe_backlog(len(self.former))
+        for slot in self.slots:
+            if slot.record is not None or slot.quarantined:
+                continue
+            batch = reason = None
+            while batch is None:
+                ready = self.former.pop_ready(now)
+                if ready is None:
+                    break
+                cand, reason = ready
+                live = []
+                for r in cand:
+                    if r.over_deadline(now) and r.dispatches > 0:
+                        # the solo pop rule: budget exhausted in line,
+                        # the partial result stands
+                        self._finalize(r, DEADLINE)
+                    else:
+                        live.append(r)
+                batch = live or None
+            if batch is None:
+                break
+            close_t = time.monotonic()
+            for r in batch:
+                # the queue-wait SLO observes at BATCH-CLOSE: a member
+                # held waiting for batchmates (or a free slot) is
+                # waiting, and the health engine's queue_wait p99 must
+                # see it (the per-request dispatch wait stays visible
+                # in snapshots as dispatch_wait_s)
+                r.batch_closed_t = close_t
+                if r.queued_t:
+                    self._m_queue_wait.observe(close_t - r.queued_t)
+            self._m_batches.inc(reason=reason)
+            self._m_batch_size.observe(len(batch))
+            if self.ledger is not None:
+                self.ledger.journal("batch", members=[r.id for r in batch],
+                                   reason=reason, submesh=slot.index)
+            tracelog.event("batch.close", size=len(batch),
+                           reason=reason, submesh=slot.index,
+                           members=[r.id for r in batch])
+            if len(batch) == 1:
+                # a lone age-closed request runs the ordinary solo
+                # path: exact solo semantics, no batched compile
+                self._dispatch(slot, batch[0])
+            else:
+                self._m_batch_req.inc(len(batch))
+                self._dispatch_batch(slot, batch)
+
+    def _dispatch_batch(self, slot: _Slot, recs: list) -> None:
+        """Start one executor thread for a closed multi-request batch
+        on `slot` (lock held)."""
+        bid = f"batch-{next(self._batch_seq):04d}"
+        for rec in recs:
+            rec.state = RUNNING
+            rec.submesh = slot.index
+            rec.dispatches += 1
+            rec.stop_reason = None
+            rec.started_t = time.monotonic()
+            rec.last_heartbeat_t = rec.started_t
+            rec.dispatch_heartbeats = 0
+            rec.batch_id = bid
+            if self.ledger is not None:
+                self.ledger.journal("dispatch", rid=rec.id,
+                                   submesh=slot.index,
+                                   dispatch=rec.dispatches,
+                                   batch=bid, batch_size=len(recs))
+            tracelog.event("request.dispatch", request_id=rec.id,
+                           submesh=slot.index, dispatch=rec.dispatches,
+                           batch=bid, batch_size=len(recs),
+                           queue_depth=len(self.queue))
+            if rec.dispatches > 1:
+                tracelog.event("request.resume", request_id=rec.id,
+                               submesh=slot.index,
+                               dispatch=rec.dispatches,
+                               preemptions=rec.preemptions,
+                               failures=rec.failures)
+        slot.record = recs[0]
+        slot.batch = list(recs)
+        slot.stop_event = threading.Event()
+        slot.thread = threading.Thread(
+            target=self._execute_batch, args=(slot, list(recs)),
+            daemon=True, name=f"tts-service-exec-{slot.index}")
+        slot.thread.start()
+
+    def _execute_batch(self, slot: _Slot, recs: list) -> None:
+        from ..engine import checkpoint, megabatch
+        from .. import problems
+
+        req0 = recs[0].request
+        p0 = np.asarray(req0.p_times)
+        prob = problems.get(req0.problem)
+        capacity = req0.capacity or prob.default_capacity(p0)
+        evt = slot.stop_event
+        bid = recs[0].batch_id
+
+        def hb(b, rep):
+            rec = recs[b]
+            rec.last_heartbeat_t = time.monotonic()
+            rec.dispatch_heartbeats += 1
+            self._ledger_budget(rec)
+            rec.progress = {
+                "segment": rep.segment, "iters": rep.iters,
+                "tree": rep.tree, "sol": rep.sol, "best": rep.best,
+                "pool": rep.pool_size,
+                "elapsed_s": round(rep.elapsed, 3)}
+            if rep.telemetry is not None:
+                from ..engine import telemetry as tele_mod
+                tele_mod.publish(rep.telemetry, self.metrics,
+                                 request=rec.id,
+                                 tag=rec.request.tag or rec.id)
+                rec.progress["telemetry"] = {
+                    k: rep.telemetry[k] for k in
+                    ("pruning_rate", "frontier_depth",
+                     "pool_highwater", "steal_sent", "steal_recv",
+                     "improvements")}
+
+        def member_stop(b, rep):
+            rec = recs[b]
+            if rec.stop_reason is not None:
+                return True
+            if rec.over_deadline():
+                rec.stop_reason = "deadline"
+                return True
+            return False
+
+        handled: set = set()
+
+        def on_member_done(b, res):
+            # a drained member turns DONE the moment the engine sees
+            # its pool empty — its terminal state (and result()) never
+            # waits for slower batchmates
+            rec = recs[b]
+            with self._lock:
+                handled.add(b)
+                rec.spent_prev_s = rec.spent_s()
+                rec.started_t = None
+                rec.result = res
+                rec.error = None
+                self._finalize(rec, DONE)
+
+        def on_member_stopped(b, res):
+            # a stopped member (cancel / deadline / member preempt)
+            # finalizes AT the boundary its lanes froze, like a solo
+            # request would: its result() unblocks, its spent clock
+            # stops accruing batch wall time, and it leaves RUNNING so
+            # the health stall rule cannot misread frozen lanes as a
+            # wedged submesh while batchmates keep exploring
+            rec = recs[b]
+            requeue = False
+            with self._lock:
+                if rec.state in TERMINAL_STATES:
+                    return
+                handled.add(b)
+                rec.spent_prev_s = rec.spent_s()
+                rec.started_t = None
+                reason = rec.stop_reason
+                rec.result = res
+                rec.error = None
+                if reason == "deadline" or rec.over_deadline():
+                    self._finalize(rec, DEADLINE)
+                elif reason == "cancel":
+                    self._finalize(rec, CANCELLED)
+                else:          # preempt / shutdown / whole-batch stop
+                    requeue = self._record_preempt(rec, reason)
+            if requeue:
+                self.queue.requeue(rec)
+
+        specs = []
+        inc_keys = [None] * len(recs)
+        if self.incumbents is not None:
+            from ..engine import incumbent as inc_mod
+            inc_keys = [inc_mod.share_key(
+                np.asarray(r.request.p_times),
+                problem=r.request.problem,
+                group=r.request.share_group) for r in recs]
+        for rec, ikey in zip(recs, inc_keys):
+            specs.append(megabatch.MemberSpec(
+                table=np.asarray(rec.request.p_times),
+                init_ub=rec.request.init_ub,
+                checkpoint_path=rec.checkpoint_path,
+                checkpoint_meta_extra=(lambda rec=rec: {
+                    **(rec.request.checkpoint_meta or {}),
+                    "spent_s": round(rec.spent_s(), 2)}),
+                incumbent_key=ikey))
+
+        results = error = None
+        no_retry = False
+        with tracelog.context(request_id=bid, submesh=slot.index):
+            try:
+                with tracelog.span(
+                        "batch.dispatch", batch=len(recs),
+                        problem=req0.problem, jobs=int(p0.shape[1]),
+                        lb_kind=req0.lb_kind) as sp:
+                    results = megabatch.serve_batch(
+                        specs, problem=req0.problem,
+                        lb_kind=req0.lb_kind, mesh=slot.mesh,
+                        chunk=req0.chunk, capacity=capacity,
+                        balance_period=req0.balance_period,
+                        min_seed=req0.min_seed,
+                        segment_iters=(req0.segment_iters
+                                       or self.segment_iters),
+                        checkpoint_every=(req0.checkpoint_every
+                                          or self.checkpoint_every),
+                        heartbeat=hb, member_stop=member_stop,
+                        on_member_done=on_member_done,
+                        on_member_stopped=on_member_stopped,
+                        stop_event=evt, loop_cache=self.cache,
+                        incumbent_board=self.incumbents,
+                        tuner=self.tuner)
+                    sp.set(done=sum(1 for r in results
+                                    if r is not None and r.complete))
+            except megabatch.MemberIncompatible as e:
+                # ONE member's resume state cannot batch (legacy
+                # checkpoint dtype/telemetry width, cross-problem tag
+                # — invisible to the batch key): demote THAT member to
+                # the solo path and requeue every batchmate untouched
+                # — nobody ran, nobody earned a failure, and a
+                # batch-wide FAILED would dead-letter innocents
+                tracelog.event("batch.member_incompatible",
+                               request_id=recs[e.member].id,
+                               batch=bid, reason=str(e))
+                with self._lock:
+                    recs[e.member].solo_only = True
+                    for rec in recs:
+                        if rec.state in TERMINAL_STATES:
+                            continue
+                        rec.spent_prev_s = rec.spent_s()
+                        rec.started_t = None
+                        rec.state = QUEUED
+                        handled.add(recs.index(rec))
+                if not self._closing.is_set():
+                    for rec in recs:
+                        if rec.state == QUEUED:
+                            self.queue.requeue(rec)
+            except checkpoint.TRANSIENT_ERRORS as e:
+                error = f"transient: {e!r}"      # retryable: no_retry
+                #                                  stays False
+            except Exception as e:  # noqa: BLE001 — FAILED terminal
+                error = f"{type(e).__name__}: {e}"
+                no_retry = True
+            self._on_batch_finished(slot, recs, results, error,
+                                    handled, no_retry)
+
+    def _on_batch_finished(self, slot: _Slot, recs: list, results,
+                           error: str | None, handled: set,
+                           no_retry: bool = False) -> None:
+        """Per-member terminal/requeue bookkeeping after a batch
+        dispatch returns — the batched mirror of `_on_finished`.
+        Members the engine already finalized mid-batch (DONE on drain,
+        stopped at their boundary — `handled`) are skipped, so a later
+        batch-wide error can never smear failure counts onto requests
+        that already succeeded or were requeued."""
+        requeues = []
+        backoff = None
+        with self._lock:
+            for b, rec in enumerate(recs):
+                if b in handled or rec.state in TERMINAL_STATES:
+                    continue
+                rec.spent_prev_s = rec.spent_s()
+                rec.started_t = None
+                reason = rec.stop_reason
+                if error is not None:
+                    if self._handle_dispatch_failure(rec, slot.index,
+                                                     error,
+                                                     no_retry=no_retry):
+                        backoff = backoff_delay(rec.failures - 1,
+                                                self.service_retry_base_s)
+                        requeues.append(rec)
+                    continue
+                res = results[b] if results is not None else None
+                rec.result = res if res is not None else rec.result
+                rec.error = None
+                if res is not None and res.complete:
+                    self._finalize(rec, DONE)
+                elif reason == "deadline" or rec.over_deadline():
+                    self._finalize(rec, DEADLINE)
+                elif reason == "cancel":
+                    self._finalize(rec, CANCELLED)
+                elif reason in ("preempt", "shutdown") or evt_set(slot):
+                    if self._record_preempt(rec, reason):
+                        requeues.append(rec)
+                else:
+                    self._finalize(
+                        rec, FAILED,
+                        error="batch member stopped incomplete without "
+                              "a stop request (engine bug?)")
+        if backoff:
+            time.sleep(backoff)
+        for rec in requeues:
+            self.queue.requeue(rec)
+        with self._lock:
+            slot.record = None
+            slot.batch = None
+            slot.stop_event = None
+            slot.thread = None
+
     def _dispatch(self, slot: _Slot, rec: RequestRecord) -> None:
         """Start one executor thread for `rec` on `slot` (lock held)."""
         rec.state = RUNNING
@@ -1317,13 +1821,19 @@ class SearchServer:
         rec.stop_reason = None
         rec.started_t = time.monotonic()
         # the queue-wait SLO observation (admit/requeue -> here) and
-        # the stall rule's liveness baseline until the first heartbeat
-        if rec.queued_t:
+        # the stall rule's liveness baseline until the first heartbeat.
+        # A batch-of-one dispatch already observed its wait at
+        # batch-close (batch_closed_t set) — observing again would
+        # double-count the member
+        if rec.queued_t and rec.batch_closed_t is None:
             self._m_queue_wait.observe(rec.started_t - rec.queued_t)
         rec.last_heartbeat_t = rec.started_t
         rec.dispatch_heartbeats = 0     # this dispatch warms afresh
         # (stall judges it against the warmup threshold until the
         # engine heartbeats — a resume on a cold submesh pays a compile)
+        rec.batch_id = None             # THIS dispatch is solo; a
+        # stale id from an earlier batched dispatch would contradict
+        # the slot's own (null) batch field in snapshots
         if self.ledger is not None:
             self.ledger.journal("dispatch", rid=rec.id,
                                submesh=slot.index,
@@ -1535,60 +2045,18 @@ class SearchServer:
             rec.started_t = None
             reason = rec.stop_reason
             if error is not None:
-                rec.failures += 1
-                rec.error = error
-                # the post-hoc diagnosis trail: EVERY failure lands in
-                # the record's failure_log (surfaced on /status and by
-                # tools/trace_summary.py), remediation on or off
-                rec.failure_log.append(
-                    {"t": time.time(), "submesh": slot.index,
-                     "attempt": rec.dispatches, "error": error})
-                del rec.failure_log[:-FAILURE_LOG_CAP]
-                # one flight-recorder entry per failure — including
-                # the TERMINAL one (redispatch events only cover the
-                # requeue path), so trace_summary can rebuild the
-                # complete failure_log from the trace alone
-                tracelog.event("request.dispatch_failure",
-                               request_id=rec.id, submesh=slot.index,
-                               attempt=rec.dispatches, error=error)
-                if self.ledger is not None:
-                    self.ledger.journal(
-                        "failure", rid=rec.id, submesh=slot.index,
-                        attempt=rec.dispatches, error=error,
-                        failures=rec.failures,
-                        spent_s=round(rec.spent_prev_s, 3))
-                # remediation verdict: exclude the failing submesh /
-                # quarantine it / dead-letter a request whose failures
-                # followed it across distinct submeshes. Observe-only
-                # (the default) journals and returns "requeue" with
-                # zero state mutated — today's behavior exactly
-                verdict = self.remediation.on_dispatch_failure(
-                    rec, slot.index, error)
-                if (verdict == "requeue"
-                        and rec.failures <= self.service_retry_attempts
-                        and not self._closing.is_set()):
-                    # submesh failure: cool this slot down for the
-                    # backoff, then put the request back in line — the
-                    # scheduler may re-dispatch it to a DIFFERENT
-                    # submesh (the checkpoint, when one was written,
-                    # reshards elastically)
-                    rec.state = QUEUED
-                    self._m_redispatch.inc()
-                    tracelog.event("request.redispatch",
-                                   request_id=rec.id,
-                                   failures=rec.failures, error=error)
+                # failure_log append, journal, trace event, remediation
+                # verdict and requeue/deadletter/FAILED arbitration all
+                # live in _handle_dispatch_failure (shared with the
+                # batched finish path). On requeue the slot cools down
+                # for the backoff, then the scheduler may re-dispatch
+                # to a DIFFERENT submesh (the checkpoint, when one was
+                # written, reshards elastically)
+                if self._handle_dispatch_failure(rec, slot.index,
+                                                 error):
                     backoff = backoff_delay(rec.failures - 1,
                                             self.service_retry_base_s)
                     requeue = rec
-                elif verdict == "deadletter":
-                    self._finalize(
-                        rec, FAILED,
-                        error=f"dead-lettered: failed on "
-                              f"{len({f['submesh'] for f in rec.failure_log})} "
-                              f"distinct submeshes (the fault follows "
-                              f"the request); last: {error}")
-                else:
-                    self._finalize(rec, FAILED, error=error)
             else:
                 rec.result = res
                 rec.error = None     # a recovered transient is not an error
@@ -1599,21 +2067,7 @@ class SearchServer:
                 elif reason == "cancel":
                     self._finalize(rec, CANCELLED)
                 elif reason in ("preempt", "shutdown") or evt_set(slot):
-                    rec.state = PREEMPTED
-                    rec.preemptions += 1
-                    self._m_preempt.inc()
-                    if self.ledger is not None:
-                        self.ledger.journal(
-                            "preempt", rid=rec.id,
-                            preemptions=rec.preemptions,
-                            spent_s=round(rec.spent_prev_s, 3),
-                            hold=rec.hold)
-                    tracelog.event("request.preempt", request_id=rec.id,
-                                   reason=reason or "stop",
-                                   preemptions=rec.preemptions,
-                                   hold=rec.hold)
-                    if reason != "shutdown" and not rec.hold \
-                            and not self._closing.is_set():
+                    if self._record_preempt(rec, reason):
                         requeue = rec
                 else:
                     self._finalize(

@@ -277,10 +277,16 @@ def serve_spool(server, spool: str | pathlib.Path,
                 del pending[sid]
                 served += 1
         # a paused server is mid-incident, not idle: the idle-exit
-        # clock must not shut it down on top of a held backlog
+        # clock must not shut it down on top of a held backlog.
+        # Megabatch: requests the scheduler drained into the batch-
+        # former are admitted work WAITING to batch — idle-exit must
+        # not cancel them mid-hold (the queue reads empty the moment
+        # the former holds them)
+        former = getattr(server, "former", None)
         busy = bool(pending) or paused is not None \
-            or len(server.queue) > 0 or any(
-                s.record is not None for s in server.slots)
+            or len(server.queue) > 0 \
+            or (former is not None and len(former) > 0) \
+            or any(s.record is not None for s in server.slots)
         now = time.monotonic()
         if busy:
             last_work = now

@@ -64,14 +64,24 @@ class Params:
     #                                    source is cache/probe
 
 
-def shape_class(jobs: int, machines: int, problem: str = "pfsp") -> str:
+def shape_class(jobs: int, machines: int, problem: str = "pfsp",
+                batch: int | None = None) -> str:
     """The shape-class label table rows key on. PFSP keeps the legacy
     Taillard-style ``JxM`` label (persisted tuning caches and the
     MEASURED rows predate the problem prefix); every other problem is
     namespaced ``problem:JxM`` so two workloads can never alias one
-    measured row."""
+    measured row. A megabatched dispatch (``batch`` = the instance-axis
+    width B > 1) appends ``@bB``: the batched loop's cost structure is
+    its own (every member pops a chunk per iteration, so the effective
+    parallel width is B x chunk), and a batched optimum must never
+    alias — or silently fall back to — the solo row of the same
+    shape."""
     label = f"{int(jobs)}x{int(machines)}"
-    return label if problem == "pfsp" else f"{problem}:{label}"
+    if problem != "pfsp":
+        label = f"{problem}:{label}"
+    if batch is not None and int(batch) > 1:
+        label = f"{label}@b{int(batch)}"
+    return label
 
 
 # (context, shape_class) -> Params. Contexts: "bench" (single-chip
@@ -85,7 +95,27 @@ MEASURED: dict[tuple[str, str], Params] = {
     ("bench", "20x5"): Params(chunk=BENCH_CHUNK_DEFAULT),
     ("bench", "20x10"): Params(chunk=BENCH_CHUNK_DEFAULT),
     ("bench", "20x20"): Params(chunk=BENCH_CHUNK_DEFAULT),
+    # MEGABATCH round (this PR, 8-dev CPU mesh, bench.py
+    # pfsp_serve_rps): the small-instance serving mix the batch-former
+    # targets — per-member chunk 64 at B=4/8/16 beat 128/256 (lockstep
+    # ramp dominates; every member pays the widest member's underfilled
+    # steps) and matched the solo row's reaction latency. Explicit rows
+    # so the batched hot path never probes and never silently reads
+    # the solo serving row.
+    ("serving", "8x5@b4"): Params(chunk=SERVING_CHUNK_DEFAULT),
+    ("serving", "8x5@b8"): Params(chunk=SERVING_CHUNK_DEFAULT),
+    ("serving", "8x5@b16"): Params(chunk=SERVING_CHUNK_DEFAULT),
 }
+
+# megabatched serving (TTS_MEGABATCH): the per-member chunk of a
+# batched dispatch. MEASURED on the 8-dev CPU mesh (this PR's
+# megabatch round): at B=8 small instances per submesh the batched
+# loop's effective parallel width is B x chunk, so the solo serving
+# chunk (64) already saturates each member's shallow pools — larger
+# per-member chunks only inflate the lockstep ramp (every member pays
+# the widest member's underfilled steps). Re-measure on hardware
+# before trusting this for big-B TPU batches.
+SERVING_BATCH_CHUNK_DEFAULT = 64
 
 _FALLBACK: dict[str, Params] = {
     "bench": Params(chunk=BENCH_CHUNK_DEFAULT),
@@ -93,21 +123,36 @@ _FALLBACK: dict[str, Params] = {
     "cli": Params(chunk=CLI_CHUNK_DEFAULT),
 }
 
+# the BATCHED serving fallback is its own explicit row: a batched
+# dispatch that finds no measured/tuned entry must land on a value
+# chosen FOR batched execution — falling through to the solo serving
+# row silently would let a solo retune change every megabatch's cost
+# structure without anyone measuring it
+_FALLBACK_BATCHED = Params(chunk=SERVING_BATCH_CHUNK_DEFAULT)
+
 
 def params_for(context: str, jobs: int | None = None,
                machines: int | None = None,
-               problem: str = "pfsp") -> Params:
+               problem: str = "pfsp",
+               batch: int | None = None) -> Params:
     """Resolve the default dispatch params for a context, problem and
     shape — the tuner's fallback tier and the single source
     config/bench/serve read their chunk/balance_period defaults from.
     Only PFSP has measured rows today; other problems resolve through
-    the per-context fallback until their own perf rounds land."""
+    the per-context fallback until their own perf rounds land.
+
+    ``batch`` (the megabatch instance-axis width) keys batched rows via
+    :func:`shape_class`'s ``@bB`` suffix; with no batched row measured
+    the resolution falls to the explicit batched serving fallback
+    (``_FALLBACK_BATCHED``), NEVER silently to the solo serving row."""
     if context not in _FALLBACK:
         raise ValueError(f"unknown defaults context {context!r} "
                          f"(want one of {sorted(_FALLBACK)})")
     if jobs is not None and machines is not None:
         row = MEASURED.get((context, shape_class(jobs, machines,
-                                                 problem)))
+                                                 problem, batch=batch)))
         if row is not None:
             return row
+    if batch is not None and int(batch) > 1:
+        return _FALLBACK_BATCHED
     return _FALLBACK[context]

@@ -109,11 +109,19 @@ class Autotuner:
 
     @staticmethod
     def key(jobs: int, machines: int, lb_kind: int,
-            n_workers: int, problem: str = "pfsp") -> tuple:
+            n_workers: int, problem: str = "pfsp",
+            batch: int | None = None) -> tuple:
         # the problem name LEADS the key (PFSP entries keep their
-        # pre-plugin cache identity — persisted caches stay valid)
-        return (str(problem), int(jobs), int(machines), int(lb_kind),
+        # pre-plugin cache identity — persisted caches stay valid).
+        # A megabatched dispatch (batch > 1) appends a ("batch", B)
+        # suffix: solo keys keep their exact persisted layout, and a
+        # batched optimum can never be served from — or clobber — the
+        # solo entry of the same shape
+        base = (str(problem), int(jobs), int(machines), int(lb_kind),
                 int(n_workers))
+        if batch is not None and int(batch) > 1:
+            base = base + ("batch", int(batch))
+        return base
 
     # --------------------------------------------------------- resolve
 
@@ -121,15 +129,23 @@ class Autotuner:
                 n_workers: int = 1, allow_probe: bool = False,
                 p_times: np.ndarray | None = None,
                 context: str = "serving",
-                problem: str = "pfsp") -> Params:
+                problem: str = "pfsp",
+                batch: int | None = None) -> Params:
         """The three-tier lookup. ``allow_probe=False`` is the request
         hot path (cache else defaults — never seconds of probing while
         a client waits); ``allow_probe=True`` is the boot/bench path
         (cache else probe+persist else defaults). Probing is PFSP-only
         for now (the probe harness drives the PFSP step); other
-        problems resolve cache-else-defaults."""
-        key = self.key(jobs, machines, lb_kind, n_workers, problem)
-        if problem != "pfsp":
+        problems resolve cache-else-defaults.
+
+        ``batch`` (a megabatch dispatch's instance-axis width) rides
+        the cache key and the defaults lookup: batched optima are their
+        own entries, and the fallback is the batched defaults row —
+        never the solo serving row (the probe harness is solo-only, so
+        batched keys resolve cache-else-batched-defaults)."""
+        key = self.key(jobs, machines, lb_kind, n_workers, problem,
+                       batch=batch)
+        if problem != "pfsp" or (batch is not None and batch > 1):
             allow_probe = False
         with self._lock:
             memo = self._memo.get(key)
@@ -155,7 +171,7 @@ class Autotuner:
                                machines=machines, lb_kind=lb_kind,
                                error=repr(e))
         return defaults.params_for(context, jobs, machines,
-                                   problem=problem)
+                                   problem=problem, batch=batch)
 
     # ------------------------------------------------------------ tune
 

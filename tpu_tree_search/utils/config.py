@@ -299,6 +299,20 @@ LEDGER_BUDGET_EVERY_S_DEFAULT = 5.0   # seconds between journaled
 #                                       hard kill can lose without
 #                                       fsyncing at heartbeat rate)
 
+# Request megabatching (engine/megabatch.py + service batch-former +
+# serve --megabatch). TTS_MEGABATCH=1 (STATIC per server; default off =
+# bit-identical to the solo scheduler) makes the admission queue a
+# BATCH-FORMER: queued requests group by (problem, table shape,
+# lb_kind, engine knobs) and a group dispatches to one submesh as ONE
+# vmapped compiled loop when it reaches TTS_BATCH_MAX members or its
+# oldest member has waited TTS_BATCH_AGE_S seconds (a lone request
+# age-closes as a batch of one and runs the ordinary solo path). Every
+# batched request's node counts, optimum and telemetry block are
+# bit-identical to its solo run (test-pinned).
+MEGABATCH_FLAG = "TTS_MEGABATCH"
+BATCH_MAX_DEFAULT = 8          # TTS_BATCH_MAX — close a batch at size
+BATCH_AGE_S_DEFAULT = 0.25     # TTS_BATCH_AGE_S — or at this age
+
 # Self-healing (service/remediate.py + serve --remediate).
 # TTS_REMEDIATE=1 lets the RemediationController EXECUTE its policy
 # table (stall -> preempt+exclude, repeated localized failures ->
@@ -470,6 +484,16 @@ KNOBS: dict[str, Knob] = _knob_table(
     Knob("TTS_DRAIN_TIMEOUT_S", "float", DRAIN_TIMEOUT_S_DEFAULT,
          "serve: SIGTERM/SIGINT graceful-drain budget before the "
          "checkpoint-and-abort escalation"),
+    # --- request megabatching (engine/megabatch.py; semantics per
+    #     README "Request megabatching")
+    Knob("TTS_MEGABATCH", "flag", False,
+         "serve: batch same-shape-class requests into one vmapped "
+         "compiled loop (default off = the solo scheduler exactly)"),
+    Knob("TTS_BATCH_MAX", "int", BATCH_MAX_DEFAULT,
+         "megabatch: close a forming batch at this many members"),
+    Knob("TTS_BATCH_AGE_S", "float", BATCH_AGE_S_DEFAULT,
+         "megabatch: close a forming batch once its oldest member has "
+         "waited this long (a lone request closes as a batch of one)"),
     # --- self-healing (service/remediate.py; semantics per README
     #     "Self-healing")
     Knob("TTS_REMEDIATE", "flag", False,
@@ -524,6 +548,11 @@ KNOBS: dict[str, Knob] = _knob_table(
          "bench: ramp/drain synthetic instance jobs", "bench"),
     Knob("TTS_BENCH_RAMP_CHUNK", "int", 1024,
          "bench: ramp/drain tuned-chunk rung", "bench"),
+    Knob("TTS_BENCH_SERVE_RPS", "flag", True,
+         "bench: emit the serve requests/s row (small-instance mix "
+         "through one serve session)", "bench"),
+    Knob("TTS_BENCH_SERVE_N", "int", 8,
+         "bench: serve-rps request count", "bench"),
     # --- tools/ drivers
     Knob("TTS_CAMPAIGN_OUT", "str", "/tmp/campaign.jsonl",
          "run_campaign: result JSONL path", "tool"),
