@@ -291,6 +291,69 @@ def bench_ramp_drain(inst: int):
           f"segments={len(segs)}", file=sys.stderr)
 
 
+def bench_hbm_bytes(p, ub, inst, lbs):
+    """Step-HBM bytes of the compiled search loop, one LOWER-IS-BETTER
+    row per bound, stamped with the ``fused`` mode channel (the
+    TTS_FUSED resolution it measured) so tools/perf_sentry.py never
+    judges a fused allocation profile against unfused history
+    (cross-mode = SKIP, the overlap/ladder/megabatch rule). This is
+    the fused-kernel arc's acceptance metric: the fused route keeps
+    the dense child grid, the (1, N) bound row, the prune mask and the
+    partition keys out of HBM entirely.
+
+    Measurement: the compiled loop's XLA memory_analysis temp-buffer
+    bytes on EVERY backend — deterministic, and exactly the per-step
+    HBM working set the fused kernels shrink. A live
+    ``peak_bytes_in_use`` delta was rejected: the peak is a lifetime
+    high-water the warm run of the same executable already
+    establishes, so a warm-vs-measured delta reads ~0 on exactly the
+    TPU/GPU backends that report it — a lower-is-better row whose
+    floor is its steady state can never FAIL. TTS_BENCH_HBM=0 skips.
+    The tile is pinned small (64) so the fused kernels' per-tile
+    store slack (J*tile) stays a sliver of the frame at the bench
+    chunk."""
+    import jax.numpy as jnp
+
+    from tpu_tree_search.ops import pallas_fused
+    from tpu_tree_search.utils import config as cfg
+
+    fused_mode = pallas_fused.resolve_mode(None)
+    # an explicit TTS_BENCH_CHUNK is honored (the row must describe
+    # the same compiled program the run's throughput rows measured);
+    # only the DEFAULT stays 512 — analysis-only lowering at the
+    # 65536 bench default would pay a large compile for a row whose
+    # reference history is chunk-stamped anyway
+    chunk = cfg.env_int("TTS_BENCH_CHUNK") or 512
+    tile = 64
+    jobs = p.shape[1]
+    tables = batched.make_tables(p)
+    for lb_kind in lbs:
+        state = device.init_state(jobs, 1 << 18, ub, p_times=p)
+        lowered = device._run.lower(
+            tables, state, lb_kind, chunk,
+            jnp.asarray(60, jnp.int64), jnp.asarray(1, jnp.int32),
+            tile=tile, fused=fused_mode)
+        value = lowered.compile().memory_analysis() \
+            .temp_size_in_bytes
+        how = "memory_analysis_temp"
+        row = {
+            "metric": f"pfsp_ta{inst:03d}_lb{lb_kind}_hbm_bytes",
+            "value": int(value),
+            "unit": "bytes_per_step",
+            "direction": "lower",
+            "how": how,
+            "chunk": chunk,
+            "tile": tile,
+            "fused": int(fused_mode != "off"),
+            "platform": PLATFORM,
+        }
+        if DEGRADED:
+            row["degraded"] = True
+        print(json.dumps(row))
+        print(f"# hbm_bytes lb={lb_kind} fused={fused_mode} "
+              f"how={how} bytes={int(value):,}", file=sys.stderr)
+
+
 def bench_serve_rps():
     """Serving throughput on a small-instance mix: N synthetic 8x5
     PFSP instances submitted to ONE serve session, reported as
@@ -392,6 +455,13 @@ def main():
         from tpu_tree_search.tune import Autotuner
         tuner = Autotuner(cache_dir=cfg.env_str("TTS_TUNE_CACHE"))
 
+    # fused-route mode channel: stamped ONLY when the fused kernels are
+    # on (TTS_FUSED resolution), so unfused rows keep matching their
+    # modeless history — the same stamping rule as "tuned"
+    from tpu_tree_search.ops import pallas_fused
+    fused_mode = pallas_fused.resolve_mode(None)
+    fused_row = {"fused": 1} if fused_mode != "off" else {}
+
     for lb_kind in lbs:
         tuned_row = {}
         if tuner is not None:
@@ -439,6 +509,7 @@ def main():
             "baseline": BASELINE_LABEL,
             "platform": PLATFORM,
             **tuned_row,
+            **fused_row,
         }
         if DEGRADED:
             row["degraded"] = True
@@ -465,6 +536,8 @@ def main():
               f"chunk={chunk} pool={int(state.size)} "
               f"best={int(state.best)}", file=sys.stderr)
 
+    if cfg.env_flag("TTS_BENCH_HBM"):
+        bench_hbm_bytes(p, ub, inst, lbs)
     if cfg.env_flag("TTS_BENCH_SEGGAP"):
         bench_segment_gap(p, ub, inst)
     if cfg.env_flag("TTS_BENCH_COLDSTART"):

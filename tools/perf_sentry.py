@@ -58,7 +58,7 @@ THRESHOLDS = {
 # Everything else is a rate (higher is better). First matching
 # substring wins.
 LOWER_IS_BETTER = ("segment_gap", "cold_start", "_seconds", "latency",
-                   "_ramp_s", "_drain_s", "_wall_s")
+                   "_ramp_s", "_drain_s", "_wall_s", "hbm_bytes")
 
 PASS, FAIL, NEW, SKIP = "PASS", "FAIL", "NEW", "SKIP"
 
@@ -99,6 +99,12 @@ def row_mode(row: dict):
         # a batched requests/s figure must never rate-judge against
         # solo serving history — different execution modes entirely
         return ("megabatch", row["megabatch"])
+    if row.get("fused") is not None:
+        # the fused Pallas bound+prune+compact route (TTS_FUSED,
+        # ops/pallas_fused): a fused step's allocation profile or rate
+        # must never be judged against unfused history — the hbm_bytes
+        # family exists precisely to show the two DIFFER
+        return ("fused", row["fused"])
     if row.get("tuned") is not None:
         return ("tuned", row["tuned"])
     return None

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops import batched, pallas_expand, reference as ref
+from ..ops import batched, pallas_expand, pallas_fused, reference as ref
 from ..ops.batched import BoundTables
 from ..utils import config as _cfg
 from . import telemetry as tele
@@ -223,6 +223,20 @@ def _col_major(x, G: int, J: int, TB: int):
     """(1, B) per-parent row -> (1, N) per-child-slot row in the expand
     kernel's column order (c = (g*J + i)*TB + b)."""
     return jnp.broadcast_to(x.reshape(G, 1, TB), (G, J, TB)).reshape(1, -1)
+
+
+def _child_masks(p_depth, valid, G: int, J: int, TB: int):
+    """The (1, N) child-slot mask family in the expand kernel's column
+    order — ONE construction shared by step()'s dense routes and the
+    fused spill branch, so the two can never drift (the spill cond's
+    bit-parity with the kernel path depends on it). Returns (depth_c,
+    mask); leaves are ``(depth_c + 1) == J`` within mask."""
+    depth_c = _col_major(p_depth, G, J, TB)
+    valid_c = _col_major(valid[None, :], G, J, TB)
+    slot_c = jnp.broadcast_to(
+        jnp.arange(J, dtype=jnp.int32)[None, :, None], (G, J, TB)
+    ).reshape(1, G * J * TB)
+    return depth_c, (slot_c >= depth_c) & valid_c
 
 
 def _partition(push: jax.Array) -> jax.Array:
@@ -533,9 +547,423 @@ def _commit(state: SearchState, prmu, depth, aux, n_push, best, sol, mask,
         telemetry=telem)
 
 
+def _sweep_tiers(tbl, cf_cols, sched_cols, count, N: int, J: int,
+                 M: int):
+    """Pair sweep over the smallest prefix tier covering `count` live
+    columns; columns past the tier read I32_MAX. Finer ladder than the
+    compaction's (its branches carry only a (1, frame) row, so extra
+    rungs are nearly free) with 3/2^k rungs for the same occupancy
+    reason (_compact_tiers). When the sweep runs as the pallas kernel,
+    each rung must satisfy its tile rule (lb2_tile — lane alignment
+    AND the scoped-VMEM model) or lb2_bounds would silently take its
+    XLA fallback there; when the class is outside the pair kernel
+    anyway (lb2_kernel_fits false — the J>64 classes), the XLA scan
+    has no tile constraint and every rung is admitted, keeping the
+    swept prefix snug around small survivor sets."""
+    PT = int(tbl.ma0.shape[0])
+    frame = cf_cols.shape[1]
+    on_tpu = jax.default_backend() == "tpu"
+
+    def rung_ok(t):
+        # a rung is admitted when the sweep at that width runs a
+        # pallas kernel — lb2_sweep_tile is THE shared dispatch
+        # predicate (register kernel or streaming big-J), so admission
+        # cannot diverge from lb2_bounds. On CPU every rung is fine
+        # (the XLA scan has no tile rule).
+        return (not on_tpu
+                or pallas_expand.lb2_sweep_tile(J, PT, M, t) > 0)
+
+    # finer than the compaction ladder (rungs here carry only a
+    # (1, frame) row): the tail sweep's survivor count sits wherever
+    # the head prune left it, and a coarse ladder over-sweeps it by up
+    # to 50% (nkeep~43k rode the 61440 rung — measured, 166 pairs x
+    # 18k wasted columns/step)
+    tiers = [t for t in (k * N // 64 for k in
+                         (1, 2, 3, 4, 5, 6, 8, 10, 12, 14, 16,
+                          20, 24, 32))
+             if 0 < t < frame and rung_ok(t)]
+    if on_tpu and not rung_ok(frame):
+        # the frame rung is appended unconditionally (it must cover
+        # every count), but if it misses the tile rule lb2_bounds
+        # takes its XLA fallback there — on the WIDEST (most
+        # expensive) rung. Loud, not silent.
+        import warnings
+        warnings.warn(
+            f"lb2 sweep frame rung {frame} (J={J}, P={PT}) fails "
+            "the pallas tile rule; the widest sweep tier will run "
+            "the XLA scan fallback", stacklevel=2)
+    tiers.append(frame)
+
+    def prefix(width):
+        def f(_):
+            b = pallas_expand.lb2_bounds(
+                tbl, cf_cols[:, :width], sched_cols[:, :width])
+            if width < frame:
+                b = jnp.concatenate(
+                    [b, jnp.full((1, frame - width), I32_MAX,
+                                 jnp.int32)], axis=1)
+            return b
+        return f
+
+    return _tier_switch(tiers, count, prefix)
+
+
+def _take_block(*rows_arrays):
+    """prefix-gather closure over the given (rows, frame) arrays."""
+    def take(idx):
+        idx = jax.lax.optimization_barrier(idx)
+        out = tuple(jnp.take(a, idx, axis=1) for a in rows_arrays)
+        return jax.lax.optimization_barrier(out)
+    return take
+
+
+def _lb2_tail(tables: BoundTables, state: SearchState, children, caux,
+              sched, ncand, W_: int, N: int, best, start, limit,
+              debug_tap: bool, TELE: bool):
+    """Everything after the LB1 prune of the two-phase LB2 route, in
+    W_-wide frames: the strong-pair head sweep, the mid prune+compact,
+    the tail sweep, the final prune+compact and the pool block write.
+    Extracted to module level so the UNFUSED prefilter branches (which
+    regather survivors from their parents) and the FUSED route (whose
+    kernel emits the compacted survivor block directly,
+    ops/pallas_fused) run the exact same ops on the compacted block —
+    the two can never drift. Inputs: children (J, W_) i16, caux
+    (M+1, W_) i32, sched (SW, W_) i32, `ncand` live survivors in the
+    leading columns (the rest unread garbage — the scratch-margin
+    contract covers the pool write). Returns
+    (prmu, depth, aux, n_push, hsum, tsum[, tele_tail])."""
+    J = children.shape[0]
+    M = tables.p.shape[0]
+    P = int(tables.ma0.shape[0])
+    KH = batched.PAIR_PREFILTER
+
+    if P <= KH:
+        # Few pairs but outside the dense route (the wide few-pair
+        # classes, e.g. 100x5: the pallas pair kernel is gated off
+        # past J=64): no prefilter tail exists — pair_split would
+        # return an empty tail table whose (0, frame) pair-max has no
+        # identity — so ONE full sweep over the LB1 survivors is the
+        # whole LB2.
+        lb2b = _sweep_tiers(tables, caux[:M], sched, ncand, N, J, M)
+        live = ncand
+        if TELE:
+            head_hp = jnp.zeros(tele.BOUND_BINS, jnp.int64)
+    else:
+        # Strong-pair prefilter (the reference's unimplemented
+        # LB2_LEARN, c_bound_johnson.h:29): sweep only the
+        # PAIR_PREFILTER strongest pairs (tables store pairs
+        # strongest-first), prune on that partial max (partial max <=
+        # LB2, so pruning on it is sound), and pay for the remaining
+        # pairs only on the children the prefix failed to prune (<10%
+        # on the 20x20 class). The total bound stays exactly
+        # max(head, tail) = full LB2, so explored trees are
+        # bit-identical to the single-sweep path.
+        SW = pallas_expand.sched_words(J)
+        head_t, tail_t = batched.pair_split(tables, KH)
+        lb2h = _sweep_tiers(head_t, caux[:M], sched, ncand, N, J, M)
+        keep = ((jnp.arange(W_) < ncand)
+                & (lb2h.reshape(-1) < best))
+        if TELE:
+            # pruned by the strong-pair head sweep: binned at the
+            # partial bound that pruned them (a sound lower bound —
+            # partial max <= LB2)
+            head_hp = tele.bound_hist(
+                lb2h, (jnp.arange(W_) < ncand) & ~keep, best)
+        nkeep = keep.sum(dtype=jnp.int32)
+        permh = _partition_prefix(keep, ncand, N, two_phase=True,
+                                  cap=W_)
+        # the partial bound rides the compaction as an extra row
+        # (three structural variants were tried and measured WORSE: an
+        # index-composed final gather that skips re-gathering children
+        # — the composing (N,) take lowers to a ~4.7 ms serialized
+        # gather; one combined i32 block per compaction — +60% gather
+        # time, byte-bound at 40+ rows; and gathering these blocks in
+        # the pool's int16 aux dtype — TPU column gathers are
+        # element/latency-bound, i16 made them SLOWER (+18%), so the
+        # narrow dtype lives only at the pool boundary, see step())
+        aux_plus = jnp.concatenate([caux, sched, lb2h], axis=0)
+        children, aux_plus = _tiered_compact(
+            _take_block(children, aux_plus), permh, nkeep, N,
+            two_phase=True, cap=W_)
+        # barrier: the tail sweep's pallas call must see the
+        # mid-compaction's switch outputs materialized — without this,
+        # XLA's fusion of the slice chain miscompiles the compiled
+        # (jitted) step on TPU and the tail sweep reads stale columns,
+        # silently over-pruning (eager and debug-tapped traces are
+        # correct — caught by test_prefilter_branch_matches_oracle on
+        # hardware)
+        aux_plus = jax.lax.optimization_barrier(aux_plus)
+        caux = aux_plus[:M + 1]
+        sched = aux_plus[M + 1:M + 1 + SW]
+        lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
+        lb2t = _sweep_tiers(tail_t, caux[:M], sched, nkeep, N, J, M)
+        lb2b = jnp.maximum(lb2h_c, lb2t)
+        live = nkeep
+
+    push = ((jnp.arange(W_) < live)
+            & (lb2b.reshape(-1) < best))
+    n_push = push.sum(dtype=jnp.int32)
+    if TELE:
+        # branched buckets + bound histograms, computed while caux
+        # still aligns column-for-column with push/lb2b (the final
+        # compaction reorders)
+        pb = tele.depth_bucket(
+            caux[M].astype(jnp.int32).reshape(-1) - 1, J)
+        live_m = jnp.arange(W_) < live
+        tele_tail = jnp.concatenate([
+            tele.bucket_counts(pb, push),
+            head_hp + tele.bound_hist(lb2b, live_m & ~push, best),
+            tele.bound_hist(lb2b, push, best)])
+    if debug_tap:
+        # smuggle intermediates out via the balance counters
+        lv = jnp.arange(W_) < live
+        hsum = jnp.where(lv, lb2h_c.reshape(-1),
+                         0).sum(dtype=jnp.int64)
+        tsum = jnp.where(lv, lb2t.reshape(-1),
+                         0).sum(dtype=jnp.int64)
+    else:
+        hsum = tsum = jnp.int64(0)
+
+    # final compaction: direct prefix gather of the already-built
+    # block (sources are the compacted (features, W_) arrays)
+    perm2 = _partition_prefix(push, live, N, two_phase=True, cap=W_)
+    children, child_aux = _tiered_compact(
+        _take_block(children, caux), perm2, n_push, N,
+        two_phase=True, cap=W_)
+    child_depth = child_aux[M].astype(jnp.int16)
+
+    # pool write inside the branch: the written block is W_-wide, so
+    # the steady branch moves a quarter of the bytes (_write_block
+    # owns the overflow scratch-margin routing, shared with the common
+    # path)
+    prmu, depth, aux = _write_block(
+        state, children, child_depth, child_aux, start, n_push, limit)
+    out = (prmu, depth, aux, n_push, hsum, tsum)
+    if TELE:
+        out += (tele_tail,)
+    return out
+
+
+def _leaf_scan(tables: BoundTables, p_prmu, p_depth, p_aux, valid):
+    """Parent-level leaf/eval statistics of one popped chunk — the
+    dense-grid quantities the unfused routes read off the (1, N) child
+    masks, computed in O(M*B) without materializing them (the fused
+    route's whole point is that the dense grid never exists in HBM).
+
+    A parent at depth J-1 has exactly ONE valid child (slot J-1), a
+    complete schedule; its LB1 as the kernels compute it is the chain
+    max_k(tmp_k + min_tails[k]) with every child-remain term zero —
+    replicated here term for term so `leaf_best` is bit-identical to
+    the dense route's masked min over leaf columns. Parents below J-1
+    contribute J - depth evaluated (all non-leaf) children; a parent
+    at J-1 contributes its one leaf. Returns
+    (leaf_best i32, n_leaf i64, evals i64)."""
+    J, B = p_prmu.shape
+    M = p_aux.shape[0]
+    d = p_depth.reshape(-1)                        # (B,) i32
+    leafp = (d == J - 1) & valid
+    # the lone unscheduled job of a depth-(J-1) parent sits at
+    # position J-1; its processing column via the J-step select
+    # (_regather's gather-free idiom)
+    a = p_prmu[J - 1:J, :].astype(jnp.int32)       # (1, B)
+    cp = jnp.zeros((M, B), jnp.int32)
+    for j in range(J):
+        cp = jnp.where(a == j, tables.p[:, j:j + 1], cp)
+    cf = p_aux[0:1] + cp[0:1]
+    tmp = cf
+    lb = tmp + tables.min_tails[0]
+    for k in range(1, M):
+        cf = jnp.maximum(cf, p_aux[k:k + 1]) + cp[k:k + 1]
+        tmp = jnp.maximum(tmp, cf)
+        lb = jnp.maximum(lb, tmp + tables.min_tails[k])
+    leaf_best = jnp.where(leafp, lb.reshape(-1), I32_MAX).min()
+    n_leaf = leafp.sum(dtype=jnp.int64)
+    evals = jnp.where(valid, (J - d).astype(jnp.int64), 0).sum()
+    return leaf_best, n_leaf, evals
+
+
+def _fused_step(tables: BoundTables, lb_kind: int, route, chunk: int,
+                TB: int, state: SearchState, p_prmu, p_depth, p_aux,
+                n, start, valid, limit, mode: str) -> SearchState:
+    """The fused bound+prune+compact route (ops/pallas_fused): the
+    dense child grid, its (1, N) bound row, the (N,) prune mask and
+    the (N,) partition keys never exist in HBM. The kernel emits the
+    compacted survivors (capped at the steady W = N/4 frame) plus a
+    count; leaves and eval totals come from the parent-level O(M*B)
+    scan (_leaf_scan); a rare survivor-overflow step (count > W) takes
+    the unfused pipeline via ONE lax.cond on bit-identical bound math,
+    so the explored set cannot depend on which branch ran. For LB2 the
+    kernel is the fused LB1 prefilter (also emitting the survivors'
+    scheduled-set bitmask) and the shared _lb2_tail runs the pair
+    sweeps over the compacted block — op-identical to the unfused
+    two-phase route. Telemetry: popped/evaluated buckets are
+    parent-level, branched buckets and the surviving-bound histogram
+    come off the compacted block, and the PRUNED-bound histogram is
+    the kernel's per-tile masked-add output — bound_hist_exact holds
+    without the pruned bounds ever touching HBM."""
+    J, capacity = state.prmu.shape
+    M = tables.p.shape[0]
+    B = chunk
+    G = B // TB
+    N = B * J
+    TELE = state.telemetry.shape[-1] > 0
+
+    leaf_best, n_leaf, evals_cnt = _leaf_scan(tables, p_prmu, p_depth,
+                                              p_aux, valid)
+    best = jnp.minimum(state.best, leaf_best)
+    sol = state.sol + n_leaf
+    if TELE:
+        d = p_depth.reshape(-1)
+        wb = tele.depth_bucket(d, J)
+        popped_b = tele.bucket_counts(wb, valid)
+        # evaluated non-leaf children bucket by PARENT depth: J - d of
+        # them per valid parent below J-1, none at J-1 (its one child
+        # is the leaf) — the dense route's bucket_counts(child_b,
+        # mask & ~leaf) collapsed to parent-level weighted sums
+        w = jnp.where(valid & (d < J - 1), (J - d).astype(jnp.int64), 0)
+        evalnl_b = jnp.stack([jnp.sum(jnp.where(wb == k, w, 0))
+                              for k in range(tele.DEPTH_BUCKETS)])
+
+    # Survivor-cap width: the LB2 route caps at the steady N/4 frame
+    # (matching the unfused tail's steady branch; the rare overflow
+    # takes the spill cond below). The LB1 route runs uncapped — its
+    # unfused pipeline block-writes a full-N frame anyway, so a narrow
+    # cap would buy no frame bytes while costing a whole duplicated
+    # spill pipeline in the compiled program (MEASURED: capping LB1 at
+    # N/4 was a net LOSS, -8% vs +17% step-temp — the spill branch's
+    # dense pipeline and the kernel outputs are live across the cond
+    # boundary, so buffer assignment cannot overlay them).
+    if lb_kind == 2:
+        W = max(N // 4, 128)
+        narrow = W < N
+        if not narrow:
+            W = N
+    else:
+        W = N
+        narrow = False
+    # survivors-only frames as narrow as their consumers allow: the
+    # bound row only feeds the LB1 telemetry histogram (the LB2 tail
+    # re-bounds survivors with the pair sweeps), and the LB1 caux
+    # block can ride the pool's own narrow aux dtype — every output
+    # byte of the kernel is the fused route's whole HBM footprint
+    kch, kaux, kbnd, ksched, n_surv, khist = pallas_fused.fused_expand(
+        tables, p_prmu, p_depth, p_aux, n, best, lb_kind=1, tile=TB,
+        cap_width=W, with_sched=(route == "prefilter"),
+        tele_bins=tele.BOUND_BINS if TELE else 0,
+        with_bounds=(lb_kind != 2 and TELE),
+        aux_i16=(lb_kind != 2 and state.aux.dtype == jnp.int16),
+        interpret=(mode == "interpret"))
+    if limit is None:
+        limit = row_limit(capacity, B, J)
+
+    def dense_masks():
+        """The unfused routes' mask family (_child_masks — the same
+        ops step() traces) — built ONLY inside the rare spill
+        branches."""
+        depth_c, mask = _child_masks(p_depth, valid, G, J, TB)
+        is_leaf = ((depth_c + 1) == J) & mask
+        return depth_c, mask, is_leaf
+
+    def narrow_to_W(a, rows):
+        """The kernel block at frame width W. The kernel's frame is
+        always WPAD = W + store_sub(J*tile): the count-gated tail
+        stores carry one sub-block of slack past the survivor cap, so
+        every fused step pays this slice — a copy of each output at
+        width W. That cost is priced in (the measured HBM wins
+        include it); store_sub exists precisely to keep the slack —
+        and therefore this copy's source frame — one ~N/8 sub-block
+        instead of a whole tile. Clamping the kernel's final stores
+        to land the frame at exactly W would retire the copy; that is
+        hardware-round work (the cursor stores are being relowered
+        through Mosaic anyway, ROADMAP item 4)."""
+        if a.shape[1] == W:
+            return a
+        return jax.lax.slice(a, (0, 0), (rows, W))
+
+    if lb_kind != 2:
+        def fused_fit(_):
+            children = narrow_to_W(kch, J)
+            caux = narrow_to_W(kaux, M + 1)
+            child_depth = caux[M].astype(jnp.int16)
+            prmu, depth, aux = _write_block(
+                state, children, child_depth, caux, start, n_surv,
+                limit)
+            out = (prmu, depth, aux, n_surv)
+            if TELE:
+                bnd = narrow_to_W(kbnd, 1)
+                livem = jnp.arange(W) < n_surv
+                pb = tele.depth_bucket(
+                    caux[M].astype(jnp.int32).reshape(-1) - 1, J)
+                out += (jnp.concatenate(
+                    [tele.bucket_counts(pb, livem),
+                     tele.bound_hist(bnd, livem, best)]),)
+            return out
+
+        # LB1 runs uncapped (W == N, see the cap comment above):
+        # n_surv can never exceed the frame, so there is no spill
+        # branch to trace — only the LB2 route carries one
+        outs = fused_fit(0)
+        prmu, depth, aux, n_push = outs[:4]
+        delta = None
+        if TELE:
+            DB = tele.DEPTH_BUCKETS
+            bh = outs[4]
+            delta = tele.step_delta(popped_b, bh[:DB],
+                                    evalnl_b - bh[:DB],
+                                    khist, bh[DB:])
+        return _commit(state, prmu, depth, aux, n_push, best, sol,
+                       jnp.asarray(evals_cnt), limit, start,
+                       tele_delta=delta)
+
+    # --- route == "prefilter": the kernel was the fused LB1 prefilter
+    P = int(tables.ma0.shape[0])
+    KH = batched.PAIR_PREFILTER
+    SW = pallas_expand.sched_words(J)
+    debug_tap = bool(__debug__ and P > KH and _DEBUG_STEP)
+    ncand = n_surv
+
+    def fused_tail(_):
+        children = narrow_to_W(kch, J)
+        caux = narrow_to_W(kaux, M + 1)
+        sched = narrow_to_W(ksched, SW)
+        return _lb2_tail(tables, state, children, caux, sched, ncand,
+                         W, N, best, start, limit, debug_tap, TELE)
+
+    def spill_tail(_):
+        lb1b = pallas_expand.expand_bounds(
+            tables, p_prmu, p_depth, p_aux, lb_kind=1, tile=TB)
+        _, mask, is_leaf = dense_masks()
+        cand = (mask & ~is_leaf & (lb1b < best)).reshape(-1)
+        perm1 = _partition(cand)
+        children, caux, sched = _compact_from_parents(
+            tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
+            with_sched=True, two_phase=True, cap=N)
+        return _lb2_tail(tables, state, children, caux, sched, ncand,
+                         N, N, best, start, limit, debug_tap, TELE)
+
+    if narrow:
+        outs = jax.lax.cond(ncand <= W, fused_tail, spill_tail, 0)
+    else:
+        outs = fused_tail(0)
+    prmu, depth, aux, n_push, hsum, tsum = outs[:6]
+    if debug_tap:
+        state = state._replace(sent=hsum, recv=tsum,
+                               steals=n_push.astype(jnp.int64))
+    delta = None
+    if TELE:
+        DB, BB = tele.DEPTH_BUCKETS, tele.BOUND_BINS
+        branched_b = outs[6][:DB]
+        delta = tele.step_delta(
+            popped_b, branched_b, evalnl_b - branched_b,
+            khist + outs[6][DB:DB + BB], outs[6][DB + BB:])
+    return _commit(state, prmu, depth, aux, n_push, best, sol,
+                   jnp.asarray(evals_cnt), limit, start,
+                   tele_delta=delta)
+
+
 def step(tables: BoundTables, lb_kind: int, chunk: int,
          state: SearchState, tile: int = 1024,
-         limit: int | None = None) -> SearchState:
+         limit: int | None = None, fused: str = "off") -> SearchState:
     """One pop->bound->prune->branch cycle (the compiled analogue of the
     reference per-thread hot loop, pfsp_multigpu_cuda.c:221-320).
 
@@ -575,13 +1003,21 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
     # write below.
     p_aux = p_aux.astype(jnp.int32)
 
-    # --- masks in the kernel's child-slot column order
-    depth_c = _col_major(p_depth, G, J, TB)                    # (1, N)
-    valid_c = _col_major(valid[None, :], G, J, TB)
-    slot_c = jnp.broadcast_to(
-        jnp.arange(J, dtype=jnp.int32)[None, :, None], (G, J, TB)
-    ).reshape(1, N)
-    mask = (slot_c >= depth_c) & valid_c
+    # --- fused bound+prune+compact route (ops/pallas_fused): STATIC
+    # gate — `fused` is a static argument threaded from the host-side
+    # mode resolution (never an env read at trace time), and fused_ok
+    # applies the same expand-kernel shape rule as the unfused
+    # dispatch. LB2's dense (few-pair) route and LB1_d stay unfused.
+    if (fused != "off"
+            and pallas_fused.fused_ok(fused, J, TB, lb_kind, M)
+            and (lb_kind == 1 or route == "prefilter")):
+        return _fused_step(tables, lb_kind, route, B, TB, state,
+                           p_prmu, p_depth, p_aux, n, start, valid,
+                           limit, fused)
+
+    # --- masks in the kernel's child-slot column order (shared with
+    # the fused spill branches — _child_masks)
+    depth_c, mask = _child_masks(p_depth, valid, G, J, TB)     # (1, N)
 
     # --- search telemetry (STATIC Python branch: with the block off the
     # traced program contains zero telemetry ops). Common inputs shared
@@ -674,83 +1110,14 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                 lb1b, (mask & ~is_leaf).reshape(-1) & ~cand, best)
 
         perm1 = _partition(cand)
-        SW = pallas_expand.sched_words(J)
         debug_tap = bool(__debug__ and P > KH and _DEBUG_STEP)
         if limit is None:
             limit = row_limit(capacity, B, J)
 
-        def sweep_tiers(tbl, cf_cols, sched_cols, count):
-            """Pair sweep over the smallest prefix tier covering `count`
-            live columns; columns past the tier read I32_MAX. Finer
-            ladder than the compaction's (its branches carry only a
-            (1, frame) row, so extra rungs are nearly free) with 3/2^k
-            rungs for the same occupancy reason (_compact_tiers). When
-            the sweep runs as the pallas kernel, each rung must satisfy
-            its tile rule (lb2_tile — lane alignment AND the scoped-VMEM
-            model) or lb2_bounds would silently take its XLA fallback
-            there; when the class is outside the pair kernel anyway
-            (lb2_kernel_fits false — the J>64 classes), the XLA scan
-            has no tile constraint and every rung is admitted, keeping
-            the swept prefix snug around small survivor sets."""
-            PT = int(tbl.ma0.shape[0])
-            frame = cf_cols.shape[1]
-            on_tpu = jax.default_backend() == "tpu"
-
-            def rung_ok(t):
-                # a rung is admitted when the sweep at that width runs
-                # a pallas kernel — lb2_sweep_tile is THE shared
-                # dispatch predicate (register kernel or streaming
-                # big-J), so admission cannot diverge from lb2_bounds.
-                # On CPU every rung is fine (the XLA scan has no tile
-                # rule).
-                return (not on_tpu
-                        or pallas_expand.lb2_sweep_tile(J, PT, M, t) > 0)
-
-            # finer than the compaction ladder (rungs here carry only a
-            # (1, frame) row): the tail sweep's survivor count sits
-            # wherever the head prune left it, and a coarse ladder
-            # over-sweeps it by up to 50% (nkeep~43k rode the 61440
-            # rung — measured, 166 pairs x 18k wasted columns/step)
-            tiers = [t for t in (k * N // 64 for k in
-                                 (1, 2, 3, 4, 5, 6, 8, 10, 12, 14, 16,
-                                  20, 24, 32))
-                     if 0 < t < frame and rung_ok(t)]
-            if on_tpu and not rung_ok(frame):
-                # the frame rung is appended unconditionally (it must
-                # cover every count), but if it misses the tile rule
-                # lb2_bounds takes its XLA fallback there — on the
-                # WIDEST (most expensive) rung. Loud, not silent.
-                import warnings
-                warnings.warn(
-                    f"lb2 sweep frame rung {frame} (J={J}, P={PT}) fails "
-                    "the pallas tile rule; the widest sweep tier will run "
-                    "the XLA scan fallback", stacklevel=2)
-            tiers.append(frame)
-
-            def prefix(width):
-                def f(_):
-                    b = pallas_expand.lb2_bounds(
-                        tbl, cf_cols[:, :width], sched_cols[:, :width])
-                    if width < frame:
-                        b = jnp.concatenate(
-                            [b, jnp.full((1, frame - width), I32_MAX,
-                                         jnp.int32)], axis=1)
-                    return b
-                return f
-
-            return _tier_switch(tiers, count, prefix)
-
-        def take_block(*rows_arrays):
-            """prefix-gather closure over the given (rows, frame)
-            arrays."""
-            def take(idx):
-                idx = jax.lax.optimization_barrier(idx)
-                out = tuple(jnp.take(a, idx, axis=1) for a in rows_arrays)
-                return jax.lax.optimization_barrier(out)
-            return take
-
         def tail_pipeline(W_):
-            """Everything after the LB1 prune, in W_-wide frames.
+            """Everything after the LB1 prune, in W_-wide frames
+            (_lb2_tail — shared with the fused route so the two cannot
+            drift).
 
             Run twice as the two branches of ONE lax.cond: the steady
             branch at W_ = N//4 (taken whenever ncand fits, ~93% of
@@ -770,123 +1137,9 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                 children, caux, sched = _compact_from_parents(
                     tables, p_prmu, p_depth, p_aux, perm1, ncand, TB, N,
                     with_sched=True, two_phase=True, cap=W_)
-
-                if P <= KH:
-                    # Few pairs but outside the dense route (the wide
-                    # few-pair classes, e.g. 100x5: the pallas pair
-                    # kernel is gated off past J=64): no prefilter tail
-                    # exists — pair_split would return an empty tail
-                    # table whose (0, frame) pair-max has no identity —
-                    # so ONE full sweep over the LB1 survivors is the
-                    # whole LB2.
-                    lb2b = sweep_tiers(tables, caux[:M], sched, ncand)
-                    live = ncand
-                    if TELE:
-                        head_hp = jnp.zeros(tele.BOUND_BINS, jnp.int64)
-                else:
-                    # Strong-pair prefilter (the reference's
-                    # unimplemented LB2_LEARN, c_bound_johnson.h:29):
-                    # sweep only the PAIR_PREFILTER strongest pairs
-                    # (tables store pairs strongest-first), prune on
-                    # that partial max (partial max <= LB2, so pruning
-                    # on it is sound), and pay for the remaining pairs
-                    # only on the children the prefix failed to prune
-                    # (<10% on the 20x20 class). The total bound stays
-                    # exactly max(head, tail) = full LB2, so explored
-                    # trees are bit-identical to the single-sweep path.
-                    head_t, tail_t = batched.pair_split(tables, KH)
-                    lb2h = sweep_tiers(head_t, caux[:M], sched, ncand)
-                    keep = ((jnp.arange(W_) < ncand)
-                            & (lb2h.reshape(-1) < best))
-                    if TELE:
-                        # pruned by the strong-pair head sweep: binned
-                        # at the partial bound that pruned them (a
-                        # sound lower bound — partial max <= LB2)
-                        head_hp = tele.bound_hist(
-                            lb2h, (jnp.arange(W_) < ncand) & ~keep,
-                            best)
-                    nkeep = keep.sum(dtype=jnp.int32)
-                    permh = _partition_prefix(keep, ncand, N,
-                                              two_phase=True, cap=W_)
-                    # the partial bound rides the compaction as an
-                    # extra row (three structural variants were tried
-                    # and measured WORSE: an index-composed final
-                    # gather that skips re-gathering children — the
-                    # composing (N,) take lowers to a ~4.7 ms
-                    # serialized gather; one combined i32 block per
-                    # compaction — +60% gather time, byte-bound at 40+
-                    # rows; and gathering these blocks in the pool's
-                    # int16 aux dtype — TPU column gathers are
-                    # element/latency-bound, i16 made them SLOWER
-                    # (+18%), so the narrow dtype lives only at the
-                    # pool boundary, see step())
-                    aux_plus = jnp.concatenate([caux, sched, lb2h],
-                                               axis=0)
-                    children, aux_plus = _tiered_compact(
-                        take_block(children, aux_plus), permh, nkeep, N,
-                        two_phase=True, cap=W_)
-                    # barrier: the tail sweep's pallas call must see
-                    # the mid-compaction's switch outputs materialized
-                    # — without this, XLA's fusion of the slice chain
-                    # miscompiles the compiled (jitted) step on TPU and
-                    # the tail sweep reads stale columns, silently
-                    # over-pruning (eager and debug-tapped traces are
-                    # correct — caught by
-                    # test_prefilter_branch_matches_oracle on hardware)
-                    aux_plus = jax.lax.optimization_barrier(aux_plus)
-                    caux = aux_plus[:M + 1]
-                    sched = aux_plus[M + 1:M + 1 + SW]
-                    lb2h_c = aux_plus[M + 1 + SW:M + 2 + SW]
-                    lb2t = sweep_tiers(tail_t, caux[:M], sched, nkeep)
-                    lb2b = jnp.maximum(lb2h_c, lb2t)
-                    live = nkeep
-
-                push = ((jnp.arange(W_) < live)
-                        & (lb2b.reshape(-1) < best))
-                n_push = push.sum(dtype=jnp.int32)
-                if TELE:
-                    # branched buckets + bound histograms, computed
-                    # while caux still aligns column-for-column with
-                    # push/lb2b (the final compaction reorders)
-                    pb = tele.depth_bucket(
-                        caux[M].astype(jnp.int32).reshape(-1) - 1, J)
-                    live_m = jnp.arange(W_) < live
-                    tele_tail = jnp.concatenate([
-                        tele.bucket_counts(pb, push),
-                        head_hp + tele.bound_hist(
-                            lb2b, live_m & ~push, best),
-                        tele.bound_hist(lb2b, push, best)])
-                if debug_tap:
-                    # smuggle intermediates out via the balance counters
-                    lv = jnp.arange(W_) < live
-                    hsum = jnp.where(lv, lb2h_c.reshape(-1),
-                                     0).sum(dtype=jnp.int64)
-                    tsum = jnp.where(lv, lb2t.reshape(-1),
-                                     0).sum(dtype=jnp.int64)
-                else:
-                    hsum = tsum = jnp.int64(0)
-
-                # final compaction: direct prefix gather of the
-                # already-built block (sources are the compacted
-                # (features, W_) arrays)
-                perm2 = _partition_prefix(push, live, N, two_phase=True,
-                                          cap=W_)
-                children, child_aux = _tiered_compact(
-                    take_block(children, caux), perm2, n_push, N,
-                    two_phase=True, cap=W_)
-                child_depth = child_aux[M].astype(jnp.int16)
-
-                # pool write inside the branch: the written block is
-                # W_-wide, so the steady branch moves a quarter of the
-                # bytes (_write_block owns the overflow scratch-margin
-                # routing, shared with the common path)
-                prmu, depth, aux = _write_block(
-                    state, children, child_depth, child_aux, start,
-                    n_push, limit)
-                out = (prmu, depth, aux, n_push, hsum, tsum)
-                if TELE:
-                    out += (tele_tail,)
-                return out
+                return _lb2_tail(tables, state, children, caux, sched,
+                                 ncand, W_, N, best, start, limit,
+                                 debug_tap, TELE)
             return f
 
         # N/4 cap: ncand hovers just under it on the 20x20 class
@@ -975,25 +1228,30 @@ def step(tables: BoundTables, lb_kind: int, chunk: int,
                    limit, start, tele_delta=delta)
 
 
-@functools.partial(jax.jit, static_argnames=("lb_kind", "chunk", "tile"))
+@functools.partial(jax.jit,
+                   static_argnames=("lb_kind", "chunk", "tile", "fused"))
 def _run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
          max_iters: jax.Array, drain_min: jax.Array,
-         tile: int = 1024) -> SearchState:
+         tile: int = 1024, fused: str = "off") -> SearchState:
     def cond(s: SearchState):
         return (s.size >= drain_min) & ~s.overflow & (s.iters < max_iters)
 
-    body = functools.partial(step, tables, lb_kind, chunk, tile=tile)
+    body = functools.partial(step, tables, lb_kind, chunk, tile=tile,
+                             fused=fused)
     return jax.lax.while_loop(cond, lambda s: body(state=s), state)
 
 
 def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
         max_iters: int | None = None, tile: int = 1024,
-        drain_min: int = 1) -> SearchState:
+        drain_min: int = 1, fused=None) -> SearchState:
     """Run the search to exhaustion (or up to a cumulative `max_iters`) in
     one compiled loop (the analogue of pfsp_c.c:55-63's while(1)
     pop+decompose). `max_iters` is a traced scalar, NOT a static argument:
     segmented drivers pass a new ceiling every segment and must hit the
-    compile cache."""
+    compile cache. `fused` (None = the TTS_FUSED env resolution,
+    ops/pallas_fused.resolve_mode) is resolved HERE, host-side, and rides
+    the jit key as a static mode string — flipping the knob retraces
+    instead of reusing a stale executable."""
     jobs, capacity = state.prmu.shape[-2:]
     if int(np.asarray(state.size).max()) > row_limit(capacity, chunk, jobs):
         # Pool already fuller than the usable limit (e.g. capacity < the
@@ -1004,7 +1262,8 @@ def run(tables: BoundTables, state: SearchState, lb_kind: int, chunk: int,
                else max_iters)
     return _run(tables, state, lb_kind, chunk,
                 jnp.asarray(ceiling, dtype=state.iters.dtype),
-                jnp.asarray(max(drain_min, 1), dtype=jnp.int32), tile=tile)
+                jnp.asarray(max(drain_min, 1), dtype=jnp.int32), tile=tile,
+                fused=pallas_fused.resolve_mode(fused))
 
 
 def generic_step(problem, tables, lb_kind: int, chunk: int,
@@ -1126,24 +1385,29 @@ def generic_step(problem, tables, lb_kind: int, chunk: int,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("problem", "lb_kind", "chunk", "tile"))
+                   static_argnames=("problem", "lb_kind", "chunk", "tile",
+                                    "fused"))
 def _run_problem(tables, state: SearchState, problem, lb_kind: int,
                  chunk: int, max_iters: jax.Array, drain_min: jax.Array,
-                 tile: int = 1024) -> SearchState:
+                 tile: int = 1024, fused: str = "off") -> SearchState:
     def cond(s: SearchState):
         return (s.size >= drain_min) & ~s.overflow & (s.iters < max_iters)
 
-    body = problem.make_step(tables, lb_kind, chunk, tile, None)
+    body = problem.make_step(tables, lb_kind, chunk, tile, None,
+                             fused=fused)
     return jax.lax.while_loop(cond, lambda s: body(s), state)
 
 
 def run_problem(problem, tables, state: SearchState, lb_kind: int,
                 chunk: int, max_iters: int | None = None,
-                tile: int = 1024, drain_min: int = 1) -> SearchState:
+                tile: int = 1024, drain_min: int = 1,
+                fused=None) -> SearchState:
     """Problem-generic `run`: the plugin's step (fast-path hook or
     generic_step) to exhaustion in one compiled loop. `max_iters` is a
     traced scalar like run()'s — segmented drivers hit the compile
-    cache across ceilings."""
+    cache across ceilings. `fused` resolves like run()'s (host-side,
+    static on the jit key); plugins without a fused fast path ignore
+    it."""
     jobs, capacity = state.prmu.shape[-2:]
     if int(np.asarray(state.size).max()) > \
             problem.usable_rows(capacity, chunk, jobs):
@@ -1157,7 +1421,7 @@ def run_problem(problem, tables, state: SearchState, lb_kind: int,
     return _run_problem(tables, state, problem, lb_kind, chunk,
                         jnp.asarray(ceiling, dtype=state.iters.dtype),
                         jnp.asarray(max(drain_min, 1), dtype=jnp.int32),
-                        tile=tile)
+                        tile=tile, fused=pallas_fused.resolve_mode(fused))
 
 
 def solve(problem, table: np.ndarray, lb_kind: int | None = None,

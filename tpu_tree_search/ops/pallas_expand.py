@@ -94,6 +94,14 @@ def _bounds_kernel(lb_kind: int, J: int, M: int, TB: int,
 def _expand_math(lb_kind: int, J: int, M: int, TB: int,
                  p_ref, tails_ref, prmu_ref, depth_ref, front_ref,
                  children_ref, aux_ref, bounds_ref):
+    # COUPLED COPY: ops/pallas_fused._fused_kernel re-implements this
+    # math (one-hot child_p, remain matmul, cf chain, prefix-swap emit,
+    # LB1 chain) inline so it can fuse prune+compact behind it — the
+    # ref-write shapes differ too much to share the body today. ANY
+    # change to the math here must be mirrored there; the fused-vs-
+    # unfused bit-parity suite (tests/test_fused.py, the CI `fused`
+    # leg) fails on divergence. Extracting a value-level shared core
+    # is named in ROADMAP item 4's hardware-round follow-ons.
     emit = children_ref is not None
     N = J * TB
     prmu = prmu_ref[:].astype(jnp.int32)          # (J, TB)
@@ -280,6 +288,18 @@ def kernel_ok(jobs: int, eff_tile: int, lb_kind: int,
     over the cap must fall back to XLA rather than compile-OOM."""
     if jax.default_backend() != "tpu":
         return False
+    return kernel_shape_ok(jobs, eff_tile, lb_kind, machines=machines)
+
+
+def kernel_shape_ok(jobs: int, eff_tile: int, lb_kind: int,
+                    machines: int | None = None) -> bool:
+    """The backend-independent SHAPE half of :func:`kernel_ok` — the
+    hardware-validated tile-family rule (including the jobs >= 128
+    eff_tile == 64 admission) plus the lane and scoped-VMEM caps. Split
+    out so the FUSED bound+prune+compact entry points
+    (ops/pallas_fused.fused_ok) enforce the exact same rule on their
+    hardware route: a shape the expand kernel rejects must never reach
+    the fused kernels either (the fused math is the expand math)."""
     lane_cap = MAX_TILE_LANES // 2 if lb_kind == 2 else MAX_TILE_LANES
     return (eff_tile >= min_tile(jobs)
             # lane-aligned reshapes: the kernel's (J, TB) -> (1, J*TB)

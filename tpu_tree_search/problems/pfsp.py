@@ -105,6 +105,7 @@ class PFSPProblem(base.Problem):
     name = "pfsp"
     leaf_in_evals = True
     supports_host_tier = True
+    supports_fused = True
     lb_kinds = (0, 1, 2)
     default_lb = 1
     telemetry_labels = {"objective": "makespan"}
@@ -169,10 +170,10 @@ class PFSPProblem(base.Problem):
             yield child, depth + 1, int(bound), depth + 1 == jobs
 
     def make_step(self, tables, lb_kind: int, chunk: int, tile: int,
-                  limit: int | None):
+                  limit: int | None, fused: str = "off"):
         from ..engine.device import step
         return functools.partial(step, tables, lb_kind, chunk,
-                                 tile=tile, limit=limit)
+                                 tile=tile, limit=limit, fused=fused)
 
 
 PROBLEM = base.register(PFSPProblem())

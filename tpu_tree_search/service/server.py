@@ -696,7 +696,7 @@ class SearchServer:
 
         def add(jobs, machines, lb=1, chunk=chunk_default,
                 capacity=None, p_times=None, balance_period=4,
-                min_seed=32, problem="pfsp"):
+                min_seed=32, problem="pfsp", rung_profile=None):
             k = (problem, jobs, machines, lb, chunk, capacity,
                  balance_period)
             if k in seen:
@@ -706,7 +706,8 @@ class SearchServer:
                            "lb": lb, "chunk": chunk,
                            "capacity": capacity, "p_times": p_times,
                            "balance_period": balance_period,
-                           "min_seed": min_seed, "problem": problem})
+                           "min_seed": min_seed, "problem": problem,
+                           "rung_profile": rung_profile})
 
         for token in (t.strip().lower() for t in spec.split(",")):
             if not token:
@@ -720,6 +721,7 @@ class SearchServer:
                 for req in self._spool_backlog(spool_dir):
                     p = np.asarray(req.p_times)
                     bchunk, bperiod = req.chunk, req.balance_period
+                    bprofile = None
                     if bchunk is None or bperiod is None:
                         # a {"tuned": true} backlog request leaves its
                         # knobs open; warm the values DISPATCH will
@@ -733,6 +735,14 @@ class SearchServer:
                         dflt = tune_defaults.params_for(
                             "serving", p.shape[1], p.shape[0],
                             problem=req.problem)
+                        # dispatch (distributed.search) enters its
+                        # tuner-resolve block whenever EITHER knob is
+                        # open and attaches rung_modes from that same
+                        # cache lookup unconditionally — mirror it
+                        # exactly, or an explicit-chunk request with
+                        # an open balance_period warms profile-less
+                        # keys dispatch never asks for
+                        bprofile = tk.get("rung_profile")
                         if bchunk is None:
                             bchunk = tk.get("chunk", dflt.chunk)
                         if bperiod is None:
@@ -741,7 +751,8 @@ class SearchServer:
                     add(p.shape[1], p.shape[0], lb=req.lb_kind,
                         chunk=bchunk, capacity=req.capacity,
                         p_times=p, balance_period=bperiod,
-                        min_seed=req.min_seed, problem=req.problem)
+                        min_seed=req.min_seed, problem=req.problem,
+                        rung_profile=bprofile)
             elif "x" in token:
                 jobs, _, machines = token.partition("x")
                 add(int(jobs), int(machines))
@@ -771,6 +782,10 @@ class SearchServer:
                 min_seed=shape["min_seed"], mesh=mesh,
                 loop_cache=self.cache,
                 problem=shape.get("problem", "pfsp"),
+                # a tuned entry's rung_modes mask changes the ladder's
+                # rung set and per-rung fused key suffixes — the warm
+                # must build the exact keys a tuned dispatch resolves
+                rung_profile=shape.get("rung_profile"),
                 # the pipelined driver dispatches the donated-pool
                 # variant; warm the one this server will actually run
                 donate=self.overlap)
@@ -827,7 +842,8 @@ class SearchServer:
         if params.source == "default":
             return {}
         return {"chunk": params.chunk,
-                "balance_period": params.balance_period}
+                "balance_period": params.balance_period,
+                "rung_profile": params.rung_modes}
 
     def _spool_backlog(self, spool_dir: str | None) -> list:
         """Parse the unserved request files waiting in the spool (their

@@ -62,6 +62,14 @@ class Params:
     source: str = "default"
     evals_per_s: float | None = None   # the winning probe's rate, when
     #                                    source is cache/probe
+    rung_modes: tuple | None = None    # per-rung kernel-vs-matmul
+    #   profitability mask (source cache/probe only): a tuple of
+    #   {"chunk", "winner": "fused"|"unfused", "ms_per_iter",
+    #   "evals_per_s_fused", "evals_per_s_unfused"} rows for the
+    #   winning chunk's ladder rungs, probed below the static rung
+    #   floor too — engine/ladder.rungs_from_profile admits rungs from
+    #   it (subsuming the static LB2 floor) and ladder.fused_for picks
+    #   each rung's pipeline (ops/pallas_fused vs the matmul path)
 
 
 def shape_class(jobs: int, machines: int, problem: str = "pfsp",

@@ -22,7 +22,6 @@ tool's output).
 from __future__ import annotations
 
 import dataclasses
-import functools
 import time
 
 import numpy as np
@@ -53,6 +52,8 @@ class ProbeResult:
     underfilled: bool       # pool < chunk at window start: the rate is
     #                         a ramp rate, not a steady-state one —
     #                         the tuner deprioritizes these
+    fused: str = "off"      # fused-kernel mode the candidate ran under
+    #                         (ops/pallas_fused: "off"|"hw"|"interpret")
 
     def to_json(self) -> dict:
         return dataclasses.asdict(self)
@@ -63,30 +64,48 @@ class ProbeHarness:
     identical state. Single-device mesh by construction (the same-state
     method needs one canonical pool; the per-worker program cost is
     what the knobs move — spread effects are documented separately in
-    BENCHMARKS.md's sensitivity table)."""
+    BENCHMARKS.md's sensitivity table).
+
+    `problem` (registry name or plugin object, default "pfsp")
+    generalizes the harness to every registered workload: the pool is
+    seeded from the plugin's root/seed_aux, the warm-up and every
+    measured candidate run the plugin's own step pipeline
+    (Problem.make_step — the fast-path hook for PFSP, generic_step for
+    the rest), so TSP/knapsack shapes get MEASURED chunk optima
+    instead of the serving fallback row (ROADMAP item 2c). `table` is
+    the problem's 2-D instance table; the historical ``p_times`` name
+    is kept for the PFSP callers."""
 
     def __init__(self, p_times: np.ndarray, lb_kind: int = 1,
                  init_ub: int | None = None, capacity: int = 1 << 18,
                  warm_chunk: int | None = None, warm_iters: int = 200,
-                 window_iters: int = 24, repeats: int = 2):
+                 window_iters: int = 24, repeats: int = 2,
+                 problem="pfsp"):
         from ..engine import device
-        from ..ops import batched
 
+        if isinstance(problem, str):
+            from .. import problems as problems_pkg
+            problem = problems_pkg.get(problem)
+        self.problem = problem
         self.p_times = np.asarray(p_times)
-        self.jobs = int(self.p_times.shape[1])
-        self.machines = int(self.p_times.shape[0])
+        self.jobs = int(problem.slots(self.p_times))
+        self.machines = int(problem.aux_rows(self.p_times))
         self.lb_kind = int(lb_kind)
         self.capacity = int(capacity)
         self.window_iters = int(window_iters)
         self.repeats = max(1, int(repeats))
-        self.tables = batched.make_tables(self.p_times)
-        self._adt = device.aux_dtype(self.p_times)
+        self.tables = problem.make_tables(self.p_times)
+        self._adt = np.dtype(problem.aux_dtype(self.p_times))
 
         warm_chunk = int(warm_chunk or 64)
-        state = device.init_state(self.jobs, self.capacity, init_ub,
-                                  p_times=self.p_times)
-        state = device.run(self.tables, state, self.lb_kind, warm_chunk,
-                           max_iters=warm_iters)
+        prmu0, depth0 = problem.root(self.p_times)
+        state = device.init_state(
+            self.jobs, self.capacity, init_ub, prmu0=prmu0,
+            depth0=depth0,
+            aux0=problem.seed_aux(self.p_times, prmu0, depth0))
+        state = device.run_problem(problem, self.tables, state,
+                                   self.lb_kind, warm_chunk,
+                                   max_iters=warm_iters, fused="off")
         state.size.block_until_ready()
         if bool(state.overflow) or int(state.size) == 0:
             raise ProbeError(
@@ -105,12 +124,17 @@ class ProbeHarness:
 
     def measure(self, chunk: int, balance_period: int,
                 transfer_cap: int | None = None,
-                min_transfer: int | None = None) -> ProbeResult:
-        """Time one candidate configuration on the warmed state."""
+                min_transfer: int | None = None,
+                fused: str = "off") -> ProbeResult:
+        """Time one candidate configuration on the warmed state.
+        `fused` selects the step pipeline the candidate runs
+        (ops/pallas_fused mode string) — the kernel-vs-matmul
+        profitability probes measure the same rung twice, once per
+        mode, on identical state."""
         import jax
         import jax.numpy as jnp
 
-        from ..engine import device, distributed
+        from ..engine import distributed
         from ..parallel.mesh import worker_mesh
 
         chunk = int(chunk)
@@ -120,7 +144,8 @@ class ProbeHarness:
                 chunk, self.jobs, self.machines, 1,
                 aux_itemsize=self._adt.itemsize)
         min_transfer = int(min_transfer or 2 * chunk)
-        limit = min(device.row_limit(self.capacity, chunk, self.jobs),
+        limit = min(self.problem.usable_rows(self.capacity, chunk,
+                                             self.jobs),
                     self.capacity - transfer_cap)
         if limit < 1:
             raise ProbeError(
@@ -129,8 +154,8 @@ class ProbeHarness:
                 "capacity or drop the candidate")
 
         def mls(t, lim):
-            return functools.partial(device.step, t, self.lb_kind,
-                                     chunk, limit=lim)
+            return self.problem.make_step(t, self.lb_kind, chunk, 1024,
+                                          lim, fused=fused)
 
         loop = distributed.build_dist_loop(
             worker_mesh(1), self.tables, mls, balance_period,
@@ -160,7 +185,7 @@ class ProbeHarness:
             ms_per_iter=round(best / max(iters, 1) * 1e3, 4),
             window_iters=iters, evals=evals, seconds=round(best, 6),
             pool_start=self.pool,
-            underfilled=self.pool < chunk)
+            underfilled=self.pool < chunk, fused=fused)
 
 
 def measure_balance_periods(p_times: np.ndarray, lb_kind: int,

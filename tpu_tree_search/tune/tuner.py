@@ -116,11 +116,32 @@ class Autotuner:
         # A megabatched dispatch (batch > 1) appends a ("batch", B)
         # suffix: solo keys keep their exact persisted layout, and a
         # batched optimum can never be served from — or clobber — the
-        # solo entry of the same shape
+        # solo entry of the same shape. The resolved fused mode joins
+        # the same way (only when ON, and only for problems whose
+        # step HAS a fused pipeline — Problem.supports_fused): the
+        # sweep picks its chunk winner on the probing boot's pipeline
+        # rates, so an optimum probed under TTS_FUSED=1 must never be
+        # replayed by a matmul boot of the same shape (or vice versa)
+        # — each mode probes and persists its own entry, unfused
+        # entries keep their pre-fused identity. A problem without a
+        # fused pipeline measures identical rates either way:
+        # suffixing it would split one optimum across two keys and
+        # re-probe the same sweep at the next boot.
         base = (str(problem), int(jobs), int(machines), int(lb_kind),
                 int(n_workers))
         if batch is not None and int(batch) > 1:
             base = base + ("batch", int(batch))
+        from ..ops import pallas_fused
+        mode = pallas_fused.resolve_mode(None)
+        if mode != "off":
+            from ..problems import get as _get_problem
+            try:
+                fused_capable = getattr(_get_problem(str(problem)),
+                                        "supports_fused", False)
+            except KeyError:
+                fused_capable = False
+            if fused_capable:
+                base = base + ("fused", mode)
         return base
 
     # --------------------------------------------------------- resolve
@@ -134,9 +155,12 @@ class Autotuner:
         """The three-tier lookup. ``allow_probe=False`` is the request
         hot path (cache else defaults — never seconds of probing while
         a client waits); ``allow_probe=True`` is the boot/bench path
-        (cache else probe+persist else defaults). Probing is PFSP-only
-        for now (the probe harness drives the PFSP step); other
-        problems resolve cache-else-defaults.
+        (cache else probe+persist else defaults). The probe harness is
+        problem-generic (tune/probe.ProbeHarness drives the plugin's
+        own step pipeline), so any registered problem probes when a
+        table is supplied; a probe without one is PFSP-only (the
+        synthetic-table fallback is a PFSP generator) and other
+        problems fall through to defaults.
 
         ``batch`` (a megabatch dispatch's instance-axis width) rides
         the cache key and the defaults lookup: batched optima are their
@@ -145,7 +169,7 @@ class Autotuner:
         batched keys resolve cache-else-batched-defaults)."""
         key = self.key(jobs, machines, lb_kind, n_workers, problem,
                        batch=batch)
-        if problem != "pfsp" or (batch is not None and batch > 1):
+        if batch is not None and batch > 1:
             allow_probe = False
         with self._lock:
             memo = self._memo.get(key)
@@ -154,22 +178,25 @@ class Autotuner:
         if self.cache is not None:
             entry = self.cache.load(key)
             if entry is not None:
+                rm = entry.get("rung_modes")
                 params = Params(chunk=int(entry["chunk"]),
                                 balance_period=int(entry["balance_period"]),
                                 transfer_cap=entry.get("transfer_cap"),
                                 source="cache",
-                                evals_per_s=entry.get("evals_per_s"))
+                                evals_per_s=entry.get("evals_per_s"),
+                                rung_modes=tuple(rm) if rm else None)
                 with self._lock:
                     self._memo[key] = params
                 return params
         if allow_probe:
             try:
                 return self.tune(jobs, machines, lb_kind=lb_kind,
-                                 n_workers=n_workers, p_times=p_times)
+                                 n_workers=n_workers, p_times=p_times,
+                                 problem=problem)
             except ProbeError as e:
                 tracelog.event("tuner.probe_failed", jobs=jobs,
                                machines=machines, lb_kind=lb_kind,
-                               error=repr(e))
+                               problem=problem, error=repr(e))
         return defaults.params_for(context, jobs, machines,
                                    problem=problem, batch=batch)
 
@@ -177,16 +204,32 @@ class Autotuner:
 
     def tune(self, jobs: int, machines: int, lb_kind: int = 1,
              n_workers: int = 1,
-             p_times: np.ndarray | None = None) -> Params:
+             p_times: np.ndarray | None = None,
+             problem: str = "pfsp") -> Params:
         """Run the sweep for one shape family and persist the winner.
 
         Only the SHAPE of `p_times` matters (a synthetic table in the
         Taillard value range probes the same compiled program every
         real instance of the class runs); pass a real table to probe
-        on committed traffic. Raises ProbeError when no steady
-        measurement state exists (callers fall back to defaults)."""
-        key = self.key(jobs, machines, lb_kind, n_workers)
+        on committed traffic — REQUIRED for non-PFSP problems (the
+        synthetic fallback is a PFSP generator). Raises ProbeError
+        when no steady measurement state exists (callers fall back to
+        defaults).
+
+        After the chunk/period winner is picked, the winning chunk's
+        LADDER rungs are probed too — each rung once per available
+        step pipeline (fused kernel vs the matmul path,
+        ops/pallas_fused) and BELOW the static rung floor — producing
+        the per-rung profitability mask (`Params.rung_modes`) that
+        engine/ladder consumes for measured rung admission and
+        per-rung fused selection."""
+        key = self.key(jobs, machines, lb_kind, n_workers, problem)
         if p_times is None:
+            if problem != "pfsp":
+                raise ProbeError(
+                    f"probing problem {problem!r} needs its instance "
+                    "table (the synthetic fallback generates PFSP "
+                    "tables only)")
             from ..problems.pfsp import PFSPInstance
             p_times = PFSPInstance.synthetic(jobs=jobs,
                                              machines=machines,
@@ -205,10 +248,32 @@ class Autotuner:
         harness = ProbeHarness(
             p_times, lb_kind=lb_kind, capacity=capacity,
             warm_chunk=min(self.chunks), warm_iters=self.warm_iters,
-            window_iters=self.window_iters, repeats=self.repeats)
+            window_iters=self.window_iters, repeats=self.repeats,
+            problem=problem)
+        # the boot's step pipeline decides what the sweep must
+        # measure: when the fused route resolves on, every candidate
+        # is probed on BOTH pipelines and judged by the better rate —
+        # the chunk winner must be chosen on rates the serving boot
+        # can actually run (the same rule rung admission applies one
+        # level down, ladder._selected_ms), and fused_for will route
+        # the winner chunk to its measured winner pipeline at serve
+        # time. Probes stay PFSP-only (the fused kernels are the PFSP
+        # fast path) and interpret admits every shape; when the hw
+        # route returns (on-chip round), this gate must also consult
+        # pallas_fused.fused_ok per shape so a kernel-rejected shape
+        # never pays fused probes the step would silently run unfused.
+        from ..engine import ladder as _ladder
+        from ..ops import pallas_fused
+        from ..problems import get as _get_problem
+        from ..utils import config as _cfg
+        fused_mode = pallas_fused.resolve_mode(None)
+        probe_fused = (fused_mode != "off" and lb_kind in (1, 2)
+                       and getattr(_get_problem(problem),
+                                   "supports_fused", False))
         with tracelog.span("tuner.sweep", jobs=jobs, machines=machines,
                            lb_kind=lb_kind, n_workers=n_workers) as sp:
             results = []
+            fused_results = {}
             for c in self.chunks:
                 try:
                     results.append(self._probe(
@@ -220,23 +285,44 @@ class Autotuner:
                     tracelog.event("tuner.candidate_dropped", chunk=c,
                                    error=repr(e))
                     continue
+                if probe_fused:
+                    try:
+                        fused_results[c] = self._probe(
+                            harness, c, defaults.BALANCE_PERIOD_DEFAULT,
+                            fused=fused_mode)
+                    except ProbeError as e:
+                        tracelog.event("tuner.candidate_dropped",
+                                       chunk=c, fused=fused_mode,
+                                       error=repr(e))
             if not results:
                 raise ProbeError(
                     f"no chunk candidate of {self.chunks} is "
                     f"measurable at capacity {capacity}")
+
+            def best_rate(r):
+                f = fused_results.get(r.chunk)
+                return max(r.evals_per_s,
+                           f.evals_per_s if f is not None else 0.0)
+
             # steady-state rates outrank ramp rates: an underfilled
             # candidate (pool < chunk at the window start) only wins
             # when every candidate is underfilled
             filled = [r for r in results if not r.underfilled]
-            best_chunk = max(filled or results,
-                             key=lambda r: r.evals_per_s)
-            period_results = [best_chunk]
+            best_chunk = max(filled or results, key=best_rate)
+            # the period sweep runs on the winner chunk's WINNING
+            # pipeline — the one the boot will serve on
+            win_fm, base = "off", best_chunk
+            fbest = fused_results.get(best_chunk.chunk)
+            if fbest is not None \
+                    and fbest.evals_per_s > best_chunk.evals_per_s:
+                win_fm, base = fused_mode, fbest
+            period_results = [base]
             for b in self.periods:
-                if b == best_chunk.balance_period:
+                if b == base.balance_period:
                     continue
                 try:
                     period_results.append(self._probe(
-                        harness, best_chunk.chunk, b))
+                        harness, best_chunk.chunk, b, fused=win_fm))
                 except ProbeError as e:
                     tracelog.event("tuner.candidate_dropped",
                                    balance_period=b, error=repr(e))
@@ -245,7 +331,64 @@ class Autotuner:
             sp.set(chunk=winner.chunk,
                    balance_period=winner.balance_period,
                    evals_per_s=winner.evals_per_s,
-                   probes=len(results) + len(period_results) - 1)
+                   probes=len(results) + len(fused_results)
+                   + len(period_results) - 1)
+
+            # --- per-rung kernel-vs-matmul profitability mask: probe
+            # the winning chunk's LADDER rungs — below the static rung
+            # floor too (min_chunk=1), since measured admission
+            # (engine/ladder.rungs_from_profile) subsumes the floor —
+            # once per available step pipeline on the same warmed
+            # state. The mask persists with the winner and decides
+            # each rung's fused-vs-matmul dispatch at serve time.
+            # Probed only when there is a pipeline CHOICE to record
+            # (the fused route resolves on) or the operator asks
+            # (TTS_TUNE_RUNGS) — each rung is an extra compile, and a
+            # matmul-only boot gains nothing from paying several of
+            # them per shape (ladder admission then uses the static
+            # floors, exactly the pre-mask behavior).
+            rung_modes = []
+            memo = {(r.chunk, r.balance_period, r.fused): r
+                    for r in results + list(fused_results.values())
+                    + period_results}
+            rungs = (_ladder.rungs_for(winner.chunk, min_chunk=1)
+                     if probe_fused or _cfg.env_flag("TTS_TUNE_RUNGS")
+                     else ())
+            for c in rungs:
+                rows = {}
+                for fm in ("off",) + ((fused_mode,) if probe_fused
+                                      else ()):
+                    k = (c, winner.balance_period, fm)
+                    try:
+                        rows[fm] = memo.get(k) or self._probe(
+                            harness, c, winner.balance_period,
+                            fused=fm)
+                    except ProbeError as e:
+                        tracelog.event("tuner.candidate_dropped",
+                                       chunk=c, fused=fm,
+                                       error=repr(e))
+                if "off" not in rows:
+                    continue
+                ru = rows["off"]
+                rf = rows.get(fused_mode) if probe_fused else None
+                win = ("fused" if rf is not None
+                       and rf.evals_per_s > ru.evals_per_s
+                       else "unfused")
+                best_r = rf if win == "fused" else ru
+                rung_modes.append({
+                    "chunk": int(c), "winner": win,
+                    "ms_per_iter": best_r.ms_per_iter,
+                    # per-pipeline rates too: rung ADMISSION must judge
+                    # the pipeline a consuming boot actually runs
+                    # (ladder._selected_ms) — a fused-won rung read by
+                    # a TTS_FUSED=0 boot runs its unfused rate
+                    "ms_per_iter_unfused": ru.ms_per_iter,
+                    "ms_per_iter_fused":
+                        rf.ms_per_iter if rf is not None else None,
+                    "evals_per_s_unfused": ru.evals_per_s,
+                    "evals_per_s_fused":
+                        rf.evals_per_s if rf is not None else None,
+                })
         sweep_s = time.perf_counter() - t0
         if self._probe_h is not None:
             self._probe_h.observe(sweep_s)
@@ -257,22 +400,26 @@ class Autotuner:
             #   1-worker cap would mis-size a production submesh)
             "evals_per_s": winner.evals_per_s,
             "sweep_seconds": round(sweep_s, 3),
+            "rung_modes": rung_modes,
             "probes": [r.to_json()
-                       for r in results + period_results[1:]],
+                       for r in results + list(fused_results.values())
+                       + period_results[1:]],
         }
         if self.cache is not None:
             self.cache.store(key, payload,
                              key_repr="/".join(str(k) for k in key))
         params = Params(chunk=winner.chunk,
                         balance_period=winner.balance_period,
-                        source="probe", evals_per_s=winner.evals_per_s)
+                        source="probe", evals_per_s=winner.evals_per_s,
+                        rung_modes=(tuple(rung_modes) if rung_modes
+                                    else None))
         with self._lock:
             self._memo[key] = params
         return params
 
     def _probe(self, harness: ProbeHarness, chunk: int,
-               balance_period: int):
-        r = harness.measure(chunk, balance_period)
+               balance_period: int, fused: str = "off"):
+        r = harness.measure(chunk, balance_period, fused=fused)
         with self._lock:
             self.probes_run += 1
             self.ledger.append(r.to_json())
