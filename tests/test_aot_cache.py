@@ -236,6 +236,25 @@ def test_corrupt_entry_quarantined_and_recompiled(tmp_path, damage,
     aot3.close()
 
 
+def test_repeat_quarantines_keep_distinct_forensic_copies(tmp_path):
+    """Quarantine targets are per-writer unique AND counter-suffixed:
+    corrupt incarnations of the SAME entry quarantined twice (same
+    process, or N servers racing on shared fleet storage) keep both
+    forensic copies instead of os.replace-ing over each other."""
+    root = tmp_path / "aot"
+    aot = AOTCache(root)
+    key = ("probe", "key")
+    for round_ in range(2):
+        aot.path_for(key).write_bytes(b"\xffnot-an-entry" * 4)
+        assert aot.load(key) is None
+    quarantined = sorted(p.name for p in root.iterdir()
+                         if p.name.endswith(".corrupt"))
+    assert len(quarantined) == 2, quarantined
+    assert len(set(quarantined)) == 2
+    assert aot.snapshot()["quarantined"] == 2
+    aot.close()
+
+
 def test_donated_variant_keyed_separately(tmp_path):
     p = small(0, jobs=8).p_times
     mesh = worker_mesh(4)

@@ -1,0 +1,553 @@
+"""Fleet failover: lease-fenced ownership + peer ledger takeover
+(service/lease.py + service/failover.py + the server adopt path).
+
+The contract, pinned deterministically on the virtual 8-device CPU
+mesh with sub-second lease TTLs:
+
+- **takeover exactness**: a peer adopting a dead server's ledger
+  resumes its in-flight request from the copied checkpoint to the
+  exact standalone totals, budget cumulative across hosts;
+- **fencing**: a stalled-but-alive owner (the ``pause_server`` drill)
+  whose lease expires under it self-fences at its next commit — the
+  request preempts cleanly (never FAILED), the stale ledger takes
+  ZERO records past the fence, and exactly one terminal record exists
+  fleet-wide (split-brain impossible by construction);
+- **observe-only default**: with ``TTS_FAILOVER`` unset the watcher
+  detects and journals peer-down but adopts nothing — the orphan
+  ledger directory stays byte-identical;
+- **lease-file corruption**: quarantined (``*.corrupt``) and
+  re-acquired at a HIGHER epoch than any prior claim;
+- **racing adopters**: two peers adopting one expired lease resolve
+  through the claim-file CAS to exactly one adopter;
+- the epoch ratchet lives in the DATA: replay discards stamped
+  records older than the highest epoch seen, and engine/checkpoint
+  refuses an epoch-stale snapshot overwrite.
+
+The true two-process kill -9 → adopt → fenced-restart drill runs in
+the CI `failover` leg; everything here is in-process so it can pin
+totals bit-exactly.
+"""
+
+import json
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import checkpoint, distributed
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import (SearchRequest, SearchServer,
+                                     TERMINAL_STATES)
+from tpu_tree_search.service import lease as lease_mod
+from tpu_tree_search.service.ledger import LedgerState, RequestLedger
+from tpu_tree_search.service.lease import LeaseKeeper, LeaseLost
+from tpu_tree_search.utils import faults
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+def small(seed, jobs=7):
+    return PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+
+
+@pytest.fixture(scope="module")
+def run_base8():
+    """Standalone 8-worker totals for the slow instance the takeover
+    tests move between servers (1-submesh servers serve at 8)."""
+    inst = small(5, jobs=8)
+    got = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             n_devices=8, **KW)
+    return (got.explored_tree, got.explored_sol, got.best)
+
+
+def totals(rec):
+    res = rec.result
+    return (res.explored_tree, res.explored_sol, res.best)
+
+
+def crash(srv):
+    """Host-death simulation for a FLEET server: the test_ledger crash
+    helper (stop daemons without close() bookkeeping) plus the lease
+    layer — the renewal daemon stops WITHOUT writing `released`, so
+    the lease ages toward expiry exactly as a dead host's would."""
+    if srv.watcher is not None:
+        srv.watcher.close()
+    if srv.lease is not None:
+        srv.lease._stop.set()
+        t = srv.lease._thread
+        if t is not None:
+            t.join(timeout=5.0)
+    srv._closing.set()
+    with srv._lock:
+        for slot in srv.slots:
+            rec = slot.record
+            if rec is not None and rec.stop_reason is None:
+                rec.stop_reason = "shutdown"
+            if slot.stop_event is not None:
+                slot.stop_event.set()
+    if srv._scheduler is not None:
+        srv._scheduler.join()
+    for slot in srv.slots:
+        if slot.thread is not None:
+            slot.thread.join()
+    srv.resources.close()
+    srv.health.close()
+    srv.remediation.close()
+    if srv.aot is not None:
+        srv.aot.close()
+    if srv.ledger is not None:
+        srv.ledger.close()
+
+
+def ledger_records(d):
+    """Every journaled record under a ledger dir, replay order."""
+    out = []
+    for seg in sorted(d.glob("seg-*.jsonl")):
+        for ln in seg.read_bytes().splitlines():
+            if ln.strip():
+                out.append(json.loads(ln)["r"])
+    return out
+
+
+def dir_bytes(d):
+    return {p.name: p.read_bytes() for p in sorted(d.iterdir())
+            if p.is_file()}
+
+
+def wait_until(cond, timeout=120.0, every=0.02, msg="condition"):
+    t0 = time.monotonic()
+    while not cond():
+        assert time.monotonic() - t0 < timeout, f"timeout: {msg}"
+        time.sleep(every)
+
+
+# ----------------------------------------------------- pure lease/ledger
+
+
+def test_lease_acquire_renew_fence_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.4")
+    d = tmp_path / "led"
+    d.mkdir()
+    k1 = LeaseKeeper(d)
+    k1.acquire()
+    assert k1.epoch == 1
+    info = lease_mod.read_lease(d)
+    assert info.epoch == 1 and not info.expired()
+    # renewals keep it live well past the TTL
+    time.sleep(1.0)
+    assert not lease_mod.read_lease(d).expired()
+    assert k1.renewals >= 1
+    # an adopter bumps the epoch -> the owner's next check fences it
+    k2 = LeaseKeeper(d)
+    assert k2.takeover(current_epoch=1)
+    with pytest.raises(LeaseLost):
+        k1.renew()
+    assert k1.fenced
+    with pytest.raises(LeaseLost):
+        k1.check()
+    # a fenced keeper's release leaves the adopter's file alone
+    k1.release()
+    assert lease_mod.read_lease(d).epoch == 2
+    k2._stop.set()
+
+
+def test_lease_corruption_quarantined_and_reacquired(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "5.0")
+    d = tmp_path / "led"
+    d.mkdir()
+    k1 = LeaseKeeper(d)
+    k1.acquire()
+    assert k1.epoch == 1
+    k1._stop.set()          # freeze renewals so the corruption sticks
+    if k1._thread is not None:
+        k1._thread.join(timeout=5.0)
+    (d / "lease.json").write_bytes(b"\x00garbled not-json\xff")
+    # the corrupt file is quarantined and treated as absent
+    assert lease_mod.read_lease(d) is None
+    corrupt = [p.name for p in d.iterdir()
+               if p.name.endswith(".corrupt")]
+    assert corrupt == ["lease.json.corrupt"]
+    # re-acquisition bids ABOVE every surviving claim file: the fresh
+    # epoch is strictly higher than the lost one, so fencing can never
+    # regress through a corruption
+    k2 = LeaseKeeper(d)
+    k2.acquire()
+    assert k2.epoch == 2
+    k2.release()
+    assert lease_mod.read_lease(d).released
+
+
+def test_epoch_ratchet_discards_stale_records():
+    """The fence is in the DATA: replay drops stamped records older
+    than the highest epoch seen, wherever they land in the file."""
+    st = LedgerState()
+    st.apply({"k": "admit", "rid": "r1", "tag": "t1", "seq": 0,
+              "payload": {}, "spent_s": 0.0, "e": 1})
+    st.apply({"k": "takeover", "owner": "peer", "from_epoch": 1,
+              "e": 2})
+    # a stale owner's append slipping in after the takeover: discarded
+    st.apply({"k": "budget", "rid": "r1", "spent_s": 99.0, "e": 1})
+    assert st.epoch == 2 and st.takeovers == 1
+    assert st.fenced_discards == 1
+    assert st.requests["r1"]["spent_s"] == 0.0
+    # unstamped records (pre-fleet ledgers) are never discarded
+    st.apply({"k": "budget", "rid": "r1", "spent_s": 3.0})
+    assert st.requests["r1"]["spent_s"] == 3.0
+
+
+def test_fenced_ledger_refuses_appends(tmp_path, monkeypatch):
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.4")
+    d = tmp_path / "led"
+    k = LeaseKeeper(d)
+    d.mkdir()
+    k.acquire()
+    fences = []
+    led = RequestLedger(d, lease=k, on_fenced=fences.append)
+    led.journal("boot", pid=1)
+    recs = ledger_records(d)
+    assert recs and all(r["e"] == 1 for r in recs)   # epoch-stamped
+    # an adopter takes the lease away
+    k2 = LeaseKeeper(d)
+    assert k2.takeover(current_epoch=1)
+    k._renewed_mono -= 10.0      # force check() to revalidate
+    before = ledger_records(d)
+    led.journal("admit", rid="r9", tag="t9", seq=9, payload={},
+                spent_s=0.0)
+    assert led.fenced and fences        # fence fired, callback ran
+    led.journal("terminal", rid="r9", state="DONE", snapshot={})
+    # ZERO records landed past the fence — split-brain-safe by
+    # construction, not by timing
+    assert ledger_records(d) == before
+    led.close()
+    k2.release()
+
+
+def test_checkpoint_refuses_epoch_stale_overwrite(tmp_path):
+    """engine/checkpoint's half of the fence: a save stamped with an
+    older lease epoch than the on-disk snapshot raises instead of
+    clobbering; newer/equal epochs and unstamped saves land."""
+    path = tmp_path / "t.ckpt.npz"
+    arrays = {"x": np.arange(4), "meta_lease_epoch": np.asarray(2)}
+    checkpoint._write_snapshot(path, dict(arrays))
+    with pytest.raises(checkpoint.StaleCheckpointError):
+        checkpoint._write_snapshot(
+            path, {"x": np.arange(4), "meta_lease_epoch": np.asarray(1)})
+    checkpoint._write_snapshot(
+        path, {"x": np.arange(4), "meta_lease_epoch": np.asarray(3)})
+    # unstamped (non-fleet) saves never pay the peek and never refuse
+    checkpoint._write_snapshot(path, {"x": np.arange(4)})
+    assert not issubclass(checkpoint.StaleCheckpointError,
+                          tuple(checkpoint.TRANSIENT_ERRORS))
+
+
+def test_pause_server_drill_parses():
+    p = faults.FaultPlan.parse("pause_server=2:12")
+    assert p.pause_server == (2, 12.0, None)
+    p = faults.FaultPlan.parse("pause_server=1@3")
+    assert p.pause_server == (1, 5.0, 3)
+
+
+# --------------------------------------------------- server + takeover
+
+
+def test_takeover_resumes_bit_identical_and_fences_stale_restart(
+        run_base8, tmp_path, monkeypatch):
+    """The tentpole end-to-end, in-process: A dies mid-solve, B adopts
+    A's ledger after the TTL and completes the request to the exact
+    standalone totals with the budget cumulative across hosts; a
+    restarted A finds the adopter's LIVE lease, boots fenced and
+    commits nothing."""
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.8")
+    fleet = tmp_path / "fleet"
+    a_dir, b_dir = fleet / "a", fleet / "b"
+    inst = small(5, jobs=8)
+    srv_a = SearchServer(n_submeshes=1, ledger_dir=str(a_dir),
+                         fleet_dir=str(fleet))
+    assert srv_a.lease is not None and srv_a.lease.epoch == 1
+    rid = srv_a.submit(SearchRequest(
+        p_times=inst.p_times, lb_kind=1, tag="move1",
+        segment_iters=8, checkpoint_every=1,
+        faults="delay_every=0.15", **KW))
+    wait_until(lambda: (srv_a.status(rid)["progress"].get("segment", 0)
+                        >= 2
+                        or srv_a.status(rid)["state"] in TERMINAL_STATES),
+               msg="segment 2 on A")
+    assert srv_a.status(rid)["state"] == "RUNNING"
+    crash(srv_a)
+    spent_at_crash = srv_a.records[rid].spent_s()
+    assert spent_at_crash > 0
+
+    srv_b = SearchServer(n_submeshes=1, ledger_dir=str(b_dir),
+                         fleet_dir=str(fleet), failover=True)
+    try:
+        wait_until(lambda: srv_b.watcher.takeovers >= 1, timeout=60,
+                   msg="B adopts A")
+        with srv_b._lock:
+            rid_b = next(r.id for r in srv_b.records.values()
+                         if r.request.tag == "move1")
+        out = srv_b.result(rid_b, timeout=300)
+        assert out.state == "DONE", (out.state, out.error)
+        assert totals(out) == run_base8          # bit-identical
+        assert out.spent_s() >= spent_at_crash   # budget survived hosts
+        assert out.request.faults is None        # drill did NOT follow
+        snap = srv_b.status_snapshot()
+        json.dumps(snap)
+        assert snap["failover"]["takeovers"] == 1
+        assert snap["failover"]["mode"] == "act"
+
+        # the orphan ledger: epoch ratcheted to the adopter's, the
+        # moved request tombstoned, zero stale discards (A never wrote
+        # past the fence)
+        recs = ledger_records(a_dir)
+        assert any(r["k"] == "takeover" and r["e"] == 2 for r in recs)
+        assert any(r["k"] == "forget" and r["rid"] == rid for r in recs)
+
+        # the stale owner restarts: the adopter still renews A's
+        # lease, so A boots FENCED — no boot record, no replay, no
+        # commits, and admission refuses with the typed error
+        before = dir_bytes(a_dir)
+        srv_a2 = SearchServer(n_submeshes=1, ledger_dir=str(a_dir),
+                              fleet_dir=str(fleet))
+        try:
+            assert srv_a2.fenced and srv_a2.ledger is None
+            assert srv_a2.watcher is None
+            with pytest.raises(LeaseLost):
+                srv_a2.submit(SearchRequest(p_times=inst.p_times,
+                                            lb_kind=1, **KW))
+            snap2 = srv_a2.status_snapshot()
+            assert snap2["failover"]["fenced"] is True
+        finally:
+            srv_a2.close()
+        after = dir_bytes(a_dir)
+        after.pop("lease.json", None)    # the ADOPTER keeps renewing it
+        before.pop("lease.json", None)
+        assert after == before           # zero commits, byte-for-byte
+    finally:
+        srv_b.close()
+    # the survivor's close releases the adopted lease too
+    assert lease_mod.read_lease(a_dir).released
+    assert lease_mod.read_lease(b_dir).released
+
+
+def test_pause_server_split_brain_exactly_one_terminal(
+        run_base8, tmp_path, monkeypatch):
+    """Split-brain drill: A stalls alive (pause_server suspends its
+    renewals mid-request), its lease expires, B adopts and solves; A
+    wakes, SELF-FENCES at its next commit — the request preempts
+    cleanly (never FAILED), A's ledger takes zero post-fence records,
+    and exactly one terminal record exists fleet-wide."""
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.6")
+    fleet = tmp_path / "fleet"
+    a_dir, b_dir = fleet / "a", fleet / "b"
+    inst = small(5, jobs=8)
+    srv_a = SearchServer(n_submeshes=1, ledger_dir=str(a_dir),
+                         fleet_dir=str(fleet))
+    # at segment 3, once: freeze A's lease renewals AND wedge the
+    # executor 6s (10x TTL — wide enough for B to boot and adopt
+    # INSIDE the pause even on a loaded CI box) — the GC-pause shape
+    rid_a = srv_a.submit(SearchRequest(
+        p_times=inst.p_times, lb_kind=1, tag="split1",
+        segment_iters=8, checkpoint_every=1,
+        faults="delay_every=0.1,pause_server=3:6", **KW))
+    try:
+        wait_until(lambda: lease_mod.read_lease(a_dir).expired(),
+                   timeout=120, msg="A's lease expires mid-pause")
+        srv_b = SearchServer(n_submeshes=1, ledger_dir=str(b_dir),
+                             fleet_dir=str(fleet), failover=True)
+        try:
+            wait_until(lambda: srv_b.watcher.takeovers >= 1,
+                       timeout=60, msg="B adopts mid-pause")
+            # A wakes and must fence itself — request preempted, not
+            # failed, and the server stops scheduling
+            wait_until(lambda: srv_a.fenced, timeout=60,
+                       msg="A self-fences on waking")
+            wait_until(lambda: srv_a.status(rid_a)["state"]
+                       != "RUNNING", timeout=60, msg="A's slot clears")
+            assert srv_a.status(rid_a)["state"] == "PREEMPTED"
+            with srv_b._lock:
+                rid_b = next(r.id for r in srv_b.records.values()
+                             if r.request.tag == "split1")
+            out = srv_b.result(rid_b, timeout=300)
+            assert out.state == "DONE", (out.state, out.error)
+            assert totals(out) == run_base8
+            # exactly ONE terminal fleet-wide; A's ledger has none
+            terms_a = [r for r in ledger_records(a_dir)
+                       if r["k"] == "terminal"]
+            terms_b = [r for r in ledger_records(b_dir)
+                       if r["k"] == "terminal"]
+            assert terms_a == []
+            assert [r["rid"] for r in terms_b] == [rid_b]
+            # A's post-takeover appends: none landed (the fence is in
+            # the write path, so replay sees zero stale discards)
+            led = RequestLedger(a_dir)
+            assert led.state.epoch == 2
+            assert led.state.fenced_discards == 0
+            assert rid_a not in led.state.requests   # tombstoned
+            led.close()
+        finally:
+            srv_b.close()
+    finally:
+        srv_a.close()
+
+
+def test_observe_default_detects_but_never_adopts(tmp_path,
+                                                  monkeypatch):
+    """TTS_FAILOVER unset = observe-only: the watcher journals the
+    expired peer and touches NOTHING — the orphan directory stays
+    byte-identical and no request moves."""
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.5")
+    fleet = tmp_path / "fleet"
+    a_dir, b_dir = fleet / "a", fleet / "b"
+    a_dir.mkdir(parents=True)
+    keeper = LeaseKeeper(a_dir)
+    keeper.acquire()
+    led = RequestLedger(a_dir, lease=keeper)
+    led.journal("admit", rid="req-0000", tag="orph1", seq=0,
+                payload={"p_times": [[1, 2], [3, 4]], "lb": 1},
+                spent_s=0.0)
+    led.close()
+    keeper._stop.set()                     # dies without release
+    if keeper._thread is not None:
+        keeper._thread.join(timeout=5.0)
+    wait_until(lambda: lease_mod.read_lease(a_dir).expired(),
+               timeout=30, msg="orphan lease expires")
+    before = dir_bytes(a_dir)
+
+    srv_b = SearchServer(n_submeshes=1, ledger_dir=str(b_dir),
+                         fleet_dir=str(fleet), autostart=False)
+    try:
+        wait_until(lambda: srv_b.watcher.observed >= 1, timeout=60,
+                   msg="B observes the expired peer")
+        assert srv_b.watcher.takeovers == 0
+        assert dir_bytes(a_dir) == before        # untouched
+        with srv_b._lock:
+            assert not any(r.request.tag == "orph1"
+                           for r in srv_b.records.values())
+        snap = srv_b.status_snapshot()["failover"]
+        assert snap["mode"] == "observe"
+        down = [p for p in snap["peers"]
+                if p.get("expired") and not p.get("released")]
+        assert len(down) == 1 and down[0]["epoch"] == 1
+        assert snap["actions"][0]["outcome"] == "observed"
+
+        # the health layer pages an operator instead: peer_down fires
+        from tpu_tree_search.obs import health
+        rules = health.default_rules(health.Thresholds())
+        rule = next(r for r in rules if r.name == "peer_down")
+        active, detail = rule.check(
+            types.SimpleNamespace(server=srv_b, snapshot=None))
+        assert active and detail["peers_down"] == 1
+        assert rule.severity == "critical"
+
+        # the doctor's storage-side view distinguishes the verdicts
+        from tpu_tree_search.obs import aggregate
+        report = aggregate.fleet_lease_report(fleet)
+        rows = {r["dir"]: r for r in report}
+        assert rows[str(a_dir)]["expired"] is True
+        assert aggregate.needs_takeover(report) == [rows[str(a_dir)]]
+        healthy, reasons = aggregate.verdict(
+            {"servers": [], "alerts": []}, lease_report=report)
+        assert not healthy
+        assert any("DOWN-lease-expired" in r for r in reasons)
+    finally:
+        srv_b.close()
+    # a NON-fleet server's snapshot has no failover key content — the
+    # PR-12 parity surface
+    srv_plain = SearchServer(n_submeshes=1, autostart=False)
+    try:
+        assert srv_plain.status_snapshot()["failover"] is None
+        assert srv_plain.lease is None and srv_plain.watcher is None
+    finally:
+        srv_plain.close()
+
+
+def test_racing_adopters_exactly_one_wins(tmp_path, monkeypatch):
+    """Two peers racing one expired lease: the claim-file CAS mints
+    exactly one adopter; the loser backs off without touching the
+    orphan. DONE terminals re-serve idempotently on the winner."""
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.5")
+    fleet = tmp_path / "fleet"
+    a_dir = fleet / "a"
+    a_dir.mkdir(parents=True)
+    keeper = LeaseKeeper(a_dir)
+    keeper.acquire()
+    led = RequestLedger(a_dir, lease=keeper)
+    led.journal("admit", rid="req-0000", tag="race1", seq=0,
+                payload={"p_times": small(0).p_times.tolist(),
+                         "lb": 1, **KW},
+                spool_id="sp-1", spent_s=2.5)
+    led.journal("exclude", rid="req-0000", excluded=[0])
+    led.journal("admit", rid="req-0001", tag="race-done", seq=1,
+                payload={"p_times": [[1, 2], [3, 4]], "lb": 1},
+                spent_s=1.0)
+    led.journal("terminal", rid="req-0001", state="DONE",
+                snapshot={"result": {"best": 42, "explored_tree": 10,
+                                     "explored_sol": 2},
+                          "spent_s": 1.0})
+    led.close()
+    keeper._stop.set()
+    if keeper._thread is not None:
+        keeper._thread.join(timeout=5.0)
+    wait_until(lambda: lease_mod.read_lease(a_dir).expired(),
+               timeout=30, msg="orphan lease expires")
+
+    srv_b = SearchServer(n_submeshes=2, ledger_dir=str(fleet / "b"),
+                         fleet_dir=str(fleet), autostart=False)
+    srv_c = SearchServer(n_submeshes=2, ledger_dir=str(fleet / "c"),
+                         fleet_dir=str(fleet), autostart=False)
+    try:
+        barrier = threading.Barrier(2)
+        results = {}
+
+        def race(name, srv):
+            barrier.wait()
+            results[name] = srv.adopt_ledger(str(a_dir),
+                                             current_epoch=1)
+
+        ts = [threading.Thread(target=race, args=(n, s))
+              for n, s in (("b", srv_b), ("c", srv_c))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        outcomes = sorted(r["outcome"] for r in results.values())
+        assert outcomes == ["adopted", "lost_race"], results
+        winner = next(s for n, s in (("b", srv_b), ("c", srv_c))
+                      if results[n]["outcome"] == "adopted")
+        loser = srv_c if winner is srv_b else srv_b
+        win_res = next(r for r in results.values()
+                       if r["outcome"] == "adopted")
+        assert win_res["moved"] == 1 and win_res["reserved"] == 1
+        assert win_res["failed"] == 0 and win_res["epoch"] == 2
+
+        with winner._lock:
+            recs = {r.request.tag: r for r in winner.records.values()}
+        with loser._lock:
+            assert not any(r.request.tag == "race1"
+                           for r in loser.records.values())
+        # the live entry: budget, exclusion, spool id all intact
+        live = recs["race1"]
+        assert live.state == "QUEUED"
+        assert live.spent_prev_s == 2.5
+        assert live.excluded_submeshes == {0}
+        assert winner.replayed_spool["sp-1"] == live.id
+        # the DONE entry re-serves idempotently: same tag -> recorded
+        # result, zero dispatches
+        done = recs["race-done"]
+        assert done.state == "DONE" and done.result.best == 42
+        rid_again = winner.submit(SearchRequest(
+            p_times=np.asarray([[1, 2], [3, 4]], np.int32), lb_kind=1,
+            tag="race-done", **KW))
+        assert rid_again == done.id
+        assert winner.records[done.id].dispatches == 0
+        # orphan replay: one takeover at epoch 2, live set empty
+        led2 = RequestLedger(a_dir)
+        assert led2.state.takeovers == 1 and led2.state.epoch == 2
+        assert "req-0000" not in led2.state.requests
+        led2.close()
+    finally:
+        srv_b.close()
+        srv_c.close()

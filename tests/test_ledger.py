@@ -213,6 +213,77 @@ def test_ledger_compaction_bounds_terminal_history(tmp_path):
     assert "req-0001" not in probe2.requests
     led2.close()
 
+
+def test_ledger_compaction_concurrent_reader_never_torn(tmp_path):
+    """A peer scanning the directory mid-compaction (the
+    FailoverWatcher, an adopting survivor) must see either the old
+    segment set or the COMPLETE new segment — never a half-written
+    one. Compaction writes to a dot-temp (invisible to the seg-*
+    glob) and lands it with one atomic rename, so every line a reader
+    ever observes in a `seg-*.jsonl` file is CRC-complete JSON."""
+    import threading
+    import zlib
+
+    from tpu_tree_search.service.ledger import _canonical
+
+    d = tmp_path / "led"
+    led = RequestLedger(d, segment_records=8)   # rotates constantly
+    stop = threading.Event()
+    bad: list = []      # (file, line) pairs that failed CRC/JSON
+    temps: list = []    # any non-final file the glob ever matched
+    scans = [0]
+
+    def reader():
+        while not stop.is_set():
+            for seg in list(d.glob("seg-*.jsonl")):
+                if ".tmp" in seg.name or not seg.name.startswith("seg-"):
+                    temps.append(seg.name)
+                try:
+                    data = seg.read_bytes()
+                except FileNotFoundError:
+                    continue        # deleted under us: fine, old set
+                # every COMPLETE line must be a valid wrapped record
+                # (the writer's in-flight tail may lack its newline;
+                # that torn tail is exactly what replay truncates)
+                for raw in data.split(b"\n")[:-1]:
+                    if not raw:
+                        continue
+                    try:
+                        outer = json.loads(raw.decode())
+                        ok = (zlib.crc32(_canonical(outer["r"]))
+                              == int(outer["c"]))
+                    except Exception:  # noqa: BLE001
+                        ok = False
+                    if not ok:
+                        bad.append((seg.name, raw[:80]))
+            scans[0] += 1
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        led.journal("boot", pid=1)
+        for i in range(60):
+            rid = f"req-{i:04d}"
+            led.journal("admit", rid=rid, tag=f"t{i}", seq=i,
+                        payload={"p_times": [[1, 2], [3, 4]], "lb": 1},
+                        spent_s=0.0)
+            for j in range(6):
+                led.journal("budget", rid=rid, spent_s=float(j))
+            led.journal("terminal", rid=rid, state="DONE",
+                        snapshot={"spent_s": 5.0})
+    finally:
+        stop.set()
+        t.join()
+    assert led.compactions >= 2       # the race window really opened
+    assert scans[0] >= 3              # and the reader really scanned
+    assert temps == []                # dot-temps never match the glob
+    assert bad == [], bad[:5]
+    led.close()
+    # and the final state replays clean
+    led2 = RequestLedger(d)
+    assert led2.truncated == 0 and led2.quarantined_segments == 0
+    led2.close()
+
     # terminal_keep=0 means NO idempotency window — every terminal
     # drops at compaction ([:-0] must not silently keep them all)
     d0 = tmp_path / "led0"

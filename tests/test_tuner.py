@@ -219,6 +219,30 @@ def test_corrupt_entry_quarantined_and_reprobed(tmp_path, damage):
     #   other near-tied candidate; what matters is it came from disk
 
 
+def test_repeat_quarantines_keep_distinct_forensic_copies(tmp_path):
+    """Quarantine names are per-writer unique AND counter-suffixed:
+    the same entry corrupted twice (or by N processes racing on shared
+    fleet storage) keeps BOTH forensic copies — the second rename must
+    not os.replace over the first."""
+    from tpu_tree_search.tune.cache import TuningCache
+
+    cache = TuningCache(tmp_path / "tune")
+    key = ("pfsp", 8, 3, 1, 4)
+    for round_ in range(2):
+        cache.store(key, {"chunk": 64, "round": round_})
+        path = cache.path_for(key)
+        path.write_bytes(b"\xff torn" * 4)
+        assert cache.load(key) is None
+    quarantined = sorted(f.name for f in (tmp_path / "tune").iterdir()
+                         if f.name.endswith(".corrupt"))
+    assert len(quarantined) == 2, quarantined     # both copies survive
+    assert len(set(quarantined)) == 2
+    assert cache.snapshot()["quarantined"] == 2
+    # and the cache still works: a clean store replays
+    cache.store(key, {"chunk": 128})
+    assert cache.load(key)["chunk"] == 128
+
+
 def test_search_consumes_tuned_entry(tmp_path):
     """distributed.search(chunk=None, tuner=...) compiles the TUNED
     chunk — proven from the executor key, not from a log line."""

@@ -288,6 +288,29 @@ def _serve_parser(sub):
                         "pauses are restored. Pairs with a persistent "
                         "--workdir (default with --ledger: "
                         "<ledger>/workdir). Default: off")
+    p.add_argument("--fleet-dir", type=str, default=None,
+                   help="shared fleet root for high availability (also "
+                        "via TTS_FLEET_DIR; service/lease.py + "
+                        "failover.py): the server takes an fsync'd, "
+                        "CRC-stamped LEASE on its --ledger dir (owner "
+                        "id, fencing epoch, TTL TTS_LEASE_TTL_S) and "
+                        "renews it from a daemon thread; every ledger "
+                        "append and checkpoint save is stamped with "
+                        "the epoch, and a FailoverWatcher scans the "
+                        "fleet root for peer leases that expired "
+                        "without release. Requires --ledger. Default: "
+                        "off (single-server PR-12 behavior)")
+    p.add_argument("--failover", action="store_true",
+                   help="ARM peer-ledger takeover (also via "
+                        "TTS_FAILOVER=1): when a peer's lease expires, "
+                        "CAS-bump its epoch, adopt its ledger — "
+                        "re-admit queued/active requests here with "
+                        "budgets/exclusions/spool ids intact, re-serve "
+                        "done tags idempotently — and keep its lease "
+                        "so the stale owner boots fenced. Default: "
+                        "observe-only — peer-down detection and "
+                        "journaling only, zero takeovers, behavior "
+                        "bit-identical to a fleet-less server")
     p.add_argument("--drain-timeout", type=float, default=None,
                    help="graceful SIGTERM/SIGINT drain budget in "
                         "seconds (also via TTS_DRAIN_TIMEOUT_S, "
@@ -531,6 +554,12 @@ def run_serve(args) -> int:
         _cfg.set_env(_cfg.REMEDIATE_FLAG, "1")
     if args.megabatch:
         _cfg.set_env(_cfg.MEGABATCH_FLAG, "1")
+    if args.fleet_dir:
+        # env too: worker respawns and the lease/watcher layers all
+        # resolve TTS_FLEET_DIR at one site (the server constructor)
+        _cfg.set_env(_cfg.FLEET_DIR_ENV, args.fleet_dir)
+    if args.failover:
+        _cfg.set_env(_cfg.FAILOVER_FLAG, "1")
     if args.trace_file:
         tracelog.get().set_sink(args.trace_file)
         print(f"flight recorder: {args.trace_file}", flush=True)
@@ -580,6 +609,14 @@ def run_serve(args) -> int:
                       f"{rec['queued']}q/{rec['active']}a/"
                       f"{rec['held']}h/{rec['terminal']}t, "
                       f"truncated {led['truncated']})", flush=True)
+            if srv.lease is not None or srv.fenced:
+                mode = ("FENCED" if srv.fenced else
+                        ("ACT" if srv.watcher is not None
+                         and srv.watcher.act else "observe"))
+                epoch = srv.lease.epoch if srv.lease is not None else "-"
+                print(f"failover: {mode}-mode, lease epoch {epoch}, "
+                      f"ttl {_cfg.env_float('TTS_LEASE_TTL_S'):g}s "
+                      f"(TTS_FLEET_DIR/TTS_FAILOVER)", flush=True)
             if srv.aot is not None:
                 print(f"aot cache: {srv.aot.root} "
                       f"({srv.aot.entries()} entr(y/ies))", flush=True)
@@ -640,7 +677,11 @@ def run_serve(args) -> int:
                 srv, args.spool, idle_exit_s=args.idle_exit,
                 status_every_s=args.status_every or None,
                 emit=lambda s: print(s, flush=True),
-                should_exit=drain_evt.is_set)
+                # a FENCED server (lease lost to an adopter) must stop
+                # serving the spool too: its requests now live on the
+                # peer, and a fenced loop polling forever would shadow
+                # the adopter's results
+                should_exit=lambda: drain_evt.is_set() or srv.fenced)
             # the `with` close() below IS the drain: stop at segment
             # boundaries, checkpoint, flush the async checkpoint/AOT/
             # ledger writers — the watchdog escalates if it wedges
@@ -658,6 +699,14 @@ def run_serve(args) -> int:
         watchdog.cancel()       # drained inside the budget: exit 0
     if drain_evt.is_set():
         print("drained cleanly", flush=True)
+    if srv.fenced:
+        # clean exit 0 ON PURPOSE: a fenced server did the right thing
+        # (zero commits past the fence) — a nonzero exit would make a
+        # supervisor restart-loop a host whose ledger now lives on a
+        # peer
+        print(f"fenced: {srv._fence_reason or 'lease lost'} — a peer "
+              "owns this ledger now; exited without commits",
+              flush=True)
     print(f"served {served} request(s)", flush=True)
     return 0
 
@@ -782,6 +831,21 @@ def _doctor_parser(sub):
                         "scrape target for the fleet)")
     p.add_argument("--timeout", type=float, default=5.0,
                    help="per-endpoint scrape timeout in seconds")
+    p.add_argument("--fleet-dir", type=str, default=None,
+                   help="shared fleet root (TTS_FLEET_DIR): also read "
+                        "every peer's LEASE file straight off storage, "
+                        "so a DOWN server splits DOWN-with-lease-held "
+                        "(exit 1: wait out the TTL) from "
+                        "DOWN-lease-expired (exit 2: requests "
+                        "orphaned, takeover needed)")
+
+
+# doctor exit codes: 0 healthy; 1 unhealthy (unreachable/firing/
+# degraded — or DOWN-with-lease-held: wait out the TTL); 2 an expired
+# unreleased lease sits in --fleet-dir (orphaned ledger: page/arm
+# takeover NOW). Distinct codes so a supervisor can wait on 1 and act
+# on 2.
+DOCTOR_TAKEOVER_EXIT_CODE = 2
 
 
 def run_doctor(args) -> int:
@@ -791,7 +855,10 @@ def run_doctor(args) -> int:
 
     fleet = aggregate.scrape(args.urls, timeout=args.timeout)
     merged = aggregate.merge(fleet)
-    healthy, reasons = aggregate.verdict(merged)
+    lease_report = (aggregate.fleet_lease_report(args.fleet_dir)
+                    if args.fleet_dir else None)
+    healthy, reasons = aggregate.verdict(merged,
+                                         lease_report=lease_report)
     if args.dashboard:
         with open(args.dashboard, "w") as f:
             f.write(dashboard.render_fleet(merged))
@@ -802,6 +869,8 @@ def run_doctor(args) -> int:
         print(f"# wrote {args.metrics_out}", file=sys.stderr)
     if args.json:
         print(json.dumps({"healthy": healthy, "reasons": reasons,
+                          **({"leases": lease_report}
+                             if lease_report is not None else {}),
                           **{k: v for k, v in merged.items()
                              if k != "metrics"}}, indent=1))
     else:
@@ -824,15 +893,32 @@ def run_doctor(args) -> int:
                 led_col = (f" restarts={s.get('restarts')}"
                            f" recovered={s.get('recovered_requests')}"
                            f" ledger_lag_s={s.get('ledger_lag_s')}")
+            fo_col = ""
+            if s.get("failover_mode") is not None or s.get("fenced"):
+                fo_col = (f" failover={s.get('failover_mode')}"
+                          f" epoch={s.get('lease_epoch')}"
+                          f" peers_down={s.get('peers_down')}"
+                          f" takeovers={s.get('takeovers')}") + (
+                          " FENCED" if s.get("fenced") else "")
             print(f"{s['origin']:<24} {mark:<10} "
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
                   f"requests={s.get('requests')}{aot_col}{rem_col}"
-                  f"{led_col}")
+                  f"{led_col}{fo_col}")
+        for r in lease_report or []:
+            state = ("released" if r["released"] else
+                     "EXPIRED" if r["expired"] else "live")
+            print(f"lease {r['dir']}: {state} owner={r['owner']} "
+                  f"epoch={r['epoch']} age={r['age_s']:g}s"
+                  f"/ttl={r['ttl_s']:g}s")
         print("healthy" if healthy else
               "UNHEALTHY:\n  " + "\n  ".join(reasons))
-    return 0 if healthy else 1
+    if healthy:
+        return 0
+    if lease_report and aggregate.needs_takeover(lease_report):
+        return DOCTOR_TAKEOVER_EXIT_CODE
+    return 1
 
 
 def _nq_parser(sub):

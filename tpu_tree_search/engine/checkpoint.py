@@ -69,6 +69,14 @@ class SegmentTimeout(RuntimeError):
     the caller (campaign supervisor) must kill and respawn the process."""
 
 
+class StaleCheckpointError(RuntimeError):
+    """An epoch-stale save was refused: the file on disk carries a
+    NEWER lease epoch than the writer (fleet failover — a peer adopted
+    this checkpoint family; see service/lease.py). Deliberately NOT
+    transient: the stale owner must self-fence, never retry into a
+    clobber."""
+
+
 def _transient_errors() -> tuple:
     """Error types worth retrying: host/filesystem I/O, injected faults,
     and the runtime's transport errors (a dropped remote-TPU tunnel
@@ -307,6 +315,20 @@ def snapshot_arrays(state: SearchState, meta: dict | None = None
     return arrays
 
 
+def _existing_lease_epoch(path: pathlib.Path) -> int | None:
+    """Best-effort peek of an on-disk snapshot's ``meta_lease_epoch``
+    stamp. Absent file, absent stamp, or an unreadable file (mid-crash
+    torso — load_resilient's problem, not the fence's) all yield None:
+    the fence only refuses when it can PROVE the disk is newer."""
+    try:
+        with np.load(path) as z:
+            if "meta_lease_epoch" in z.files:
+                return int(z["meta_lease_epoch"])
+    except Exception:  # noqa: BLE001 — any unreadable existing file
+        return None    # means "nothing provably newer": proceed
+    return None
+
+
 def _write_snapshot(path: str | pathlib.Path, arrays: dict) -> None:
     """The durable half of a save: stamp schema + CRC, write to a temp
     file, fsync, rotate current -> `.prev` last-good, rename into
@@ -316,6 +338,19 @@ def _write_snapshot(path: str | pathlib.Path, arrays: dict) -> None:
     arrays["meta_schema_version"] = np.asarray(SCHEMA_VERSION)
     arrays["meta_crc32"] = np.asarray(_payload_crc(arrays), np.uint32)
     path = pathlib.Path(path)
+    # fencing (fleet failover): a save carrying a lease-epoch stamp
+    # first peeks the on-disk file's stamp and REFUSES to overwrite a
+    # newer one — a fenced-out stale owner can never clobber its
+    # adopter's snapshot, even if timing slips. Saves without the
+    # stamp (every non-fleet run) pay nothing.
+    inc = arrays.get("meta_lease_epoch")
+    if inc is not None:
+        existing = _existing_lease_epoch(path)
+        if existing is not None and existing > int(inc):
+            raise StaleCheckpointError(
+                f"{path}: on-disk checkpoint carries lease epoch "
+                f"{existing} > writer's {int(inc)} — refusing the "
+                "stale save")
     tmp = path.with_suffix(".tmp.npz")
     with open(tmp, "wb") as f:
         np.savez_compressed(f, **arrays)

@@ -34,7 +34,8 @@ import urllib.error
 import urllib.request
 
 __all__ = ["parse_prometheus", "scrape_one", "scrape", "merge",
-           "fleet_to_prometheus", "verdict", "recovered_live"]
+           "fleet_to_prometheus", "verdict", "recovered_live",
+           "fleet_lease_report", "needs_takeover"]
 
 
 def recovered_live(ledger: dict | None) -> int:
@@ -89,9 +90,27 @@ def _split_labels(s: str) -> list[str]:
     return [p for p in parts if p.strip()]
 
 
-def _get(url: str, timeout: float):
-    with urllib.request.urlopen(url, timeout=timeout) as r:
-        return r.status, r.read().decode()
+# transient-scrape retry budget: a fleet doctor run races server boots
+# and GC pauses; one refused connect must not mark a live peer DOWN.
+# Bounded backoff 0.1 * 2^k keeps the worst case well under a second.
+SCRAPE_RETRIES = 3
+SCRAPE_BACKOFF_S = 0.1
+
+
+def _get(url: str, timeout: float, retries: int = SCRAPE_RETRIES):
+    for attempt in range(retries):
+        try:
+            with urllib.request.urlopen(url, timeout=timeout) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError:
+            # the server ANSWERED — a non-2xx is a health fact for the
+            # caller to judge, not a flake to retry
+            raise
+        except OSError:
+            if attempt == retries - 1:
+                raise
+            time.sleep(SCRAPE_BACKOFF_S * (2 ** attempt))
+    raise AssertionError("unreachable")  # pragma: no cover
 
 
 def scrape_one(url: str, timeout: float = 5.0) -> dict:
@@ -153,7 +172,12 @@ def merge(fleet: dict) -> dict:
                # crash-safe serving (service/ledger): None on a server
                # running without a ledger
                "restarts": None, "recovered_requests": None,
-               "ledger_lag_s": None}
+               "ledger_lag_s": None,
+               # fleet failover (service/failover): None outside fleet
+               # mode (snapshot parity with a PR-12 server)
+               "fenced": None, "lease_epoch": None,
+               "failover_mode": None, "peers_down": None,
+               "takeovers": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -180,6 +204,20 @@ def merge(fleet: dict) -> dict:
                 row["restarts"] = led.get("restarts")
                 row["recovered_requests"] = recovered_live(led)
                 row["ledger_lag_s"] = led.get("lag_s")
+            # the fleet-failover facts: fencing state, lease epoch,
+            # watcher mode and how many peers look down from HERE —
+            # the doctor's failover columns
+            fo = st.get("failover")
+            if fo:
+                row["fenced"] = fo.get("fenced")
+                row["lease_epoch"] = (fo.get("lease") or {}).get("epoch")
+                row["failover_mode"] = fo.get("mode")
+                row["takeovers"] = fo.get("takeovers")
+                peers = fo.get("peers")
+                if peers is not None:
+                    row["peers_down"] = sum(
+                        1 for p in peers
+                        if p.get("expired") and not p.get("released"))
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             for rid, snap in reqs.items():
@@ -211,13 +249,76 @@ def fleet_to_prometheus(merged: dict) -> str:
     return "\n".join(lines) + "\n"
 
 
-def verdict(merged: dict) -> tuple[bool, list[str]]:
+def fleet_lease_report(fleet_dir) -> list[dict]:
+    """Every peer's lease read straight off the shared fleet root — no
+    HTTP, so it works exactly when scraping does not: a DOWN server
+    cannot answer /status, but its lease file says whether it is
+    DOWN-with-lease-held (alive-ish or freshly dead: wait out the TTL)
+    or DOWN-lease-expired (requests orphaned: takeover needed, doctor
+    exit code 2). Lazily imports the service lease module; [] when the
+    dir is empty/unreadable."""
+    import pathlib
+
+    from ..service import lease as lease_mod
+    rows = []
+    try:
+        subdirs = sorted(p for p in pathlib.Path(fleet_dir).iterdir()
+                         if p.is_dir())
+    except OSError:
+        return rows
+    for d in subdirs:
+        info = lease_mod.read_lease(d)
+        if info is None:
+            continue
+        rows.append({"dir": str(d), "owner": info.owner,
+                     "epoch": info.epoch,
+                     "age_s": round(info.age_s(), 3),
+                     "ttl_s": info.ttl_s,
+                     "released": info.released,
+                     "expired": info.expired()})
+    return rows
+
+
+def needs_takeover(lease_report: list[dict]) -> list[dict]:
+    """The rows of a :func:`fleet_lease_report` that demand action:
+    expired WITHOUT release = a dead owner's orphaned ledger. THE
+    definition behind doctor exit code 2, so the CLI and tests cannot
+    drift."""
+    return [r for r in lease_report
+            if r.get("expired") and not r.get("released")]
+
+
+def verdict(merged: dict,
+            lease_report: list[dict] | None = None) -> tuple[bool,
+                                                             list[str]]:
     """The doctor's judgment: (healthy, reasons). Healthy iff every
     server scraped, healthz says ok, zero alerts are firing, and no
     server is serving in a degraded (quarantined-submesh)
     configuration — a fleet routing around a held-out submesh works,
-    but it is running on reduced capacity and a human should know."""
+    but it is running on reduced capacity and a human should know.
+
+    With a `lease_report` (doctor --fleet-dir), DOWN servers split two
+    ways: an expired unreleased lease is DOWN-lease-expired (orphaned
+    requests, takeover needed — exit code 2 via
+    :func:`needs_takeover`); an unreachable server while every lease
+    is still live is DOWN-with-lease-held (restarting or paused: wait
+    out the TTL before any takeover)."""
     reasons = []
+    if lease_report:
+        expired = needs_takeover(lease_report)
+        for r in expired:
+            reasons.append(
+                f"{r['dir']}: DOWN-lease-expired — owner {r['owner']} "
+                f"epoch {r['epoch']} silent {r['age_s']}s > ttl "
+                f"{r['ttl_s']}s; requests orphaned (takeover needed)")
+        held = [r for r in lease_report
+                if not r.get("expired") and not r.get("released")]
+        if held and not expired \
+                and any(not s["ok"] for s in merged["servers"]):
+            reasons.append(
+                f"fleet: unreachable server(s) but {len(held)} "
+                "lease(s) still live — DOWN-with-lease-held: owner may "
+                "be restarting; wait out the TTL before takeover")
     for s in merged["servers"]:
         if not s["ok"]:
             reasons.append(f"{s['origin']}: unreachable ({s['error']})")

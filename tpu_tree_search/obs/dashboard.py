@@ -226,6 +226,20 @@ def render_server(snapshot: dict | None, alerts: dict | None,
             _tile("ledger lag s", led.get("lag_s")
                   if led.get("lag_s") is not None else "—"),
         ]
+    fo = snapshot.get("failover") or {}
+    if fo:
+        peers = fo.get("peers") or []
+        peers_down = sum(1 for p in peers
+                         if p.get("expired") and not p.get("released"))
+        led_tiles += [
+            _tile("failover", "FENCED" if fo.get("fenced")
+                  else fo.get("mode", "observe"),
+                  bad=bool(fo.get("fenced"))),
+            _tile("lease epoch",
+                  (fo.get("lease") or {}).get("epoch", "—")),
+            _tile("peers down", peers_down, bad=peers_down > 0),
+            _tile("takeovers", fo.get("takeovers", 0)),
+        ]
     tiles = "".join([
         _tile("firing alerts", firing, bad=firing > 0),
         _tile("queue depth", queue.get("depth", 0)),
@@ -285,6 +299,8 @@ def render_fleet(merged: dict) -> str:
         _tile("quarantined submeshes", quarantined,
               bad=quarantined > 0),
         _tile("admission paused", paused, bad=paused > 0),
+        _tile("fenced", sum(1 for s in servers if s.get("fenced")),
+              bad=any(s.get("fenced") for s in servers)),
         _tile("requests", len(merged.get("requests") or [])),
     ])
     srv_rows = []
@@ -303,6 +319,16 @@ def render_fleet(merged: dict) -> str:
                f"{s.get('restarts')} restart(s) · "
                f"{s.get('recovered_requests')} recovered · "
                f"lag {s.get('ledger_lag_s')}s")
+        if s.get("failover_mode") is None and not s.get("fenced"):
+            fo_cell = "—"
+        else:
+            fo_cell = (f"{s.get('failover_mode')} · "
+                       f"epoch {s.get('lease_epoch')} · "
+                       f"{s.get('peers_down') or 0} down · "
+                       f"{s.get('takeovers') or 0} takeover(s)")
+            if s.get("fenced"):
+                # icon + word, never color alone (the palette rule)
+                fo_cell = "✗ FENCED · " + fo_cell
         srv_rows.append(
             f"<tr><td>{_esc(s['origin'])}</td><td>{mark}</td>"
             f'<td class="num">{_esc(s.get("firing", "-"))}</td>'
@@ -311,13 +337,15 @@ def render_fleet(merged: dict) -> str:
             f"{_esc(s.get('submeshes', '-'))}</td>"
             f"<td>{_esc(rem or '—')}</td>"
             f"<td>{_esc(led)}</td>"
+            f"<td>{_esc(fo_cell)}</td>"
             f'<td class="num">{_esc(s.get("requests", 0))}</td>'
             f'<td class="num">{_esc(s.get("uptime_s", "-"))}</td></tr>')
     body = (
         f'<div class="tiles">{tiles}</div>'
         "<h2>Servers</h2><table><tr><th>origin</th><th>health</th>"
         "<th>firing</th><th>queue</th><th>busy</th>"
-        "<th>remediation</th><th>ledger</th><th>requests</th>"
+        "<th>remediation</th><th>ledger</th><th>failover</th>"
+        "<th>requests</th>"
         f"<th>uptime s</th></tr>{''.join(srv_rows)}</table>"
         "<h2>Alerts</h2><table><tr><th>origin</th><th>severity</th>"
         "<th>rule</th><th>state</th><th>fired</th><th>detail</th></tr>"

@@ -325,6 +325,32 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
                       "detail": last.detail,
                       "window_s": th.audit_window_s}
 
+    def peer_down(ctx):
+        # fleet failover (service/failover.py): the watcher's last scan
+        # rides the status snapshot's `failover` key. Any peer whose
+        # lease EXPIRED without being released is a down server whose
+        # ledger holds orphaned requests — critical whether or not
+        # TTS_FAILOVER is armed (observe-only fleets page an operator
+        # instead of self-adopting). Duck-typed: non-fleet servers
+        # (no watcher, snapshot key absent/None) never fire.
+        watcher = getattr(ctx.server, "watcher", None)
+        fo = (watcher.snapshot() if watcher is not None
+              else (ctx.snapshot or {}).get("failover") or {})
+        peers = fo.get("peers") or []
+        down = [p for p in peers
+                if p.get("expired") and not p.get("released")]
+        if not down:
+            return False, {}
+        worst = max(down, key=lambda p: p.get("age_s") or 0.0)
+        return True, {"peers_down": len(down),
+                      "dir": worst.get("dir"),
+                      "owner": worst.get("owner"),
+                      "epoch": worst.get("epoch"),
+                      "age_s": worst.get("age_s"),
+                      "ttl_s": worst.get("ttl_s"),
+                      "mode": fo.get("mode"),
+                      "takeovers": fo.get("takeovers")}
+
     def perf(ctx):
         path = th.perf_json
         if not path or not os.path.exists(path):
@@ -360,6 +386,10 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
                          "(obs/audit.py)"),
         Rule("perf", perf, severity="warn",
              description="perf_sentry --json verdict is FAIL"),
+        Rule("peer_down", peer_down, severity="critical",
+             description="a fleet peer's ledger lease expired without "
+                         "release (host down, requests orphaned; "
+                         "observe-only fleets need an operator)"),
     ]
 
 

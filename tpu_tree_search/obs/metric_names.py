@@ -194,6 +194,17 @@ REGISTRY: dict[str, Metric] = _table(
            "submesh slots currently held out of the partition"),
     Metric("tts_admission_paused", "gauge", "",
            "1 while the remediation controller holds admission paused"),
+    # --- fleet failover (service/lease.py + service/failover.py)
+    Metric("tts_lease_epoch", "gauge", "",
+           "fencing epoch of the ledger lease this server holds"),
+    Metric("tts_lease_renewals_total", "counter", "",
+           "successful ledger-lease renewals"),
+    Metric("tts_lease_lost_total", "counter", "",
+           "lease losses (epoch bumped by an adopter / owner changed): "
+           "the server self-fenced"),
+    Metric("tts_takeovers_total", "counter", "outcome",
+           "expired peer leases handled by the failover watcher "
+           "(outcome: adopted/observed/lost_race/error)"),
     # --- health / audit / meta
     Metric("tts_alerts", "gauge", "rule,severity",
            "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),

@@ -67,6 +67,7 @@ import time
 import zlib
 
 from ..obs import tracelog
+from .lease import LeaseLost
 
 __all__ = ["RequestLedger", "LedgerState", "FAILURE_LOG_CAP"]
 
@@ -123,6 +124,13 @@ class LedgerState:
         self.paused: str | None = None
         self.quarantined: dict[int, str] = {}
         self.requests: dict[str, dict] = {}
+        # lease-fencing epoch (failover): the highest epoch stamp seen.
+        # Records stamped with a LOWER epoch are a fenced-out owner's
+        # stale appends and are discarded on apply — the split-brain
+        # fence lives in the data, not in timing
+        self.epoch = 0
+        self.fenced_discards = 0
+        self.takeovers = 0
         # True while the last journaled lifetime ended with a graceful
         # `drain` marker; a boot record clears it. At replay this says
         # whether the PRIOR lifetime drained cleanly or died hard —
@@ -134,7 +142,16 @@ class LedgerState:
     def apply(self, rec: dict) -> None:
         """Fold one record in. Unknown kinds are ignored (forward
         compatibility: an old binary replaying a newer ledger must not
-        die on a record it does not understand)."""
+        die on a record it does not understand). Records carrying an
+        epoch stamp ``"e"`` below the current fencing epoch are a stale
+        owner's post-takeover appends: discarded (counted), on this
+        replay and every future one."""
+        e = rec.get("e")
+        if isinstance(e, int):
+            if e < self.epoch:
+                self.fenced_discards += 1
+                return
+            self.epoch = e
         kind = rec.get("k")
         fn = getattr(self, f"_apply_{kind}", None)
         if fn is not None:
@@ -261,6 +278,12 @@ class LedgerState:
     def _apply_pause_state(self, rec: dict) -> None:
         self.paused = rec.get("reason")
 
+    def _apply_takeover(self, rec: dict) -> None:
+        # the durable fence line a peer journals when it adopts this
+        # ledger: the epoch ratchet itself happened in apply() — this
+        # handler just keeps the count for snapshot()/doctor
+        self.takeovers += 1
+
     def _apply_restore(self, rec: dict) -> None:
         e = dict(rec.get("entry") or {})
         if e.get("rid"):
@@ -275,12 +298,18 @@ class LedgerState:
         (non-terminal) requests are all kept; terminal snapshots keep
         only the newest `terminal_keep` (the bounded idempotency
         window)."""
-        out: list[dict] = [{"k": "boots", "n": self.boots,
-                            "clean": self.clean_shutdown},
-                           {"k": "pause_state", "reason": self.paused},
-                           {"k": "quarantine_state",
-                            "submeshes": {str(k): v for k, v in
-                                          self.quarantined.items()}}]
+        out: list[dict] = []
+        if self.epoch:
+            # the fencing epoch must survive compaction: without this
+            # head record a rotation would forget the fence and a stale
+            # owner's discarded appends could replay on the next boot
+            out.append({"k": "epoch", "e": self.epoch})
+        out.append({"k": "boots", "n": self.boots,
+                    "clean": self.clean_shutdown})
+        out.extend([{"k": "pause_state", "reason": self.paused},
+                    {"k": "quarantine_state",
+                     "submeshes": {str(k): v for k, v in
+                                   self.quarantined.items()}}])
         entries = sorted(self.requests.values(),
                          key=lambda e: e.get("seq", 0))
         terminal = [e for e in entries if e.get("terminal") is not None]
@@ -318,7 +347,13 @@ class RequestLedger:
     def __init__(self, root: str | os.PathLike, registry=None,
                  segment_records: int = SEGMENT_RECORDS_DEFAULT,
                  terminal_keep: int = TERMINAL_KEEP_DEFAULT,
-                 fsync: bool = True):
+                 fsync: bool = True, lease=None, on_fenced=None):
+        self._lease = lease         # LeaseKeeper fencing this ledger's
+        #                             appends (None = single-host mode,
+        #                             byte-identical PR-12 behavior)
+        self._on_fenced = on_fenced  # fired once, outside the lock
+        self.fenced = False
+        self.fence_reason: str | None = None
         self.root = pathlib.Path(root)
         self.root.mkdir(parents=True, exist_ok=True)
         self.segment_records = max(2, int(segment_records))
@@ -478,8 +513,25 @@ class RequestLedger:
         failure is surfaced three ways (`ledger.write_error` event,
         `tts_ledger_errors_total`, `write_errors` in snapshot — the
         doctor's signal that the durability promise is degraded until
-        the disk recovers)."""
+        the disk recovers).
+
+        Under a lease (fleet mode) every record is stamped with the
+        owner's fencing epoch, and a lost lease FENCES the ledger: the
+        record is neither written nor applied, every later journal is a
+        no-op (zero commits by construction), and ``on_fenced`` fires
+        once. Fencing does not raise here for the same reason write
+        errors don't — the typed ``LeaseLost`` surfaces on the admission
+        and checkpoint paths instead."""
         rec = {"k": kind, "t": time.time(), **fields}
+        if self._lease is not None:
+            if self.fenced:
+                return
+            try:
+                self._lease.check()
+            except LeaseLost as e:
+                self._fence(str(e) or "lease lost", kind)
+                return
+            rec["e"] = self._lease.epoch
         compacted = error = None
         with self._lock:
             if self._closed:
@@ -513,6 +565,24 @@ class RequestLedger:
         if self._m_records is not None:
             self._m_records.inc(kind=kind)
 
+    def _fence(self, reason: str, kind: str) -> None:
+        """Mark the ledger fenced (idempotent) and fire `on_fenced`
+        once. After this every journal() is a no-op: a fenced-out
+        stale owner commits NOTHING, by construction."""
+        with self._lock:
+            if self.fenced:
+                return
+            self.fenced = True
+            self.fence_reason = reason
+        tracelog.event("ledger.fenced", dir=str(self.root),
+                       kind=kind, reason=reason)
+        if self._on_fenced is not None:
+            try:
+                self._on_fenced(reason)
+            except Exception as e:  # noqa: BLE001 — journal never raises
+                tracelog.event("ledger.fence_callback_error",
+                               error=repr(e))
+
     def _compact_locked(self) -> dict:   # holds: self._lock
         """Rotate to a fresh segment seeded with absolute state, then
         delete the old ones (caller holds the lock; returns the event
@@ -531,13 +601,29 @@ class RequestLedger:
         old = self._segments()
         self._seg_index += 1
         new_path = self._seg_path(self._seg_index)
-        with open(new_path, "wb") as f:
-            n = 0
-            for rec in self.state.to_records(self.terminal_keep):
-                f.write(_line({"t": time.time(), **rec}))
-                n += 1
-            f.flush()
-            os.fsync(f.fileno())
+        # unique temp + atomic rename: a peer scanning the directory
+        # mid-compaction (FailoverWatcher, an adopting survivor) sees
+        # either the old segment set or the complete new segment, never
+        # a torn half-written one (`_segments` skips dot-temp names)
+        tmp = new_path.with_name(
+            f".{new_path.name}.{os.getpid()}-{threading.get_ident()}.tmp")
+        stamp = ({} if self._lease is None or self.fenced
+                 else {"e": self._lease.epoch})
+        try:
+            with open(tmp, "wb") as f:
+                n = 0
+                for rec in self.state.to_records(self.terminal_keep):
+                    f.write(_line({"t": time.time(), **stamp, **rec}))
+                    n += 1
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, new_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         self._fsync_dir()
         if self._fh is not None:
             self._fh.close()
@@ -611,7 +697,16 @@ class RequestLedger:
     def snapshot(self) -> dict:
         """JSON-safe stats for status_snapshot()'s `ledger` key."""
         with self._lock:
+            extra = {}
+            if (self._lease is not None or self.state.epoch
+                    or self.state.fenced_discards):
+                extra = {"epoch": self.state.epoch,
+                         "fenced": self.fenced,
+                         "fence_reason": self.fence_reason,
+                         "fenced_discards": self.state.fenced_discards,
+                         "takeovers": self.state.takeovers}
             return {"dir": str(self.root),
+                    **extra,
                     "records": self.records,
                     "replayed": self.replayed,
                     "truncated": self.truncated,

@@ -55,6 +55,7 @@ import contextlib
 import itertools
 import os
 import pathlib
+import shutil
 import tempfile
 import threading
 import time
@@ -69,6 +70,7 @@ from ..utils import config as cfg
 from ..utils import faults
 from ..utils.retry import backoff_delay
 from .executors import ExecutorCache
+from .lease import LeaseLost
 from .queueing import AdmissionError, AdmissionPaused, RequestQueue
 from .request import (CANCELLED, DEADLINE, DONE, FAILED, FAILURE_LOG_CAP,
                       PREEMPTED, QUEUED, RUNNING, TERMINAL_STATES,
@@ -157,6 +159,8 @@ class SearchServer:
                  tune_at_boot: bool | None = None,
                  remediate: bool | None = None,
                  ledger_dir: str | None = None,
+                 fleet_dir: str | None = None,
+                 failover: bool | None = None,
                  megabatch: bool | None = None,
                  batch_max: int | None = None,
                  batch_age_s: float | None = None):
@@ -412,13 +416,50 @@ class SearchServer:
         self.replayed_spool: dict[str, str] = {}
         self._recovered = {"queued": 0, "active": 0, "held": 0,
                            "terminal": 0}
+        # fleet failover (service/lease + service/failover): inside a
+        # shared fleet root this server's ledger is owned through a
+        # fenced LEASE — acquired BEFORE the ledger replays, so a boot
+        # against a ledger a live adopter is serving comes up FENCED
+        # (serves nothing, commits nothing, exits clean) instead of
+        # split-braining it. Unset fleet dir -> every lease/watcher
+        # path below is vacuous — bit-identical PR-12 behavior.
+        if fleet_dir is None:
+            fleet_dir = cfg.env_str(cfg.FLEET_DIR_ENV)
+        self.lease = None
+        self.watcher = None
+        self.fenced = False
+        self._fence_reason: str | None = None
+        self._adopted: list = []    # LeaseKeepers of adopted ledgers
+        #                             (kept renewing: a restarted stale
+        #                             owner must find a LIVE lease)
         if ledger_dir:
             from .ledger import RequestLedger
-            self.ledger = RequestLedger(ledger_dir,
-                                        registry=self.metrics)
-            self._replay_boot()
-            self.ledger.journal("boot", pid=os.getpid(),
-                               submeshes=len(self.slots))
+            if fleet_dir:
+                from .lease import LeaseKeeper
+                keeper = LeaseKeeper(ledger_dir, registry=self.metrics,
+                                     on_lost=self._self_fence)
+                try:
+                    keeper.acquire()
+                    self.lease = keeper
+                except LeaseLost as e:
+                    self.fenced = True
+                    self._fence_reason = str(e)
+                    tracelog.event("failover.boot_fenced",
+                                   dir=str(ledger_dir), reason=str(e))
+            if not self.fenced:
+                self.ledger = RequestLedger(ledger_dir,
+                                            registry=self.metrics,
+                                            lease=self.lease,
+                                            on_fenced=self._self_fence)
+                self._replay_boot()
+                self.ledger.journal("boot", pid=os.getpid(),
+                                   submeshes=len(self.slots))
+        if fleet_dir and not self.fenced:
+            from .failover import FailoverWatcher
+            self.watcher = FailoverWatcher(
+                self, fleet_dir, own_root=ledger_dir,
+                act=failover, registry=self.metrics)
+            self.watcher.start()
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
@@ -426,7 +467,9 @@ class SearchServer:
                        overlap=self.overlap,
                        share_incumbent=self.incumbents is not None,
                        remediate=self.remediation.enabled,
-                       ledger=ledger_dir or None)
+                       ledger=ledger_dir or None,
+                       fleet_dir=fleet_dir or None,
+                       fenced=self.fenced)
         if autostart:
             self.start()
 
@@ -489,6 +532,9 @@ class SearchServer:
                 if rec.state == QUEUED and self.ledger is None:
                     self._finalize(rec, CANCELLED, error="server shutdown")
                 rec.done_event.set()
+        # the failover watcher stops scanning before the lease goes
+        if self.watcher is not None:
+            self.watcher.close()
         # stop the resource sampler and retire its gauge series — a
         # closed server must not keep publishing (or holding) them
         self.resources.close()
@@ -508,6 +554,13 @@ class SearchServer:
         if self.ledger is not None:
             self.ledger.journal("drain", pid=os.getpid())
             self.ledger.close()
+        # release leases LAST: our own (marked `released` so peers do
+        # not adopt a cleanly drained ledger; a fenced keeper leaves
+        # the file to its adopter) and every adopted orphan's
+        if self.lease is not None:
+            self.lease.release()
+        for keeper in self._adopted:
+            keeper.release()
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -537,6 +590,15 @@ class SearchServer:
             self.queue.rejected += 1
             tracelog.event("request.reject", reason="server closed")
             raise AdmissionError("server closed")
+        if self.fenced:
+            # a fenced server owns nothing: its ledger belongs to an
+            # adopter, so an admission here could never be durable —
+            # the typed refusal tells the client to resubmit to the
+            # peer that holds the lease
+            self.queue.rejected += 1
+            tracelog.event("request.reject",
+                           reason=f"fenced: {self._fence_reason}")
+            raise LeaseLost(f"server fenced: {self._fence_reason}")
         paused = self.admission_paused()
         if paused is not None:
             # the remediation controller's compile_storm valve: an
@@ -1093,6 +1155,7 @@ class SearchServer:
                 "ledger": ({**self.ledger.snapshot(),
                             "recovered": dict(self._recovered)}
                            if self.ledger is not None else None),
+                "failover": self._failover_snapshot(),
                 "executor_cache": self.cache.snapshot(),
                 "aot_cache": (self.aot.snapshot()
                               if self.aot is not None else None),
@@ -1106,6 +1169,23 @@ class SearchServer:
                 "requests": {rid: rec.snapshot()
                              for rid, rec in self.records.items()},
             }
+
+    def _failover_snapshot(self) -> dict | None:
+        """status_snapshot()'s `failover` key: None outside fleet mode
+        (snapshot parity with the PR-12 server), else lease + watcher
+        state — the doctor/dashboard columns and the health layer's
+        `peer_down` rule both read it."""
+        if (self.lease is None and self.watcher is None
+                and not self.fenced):
+            return None
+        out: dict = {"fenced": self.fenced,
+                     "fence_reason": self._fence_reason,
+                     "adopted": len(self._adopted)}
+        if self.lease is not None:
+            out["lease"] = self.lease.snapshot()
+        if self.watcher is not None:
+            out.update(self.watcher.snapshot())
+        return out
 
     # ------------------------------------------------------ crash recovery
     # (service/ledger: replaying the write-ahead journal at boot)
@@ -1227,6 +1307,222 @@ class SearchServer:
                        spent_s=round(rec.spent_prev_s, 3),
                        dispatches=rec.dispatches,
                        excluded=sorted(rec.excluded_submeshes))
+
+    # ------------------------------------------------------ fleet failover
+    # (service/lease + service/failover: fenced ownership and takeover)
+
+    def _self_fence(self, reason: str) -> None:
+        """This process no longer owns its ledger (epoch bumped by an
+        adopter). Stop committing: admission refuses with LeaseLost,
+        the scheduler tick exits cleanly, running requests stop at
+        their next segment boundary (their preempt journals no-op on
+        the fenced ledger — zero commits by construction). Idempotent;
+        fired by the lease keeper's renewal daemon or the ledger's
+        append-path check, whichever notices first."""
+        with self._lock:
+            if self.fenced:
+                return
+            self.fenced = True
+            self._fence_reason = reason
+            for slot in self.slots:
+                for rec in slot.records:
+                    if rec.stop_reason is None:
+                        rec.stop_reason = "fenced"
+                if slot.records and slot.stop_event is not None:
+                    slot.stop_event.set()
+        tracelog.event("server.fenced", reason=reason)
+
+    def _ckpt_fence_meta(self) -> dict:
+        """Fencing stamp for checkpoint meta. Raises LeaseLost before a
+        stale owner's save can even serialize; the epoch stamp it
+        returns makes engine/checkpoint refuse an epoch-stale overwrite
+        on top (the fence is in the data, not just the timing).
+        Vacuous ({}) outside fleet mode."""
+        if self.lease is None:
+            return {}
+        self.lease.check()
+        return {"lease_epoch": self.lease.epoch}
+
+    def adopt_ledger(self, orphan_dir: str,
+                     current_epoch: int | None = None) -> dict:
+        """Take over a dead peer's ledger (the FailoverWatcher's act
+        path; callable directly for drills). Protocol:
+
+        1. CAS the fencing epoch to ``current_epoch + 1`` through the
+           claim file — exactly one adopter; losing returns
+           ``{"outcome": "lost_race"}`` without touching the orphan.
+        2. Replay the orphan through the PR-12 boot path (the ledger
+           constructor truncates any torn tail to last-good) and
+           journal a ``takeover`` record at the NEW epoch — any stale
+           append the dead owner slips in afterwards is discarded on
+           every future replay.
+        3. Re-admit its QUEUED/ACTIVE requests HERE under fresh ids
+           (the orphan's ``req-NNNN`` ids collide with ours) with
+           budgets, exclusions, failure logs, spool ids and checkpoint
+           files intact; journal each into OUR ledger (a crash here
+           re-replays the adoption) and a ``forget`` tombstone into
+           the orphan (a rebooted original owner replays an empty live
+           set). DONE terminals register for idempotent tag re-serve.
+           The orphan's standing submesh quarantines are deliberately
+           NOT imported — they described the dead host's hardware.
+        4. Keep renewing the orphan's lease: a restarted stale owner
+           must find a LIVE foreign lease and boot fenced, and no
+           second peer may re-adopt. Released at close().
+        """
+        from . import lease as lease_mod
+        from . import spool as spool_mod
+        from .lease import LeaseKeeper
+        from .ledger import RequestLedger
+
+        orphan_dir = str(orphan_dir)
+        if current_epoch is None:
+            info = lease_mod.read_lease(orphan_dir)
+            current_epoch = info.epoch if info is not None else 0
+        keeper = LeaseKeeper(orphan_dir)
+        if not keeper.takeover(current_epoch):
+            tracelog.event("failover.lost_race", dir=orphan_dir,
+                           epoch=current_epoch + 1)
+            return {"outcome": "lost_race", "dir": orphan_dir}
+        moved = reserved = failed = 0
+        orphan = RequestLedger(orphan_dir, lease=keeper)
+        try:
+            orphan.journal("takeover", owner=keeper.owner,
+                           from_epoch=current_epoch, pid=os.getpid())
+            entries = sorted(orphan.state.requests.values(),
+                             key=lambda e: e.get("seq", 0))
+            for entry in entries:
+                try:
+                    if entry.get("state") in TERMINAL_STATES:
+                        if entry.get("state") == DONE \
+                                and self._adopt_terminal(entry,
+                                                         spool_mod):
+                            reserved += 1
+                        continue
+                    self._adopt_entry(entry, orphan_dir, spool_mod)
+                    orphan.journal("forget", rid=entry.get("rid"))
+                    moved += 1
+                except Exception as e:  # noqa: BLE001 — one
+                    # unparseable entry must not strand the rest of
+                    # the takeover (the _replay_boot stance)
+                    failed += 1
+                    tracelog.event("failover.adopt_entry_failed",
+                                   request_id=entry.get("rid"),
+                                   error=repr(e))
+        finally:
+            orphan.close()
+        self._adopted.append(keeper)
+        result = {"outcome": "adopted", "dir": orphan_dir,
+                  "epoch": keeper.epoch, "moved": moved,
+                  "reserved": reserved, "failed": failed}
+        tracelog.event("failover.adopted", **result)
+        return result
+
+    def _adopt_entry(self, entry: dict, orphan_dir: str,
+                     spool_mod) -> str:
+        """Re-admit one live orphan entry on THIS server — the
+        _readmit_replayed recipe under a fresh id, journaled into our
+        own ledger. The orphan's checkpoint family is copied into our
+        workdir first (never clobbering an existing one) so the resume
+        is lossless and budget-continuous."""
+        rid_old = entry["rid"]
+        req = spool_mod.request_from_payload(entry.get("payload") or {})
+        tag = entry.get("tag") or rid_old
+        req.tag = tag
+        src_dir = pathlib.Path(orphan_dir) / "workdir"
+        path = str(self.workdir / f"{tag}.ckpt.npz")
+        for suffix in ("", ".prev"):
+            src = src_dir / f"{tag}.ckpt.npz{suffix}"
+            dst = pathlib.Path(path + suffix)
+            if not src.exists() or dst.exists() or src == dst:
+                continue
+            try:
+                # copy to a unique temp then rename: our own executor
+                # must never read a half-copied snapshot
+                tmp = dst.with_name(f".{dst.name}.{os.getpid()}.tmp")
+                shutil.copy2(src, tmp)
+                os.replace(tmp, dst)
+            except OSError as e:
+                tracelog.event("failover.checkpoint_copy_failed",
+                               src=str(src), error=repr(e))
+        with self._lock:
+            seq = next(self._seq)
+            rid = f"req-{seq:04d}"
+            rec = RequestRecord(
+                id=rid, request=req, submitted_t=time.monotonic(),
+                seq=seq, checkpoint_path=path,
+                spent_prev_s=max(float(entry.get("spent_s") or 0.0),
+                                 _prior_spent_s(path)),
+                dispatches=int(entry.get("dispatches") or 0),
+                preemptions=int(entry.get("preemptions") or 0),
+                failures=int(entry.get("failures") or 0))
+            rec.failure_log = [dict(f) for f in
+                               entry.get("failure_log") or []]
+            excluded = {int(s) for s in entry.get("excluded") or []
+                        if 0 <= int(s) < len(self.slots)}
+            if len(excluded) >= len(self.slots):
+                excluded = set()
+            rec.excluded_submeshes = excluded
+            rec.error = entry.get("error")
+            if entry.get("state") == PREEMPTED and entry.get("hold"):
+                rec.state = PREEMPTED
+                rec.hold = True
+            else:
+                rec.state = QUEUED
+            self.records[rid] = rec
+            self._m_submitted.inc()
+            if self.ledger is not None:
+                self.ledger.journal(
+                    "admit", rid=rid, tag=tag, seq=seq,
+                    payload=spool_mod.payload_from_request(req),
+                    spool_id=entry.get("spool_id"),
+                    spent_s=round(rec.spent_prev_s, 3))
+                if rec.excluded_submeshes:
+                    self.ledger.journal(
+                        "exclude", rid=rid,
+                        excluded=sorted(rec.excluded_submeshes))
+            if rec.state == QUEUED:
+                self.queue.requeue(rec)
+        if entry.get("spool_id"):
+            self.replayed_spool[str(entry["spool_id"])] = rid
+        tracelog.event("request.adopted", request_id=rid,
+                       orphan_id=rid_old, tag=tag, state=rec.state,
+                       spent_s=round(rec.spent_prev_s, 3),
+                       spool_id=entry.get("spool_id"))
+        return rid
+
+    def _adopt_terminal(self, entry: dict, spool_mod) -> bool:
+        """Register a DONE orphan entry for idempotent re-serve: a
+        duplicate-tag submission (a crash-retried client) gets the
+        recorded result instead of a re-solve, exactly as it would
+        have from the dead owner. In-memory only — the orphan ledger
+        keeps the durable copy."""
+        tag = entry.get("tag") or entry.get("rid")
+        snap = entry.get("terminal") or {}
+        if snap.get("result") is None:
+            return False
+        with self._lock:
+            if any((r.request.tag or r.id) == tag
+                   for r in self.records.values()):
+                return False    # the tag already lives here
+            seq = next(self._seq)
+            rid = f"req-{seq:04d}"
+            req = spool_mod.request_from_payload(
+                entry.get("payload") or {})
+            req.tag = tag
+            rec = RequestRecord(
+                id=rid, request=req, submitted_t=time.monotonic(),
+                seq=seq,
+                checkpoint_path=str(self.workdir / f"{tag}.ckpt.npz"),
+                spent_prev_s=float(entry.get("spent_s") or 0.0))
+            rec.state = DONE
+            rec.result = _ReplayedResult(snap["result"])
+            rec.done_event.set()
+            self.records[rid] = rec
+        if entry.get("spool_id"):
+            self.replayed_spool[str(entry["spool_id"])] = rid
+        tracelog.event("request.adopted_terminal", request_id=rid,
+                       tag=tag, spool_id=entry.get("spool_id"))
+        return True
 
     def _ledger_budget(self, rec: RequestRecord) -> None:
         """Journal the request's cumulative execution clock, throttled
@@ -1399,6 +1695,11 @@ class SearchServer:
                 # check and here; dispatching now would start a search
                 # whose stop_event close() has already swept past —
                 # close(wait=True) would then block on the full solve
+                return
+            if self.fenced:
+                # a fenced scheduler tick exits cleanly: nothing may
+                # dispatch (every dispatch would journal, and a fenced
+                # ledger commits nothing) — the adopter serves instead
                 return
             now = time.monotonic()
             # 1. deadline enforcement on running requests. A batched
@@ -1715,6 +2016,7 @@ class SearchServer:
                 checkpoint_path=rec.checkpoint_path,
                 checkpoint_meta_extra=(lambda rec=rec: {
                     **(rec.request.checkpoint_meta or {}),
+                    **self._ckpt_fence_meta(),
                     "spent_s": round(rec.spent_s(), 2)}),
                 incumbent_key=ikey))
 
@@ -1767,6 +2069,25 @@ class SearchServer:
                     for rec in recs:
                         if rec.state == QUEUED:
                             self.queue.requeue(rec)
+            except (LeaseLost, checkpoint.StaleCheckpointError) as e:
+                # fenced mid-batch: every unhandled member preempts
+                # cleanly at this boundary (journals no-op on the
+                # fenced ledger) — the solo executor's fence path,
+                # batch-wide
+                with self._lock:
+                    for b, rec in enumerate(recs):
+                        if b in handled or rec.state in TERMINAL_STATES:
+                            continue
+                        rec.spent_prev_s = rec.spent_s()
+                        rec.started_t = None
+                        self._record_preempt(rec, "fenced")
+                        handled.add(b)
+                    slot.record = None
+                    slot.batch = None
+                    slot.stop_event = None
+                    slot.thread = None
+                self._self_fence(f"{type(e).__name__}: {e}")
+                return
             except checkpoint.TRANSIENT_ERRORS as e:
                 error = f"transient: {e!r}"      # retryable: no_retry
                 #                                  stays False
@@ -1977,9 +2298,30 @@ class SearchServer:
                         # server restarts and legacy<->serve handoffs
                         checkpoint_meta_extra=lambda: {
                             **(req.checkpoint_meta or {}),
+                            # fencing: raises LeaseLost / stamps the
+                            # epoch so a stale owner's save can never
+                            # land over the adopter's (vacuous outside
+                            # fleet mode)
+                            **self._ckpt_fence_meta(),
                             "spent_s": round(rec.spent_s(), 2)})
                     ex_span.set(tree=res.explored_tree, best=res.best,
                                 complete=res.complete)
+            except (LeaseLost, checkpoint.StaleCheckpointError) as e:
+                # fenced mid-dispatch (an adopter bumped our epoch):
+                # stop cleanly at this boundary — PREEMPTED with the
+                # journal no-op'ing on the fenced ledger, never FAILED.
+                # The adopter re-admitted the request from the ledger;
+                # our copy is a husk the operator restarts around.
+                with self._lock:
+                    rec.spent_prev_s = rec.spent_s()
+                    rec.started_t = None
+                    if rec.state not in TERMINAL_STATES:
+                        self._record_preempt(rec, "fenced")
+                    slot.record = None
+                    slot.stop_event = None
+                    slot.thread = None
+                self._self_fence(f"{type(e).__name__}: {e}")
+                return
             except checkpoint.TRANSIENT_ERRORS as e:
                 error = f"transient: {e!r}"
             except Exception as e:  # noqa: BLE001 — FAILED terminal below

@@ -165,7 +165,18 @@ class TuningCache:
 
     def _quarantine(self, path: pathlib.Path, error: str) -> None:
         self._count("_misses_c", "errors")
-        qpath = str(path) + QUARANTINE_SUFFIX
+        # per-writer unique target (same discipline as store()'s temp
+        # name): N processes quarantining corrupt incarnations of the
+        # SAME entry must not os.replace over each other's forensic
+        # copy — the suffix stays last so sweeps/tests keep matching.
+        # The existence loop is raceless: only THIS thread mints names
+        # under this pid-tid prefix
+        base = f"{path.name}.{os.getpid()}-{threading.get_ident()}"
+        qpath = str(path.with_name(base + QUARANTINE_SUFFIX))
+        n = 0
+        while os.path.exists(qpath):
+            n += 1
+            qpath = str(path.with_name(f"{base}.{n}{QUARANTINE_SUFFIX}"))
         try:
             os.replace(path, qpath)
             with self._lock:

@@ -299,6 +299,27 @@ LEDGER_BUDGET_EVERY_S_DEFAULT = 5.0   # seconds between journaled
 #                                       hard kill can lose without
 #                                       fsyncing at heartbeat rate)
 
+# Fleet failover (service/lease.py + service/failover.py + serve
+# --fleet-dir/--failover). Every server that opens a ledger also takes
+# a LEASE on it: an fsync'd CRC-stamped lease file (owner id,
+# monotonically increasing fencing epoch, TTL TTS_LEASE_TTL_S) renewed
+# by a daemon thread. TTS_FLEET_DIR names the shared root peers scan
+# for ledgers whose lease expired; TTS_FAILOVER=1 lets the
+# FailoverWatcher EXECUTE the takeover protocol (epoch CAS bump,
+# truncate-to-last-good, replay + re-admit on the survivor). The
+# default (off) is OBSERVE-ONLY: expired peers are journaled
+# (failover.peer_down) and surface on /alerts, zero takeovers run —
+# the TTS_REMEDIATE rollout discipline. Fencing makes split-brain safe
+# by construction: a stale owner discovers the bumped epoch at its
+# next append/save/renewal and self-fences (typed LeaseLost, zero
+# further commits).
+FAILOVER_FLAG = "TTS_FAILOVER"     # default off (observe)
+FLEET_DIR_ENV = "TTS_FLEET_DIR"
+LEASE_TTL_S_DEFAULT = 10.0         # TTS_LEASE_TTL_S — lease expiry age;
+#                                    renewals run at ~TTL/3, takeover
+#                                    scans at ~TTL/2 (adoption inside
+#                                    2x TTL, the drill's bound)
+
 # Request megabatching (engine/megabatch.py + service batch-former +
 # serve --megabatch). TTS_MEGABATCH=1 (STATIC per server; default off =
 # bit-identical to the solo scheduler) makes the admission queue a
@@ -508,6 +529,18 @@ KNOBS: dict[str, Knob] = _knob_table(
     Knob("TTS_BATCH_AGE_S", "float", BATCH_AGE_S_DEFAULT,
          "megabatch: close a forming batch once its oldest member has "
          "waited this long (a lone request closes as a batch of one)"),
+    # --- fleet failover (service/lease.py + service/failover.py;
+    #     semantics per README "High availability & failover")
+    Knob("TTS_FLEET_DIR", "str", None,
+         "serve: shared fleet root the FailoverWatcher scans for peer "
+         "ledgers whose lease expired (unset = no watcher)"),
+    Knob("TTS_FAILOVER", "flag", False,
+         "execute ledger takeovers of expired peers (default: "
+         "observe-only — peer_down detection and journaling run, zero "
+         "takeovers)"),
+    Knob("TTS_LEASE_TTL_S", "float", LEASE_TTL_S_DEFAULT,
+         "ledger-lease expiry age in seconds (renewed at ~TTL/3; an "
+         "unreachable owner is takeover-eligible past it)"),
     # --- self-healing (service/remediate.py; semantics per README
     #     "Self-healing")
     Knob("TTS_REMEDIATE", "flag", False,

@@ -66,16 +66,33 @@ programmatically via :func:`configure`:
                                              # segment boundaries, drains
                                              # every writer and exits 0
                                              # inside TTS_DRAIN_TIMEOUT_S
+    TTS_FAULTS="pause_server=2:12"           # at the start of segment 2,
+                                             # once: suspend this
+                                             # process's lease renewals
+                                             # (service/lease.py) AND
+                                             # sleep 12 s — a stalled-
+                                             # but-alive owner (GC pause,
+                                             # NFS hang). With the pause
+                                             # longer than TTS_LEASE_TTL_S
+                                             # a peer adopts the ledger
+                                             # mid-pause, and on waking
+                                             # the stale owner must
+                                             # SELF-FENCE at its next
+                                             # append/save — the split-
+                                             # brain drill the fencing
+                                             # epoch exists for
 
 The chaos-drill kinds (kill_submesh / oom_segment / wedge_executor /
-kill_server / sigterm_server) accept an optional ``@SUBMESH`` suffix: the injection fires only in a
+kill_server / sigterm_server / pause_server) accept an optional
+``@SUBMESH`` suffix: the injection fires only in a
 thread whose ambient flight-recorder context (obs/tracelog) carries
 that submesh index — so a GLOBAL plan can target one submesh of a
 serving mesh while requests on the other submeshes run clean, which is
 exactly the failure geometry the quarantine path exists for.
 kill_submesh and oom_segment also take a fire budget
 (``kill_submesh=SEG:BUDGET``, default 1) counted on the plan like
-fail_host_fetch; wedge_executor fires at most once per plan.
+fail_host_fetch; wedge_executor and pause_server fire at most once per
+plan.
 
 Specs compose: ``"delay_segment=2:0.1,kill_after_segment=4"``. Unknown
 names raise at parse time — a typo'd fault spec that silently injects
@@ -147,6 +164,10 @@ class FaultPlan:
     # SIGTERM to our own pid (the graceful-drain drill)
     kill_server: tuple[int, int, int | None] | None = None
     sigterm_server: tuple[int, int, int | None] | None = None
+    # split-brain drill: (segment, seconds, submesh|None) — suspend
+    # lease renewals AND wedge the thread for `seconds`, once: a
+    # stalled-but-alive owner whose lease expires under it
+    pause_server: tuple[int, float, int | None] | None = None
     # fire count lives ON the plan (not module state): a thread-scoped
     # plan must have its own injection budget — concurrent requests with
     # scoped plans would otherwise spend each other's failures
@@ -155,6 +176,7 @@ class FaultPlan:
     ooms_fired: int = dataclasses.field(default=0, repr=False)
     wedges_fired: int = dataclasses.field(default=0, repr=False)
     sigterms_fired: int = dataclasses.field(default=0, repr=False)
+    pauses_fired: int = dataclasses.field(default=0, repr=False)
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":
@@ -186,6 +208,8 @@ class FaultPlan:
                 plan.kill_server = _parse_drill(val, int, 1)
             elif name == "sigterm_server":
                 plan.sigterm_server = _parse_drill(val, int, 1)
+            elif name == "pause_server":
+                plan.pause_server = _parse_drill(val, float, 5.0)
             else:
                 raise ValueError(
                     f"unknown fault {name!r} in TTS_FAULTS spec {spec!r}")
@@ -332,6 +356,26 @@ def fire(point: str, segment: int | None = None, path=None) -> None:
             # dispatch does not honor stop flags either — recovery is
             # the remediation tier acting from outside, never the
             # wedge cooperating. Keep drill durations bounded.
+            time.sleep(seconds)
+        if (plan.pause_server is not None
+                and segment == plan.pause_server[0]
+                and plan.pauses_fired < 1
+                and _submesh_matches(plan.pause_server[2])):
+            plan.pauses_fired += 1
+            seconds = plan.pause_server[1]
+            _record(point, "pause_server", segment=segment,
+                    seconds=seconds, submesh=_ambient_submesh())
+            # the split-brain drill: stop renewing OUR lease(s), then
+            # wedge like wedge_executor — a GC pause / NFS hang where
+            # the process is alive but the lease expires under it. A
+            # peer adopts mid-pause; on waking, the next ledger append
+            # or checkpoint save must SELF-FENCE (LeaseLost), which is
+            # exactly what the drill's test asserts.
+            try:
+                from ..service import lease as _lease
+                _lease.suspend_renewals(seconds)
+            except ImportError:
+                pass   # engine-only install: plain wedge, still a drill
             time.sleep(seconds)
         if (plan.kill_submesh is not None
                 and segment == plan.kill_submesh[0]
