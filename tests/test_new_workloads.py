@@ -119,6 +119,112 @@ def test_knapsack_fractional_bound_dominates_dp():
             assert ub >= dp, (start, rem, ub, dp)
 
 
+# ------------------------------------------- stronger bound tiers (lb2)
+
+
+def test_tsp_one_tree_dominates_nn_sum():
+    """lb2 (Held–Karp 1-tree / MST relaxation) explores STRICTLY fewer
+    nodes than lb1 (NN-sum) on the same instance at the same optimum —
+    the tier exists to prune harder, and this pin is what keeps a
+    bound edit from silently weakening it into a slower lb1."""
+    inst = TSPInstance.synthetic(9, 2)
+    opt = inst.brute_force_optimum()
+    out1 = device.solve("tsp", inst.d, lb_kind=1, chunk=8,
+                        capacity=1 << 14)
+    out2 = device.solve("tsp", inst.d, lb_kind=2, chunk=8,
+                        capacity=1 << 14)
+    assert out1.complete and out2.complete
+    assert out1.best == opt and out2.best == opt
+    assert out2.explored_tree < out1.explored_tree
+
+
+def test_tsp_one_tree_admissible_on_random_nodes():
+    """The MST-relaxation bound never exceeds the best completion of
+    the node (brute-forced completions of random prefixes — the same
+    oracle harness the NN-sum tier is pinned by)."""
+    import itertools
+
+    inst = TSPInstance.synthetic(7, 5)
+    prob = __import__("tpu_tree_search.problems.tsp",
+                      fromlist=["PROBLEM"]).PROBLEM
+    rng = np.random.default_rng(1)
+    for _ in range(10):
+        rest = list(rng.permutation(np.arange(1, 7)))
+        depth = int(rng.integers(1, 6))
+        node = np.array([0] + rest, np.int16)
+        for child, cdepth, bound, is_leaf in prob.host_children(
+                inst.d, node, depth, 2**31 - 1, lb_kind=2):
+            fixed = [int(c) for c in child[:cdepth]]
+            free = [int(c) for c in child[cdepth:]]
+            best_completion = min(
+                inst.tour_length(np.array(fixed + list(tail)))
+                for tail in itertools.permutations(free)) \
+                if free else inst.tour_length(np.array(fixed))
+            assert bound <= best_completion, (node, depth, child)
+
+
+def test_knapsack_mt_bound_vs_dp_oracle():
+    """Martello–Toth sandwich: for every suffix subproblem the MT
+    upper bound is admissible (>= the DP optimum) AND no looser than
+    the Dantzig fractional bound it refines."""
+    from tpu_tree_search.problems.knapsack import _mt_ub
+
+    inst = KnapsackInstance.synthetic(12, 7)
+    w, v, cap, _ = _sorted_items(inst.table)
+    for start in range(len(w)):
+        for rem in (0, cap // 3, cap):
+            mt = _mt_ub(w, v, start, rem)
+            dz = _fractional_ub(w, v, start, rem)
+            dp = KnapsackInstance(weights=w[start:], values=v[start:],
+                                  capacity=rem).optimum()
+            assert dp <= mt <= dz, (start, rem, dp, mt, dz)
+
+
+def test_knapsack_mt_solves_exactly_with_no_more_nodes():
+    """lb2 (MT) reaches the same DP optimum while never exploring more
+    nodes than lb1 (Dantzig) — MT <= Dantzig pointwise, so its tree is
+    a subset."""
+    inst = KnapsackInstance.synthetic(18, 2)
+    out1 = device.solve("knapsack", inst.table, lb_kind=1, chunk=8,
+                        capacity=1 << 14)
+    out2 = device.solve("knapsack", inst.table, lb_kind=2, chunk=8,
+                        capacity=1 << 14)
+    assert out1.complete and out2.complete
+    assert -out1.best == -out2.best == inst.optimum()
+    assert out2.explored_tree <= out1.explored_tree
+
+
+# ------------------------------------------- `-C` host tier (plugin opt-in)
+
+
+def test_tsp_host_tier_matches_brute_force():
+    inst = TSPInstance.synthetic(8, 3)
+    res = distributed.search(inst.d, problem="tsp", n_devices=2,
+                             chunk=8, capacity=1 << 14, min_seed=8,
+                             host_fraction=1)
+    assert res.complete and res.best == inst.brute_force_optimum()
+
+
+def test_knapsack_host_tier_matches_dp():
+    inst = KnapsackInstance.synthetic(14, 1)
+    res = distributed.search(inst.table, problem="knapsack",
+                             n_devices=2, chunk=8, capacity=1 << 14,
+                             min_seed=8, host_fraction=1)
+    assert res.complete and -res.best == inst.optimum()
+
+
+def test_host_tier_refused_without_plugin_support():
+    """host_fraction > 0 on a plugin without a host tier fails FAST
+    with the typed refusal, not deep in the engine."""
+    from tpu_tree_search.problems import nqueens as nq
+    from tpu_tree_search.problems.base import HostTierUnsupported
+
+    with pytest.raises(HostTierUnsupported):
+        distributed.search(nq.table(6), problem="nqueens", n_devices=2,
+                           chunk=8, capacity=1 << 12, min_seed=8,
+                           host_fraction=1)
+
+
 def test_knapsack_infeasible_take_never_pushed():
     """Zero-capacity instance: no item fits, optimum 0, and the tree
     contains only skip chains."""

@@ -1,0 +1,327 @@
+"""Bound-portfolio racing (service/portfolio) on the virtual 8-device
+CPU mesh.
+
+The race contract, pinned deterministically:
+
+- a ``portfolio: K`` request fans out K distinct-config members naming
+  ONE share group; the FIRST member DONE wins, the parent finalizes
+  DONE with the winner's result (bit-identical to the instance
+  optimum), and every loser cancels through the member-level stop path
+  — with ZERO post-proof dispatches (trace-pinned: no member dispatch
+  event after the ``portfolio.win`` instant);
+- the race costs STRICTLY fewer total bound evaluations than the K
+  solo runs it replaces (the shared incumbent board at work), and no
+  more wall-clock than the sequential K-config sweep (on a box with
+  fewer cores than members the submeshes time-slice one CPU, so the
+  sequential sum — not the best member's solo wall — is the honest
+  reference; on real parallel hardware that assertion is strictly
+  weaker than the race-≈-best-member bar, so it stays valid there);
+- portfolio OFF is the exact pre-portfolio path: node counts
+  bit-identical to standalone ``distributed.search`` at the submesh
+  worker count, no race state, no portfolio ledger records;
+- the race is crash-durable: a ledger restart mid-race re-arms and
+  converges to the bit-identical optimum, and a restart AFTER the win
+  re-serves the recorded winner without re-running anything.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+from tpu_tree_search.engine import distributed
+from tpu_tree_search.obs import metrics, tracelog
+from tpu_tree_search.problems import get as get_problem
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import (AdmissionError, SearchRequest,
+                                     SearchServer)
+from tpu_tree_search.service.portfolio import plan_members
+from tpu_tree_search.service.request import TERMINAL_STATES
+from tpu_tree_search.service.spool import (payload_from_request,
+                                           request_from_payload)
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+def small(seed, jobs=7):
+    return PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+
+
+@pytest.fixture
+def fresh_obs():
+    log = tracelog.TraceLog(capacity=1 << 16)
+    prev_log = tracelog.install(log)
+    reg = metrics.Registry()
+    prev_reg = metrics.install(reg)
+    try:
+        yield log, reg
+    finally:
+        tracelog.install(prev_log)
+        metrics.install(prev_reg)
+
+
+# ------------------------------------------------------------ plan_members
+
+
+def test_plan_members_deterministic_distinct_and_baseline_preserving():
+    req = SearchRequest(p_times=small(0).p_times, lb_kind=1,
+                        chunk=64, balance_period=4)
+    prob = get_problem("pfsp")
+    plan = plan_members(req, prob, 4, parent_tag="t")
+    assert len(plan) == 4
+    # member 0 is the request's OWN config verbatim: racing can only
+    # add information, never lose the run the client asked for
+    m0, c0 = plan[0]
+    assert (m0.lb_kind, m0.chunk, m0.balance_period) == (1, 64, 4)
+    assert c0["source"] == "request"
+    # every member: the one shared group, the parent-derived tag, no
+    # recursive fan-out
+    for i, (m, c) in enumerate(plan):
+        assert m.share_group == "pf:t" and m.portfolio is None
+        assert m.tag == f"t.pf{i}" and c["tag"] == m.tag
+    # tiers cycle starting from the request's own; configs all distinct
+    assert [c["lb_kind"] for _, c in plan[:3]] == \
+        [1] + [lb for lb in prob.lb_kinds if lb != 1]
+    assert len({(c["lb_kind"], c["chunk"], c["balance_period"])
+                for _, c in plan}) == 4
+    # determinism: same inputs, same plan
+    again = plan_members(req, prob, 4, parent_tag="t")
+    assert [c for _, c in again] == [c for _, c in plan]
+
+
+def test_portfolio_request_validation():
+    table = small(0).p_times
+    # 0/1 normalize to None (solo path); negatives/oversize reject
+    assert SearchRequest(p_times=table, portfolio=0).portfolio is None
+    assert SearchRequest(p_times=table, portfolio=1).portfolio is None
+    assert SearchRequest(p_times=table, portfolio=2).validate() is None
+    assert "portfolio" in SearchRequest(p_times=table,
+                                        portfolio=-3).validate()
+    assert "portfolio" in SearchRequest(p_times=table,
+                                        portfolio=999).validate()
+    # a racing fault drill would inject K-fold: refused
+    assert "faults" in SearchRequest(p_times=table, portfolio=2,
+                                     faults="delay_every=1").validate()
+
+
+def test_portfolio_payload_roundtrip():
+    req = SearchRequest(p_times=small(0).p_times, lb_kind=1,
+                        portfolio=3, tag="t", **KW)
+    pay = payload_from_request(req)
+    assert pay["portfolio"] == 3
+    back = request_from_payload(pay)
+    assert back.portfolio == 3
+    # and absent stays absent — the off-path payload is unchanged
+    solo = payload_from_request(dataclasses.replace(req, portfolio=None))
+    assert "portfolio" not in solo
+
+
+# ------------------------------------------------------------ the race
+
+
+def test_portfolio_race_wins_cancels_and_never_dispatches_post_proof(
+        fresh_obs):
+    log, _ = fresh_obs
+    inst = small(3, jobs=8)
+    opt = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             n_devices=4, **KW).best
+    srv = SearchServer(n_submeshes=2, share_incumbent=True)
+    try:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       portfolio=3, tag="race", **KW))
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DONE"
+        assert int(rec.result.best) == int(opt)     # bit-identical
+        assert rec.portfolio_winner in rec.portfolio_members
+        assert rec.portfolio_config is not None
+        # parent snapshot carries the race block; members their side
+        snap = rec.snapshot()["portfolio"]
+        assert snap["k"] == 3 and snap["winner"] == rec.portfolio_winner
+        # losers all reach a terminal state (cancel lands at the next
+        # segment boundary for a running loser)
+        for mrid in rec.portfolio_members:
+            m = srv.result(mrid, timeout=120)
+            assert m.state in TERMINAL_STATES
+            assert srv.records[mrid].portfolio_parent == rid
+        assert rec.portfolio_cancelled >= 1         # 3 racers, 2 slots
+    finally:
+        srv.close()
+    # zero post-proof dispatches, pinned from the flight recorder: no
+    # member dispatch strictly after the win instant
+    recs = log.records()
+    win = next(r for r in recs if r["name"] == "portfolio.win")
+    fanout = next(r for r in recs if r["name"] == "portfolio.fanout")
+    member_rids = {m["rid"] for m in fanout["members"]}
+    late = [r for r in recs
+            if r["name"] == "request.dispatch"
+            and r.get("request_id") in member_rids
+            and r["ts"] > win["ts"]]
+    assert late == [], late
+
+
+def test_portfolio_beats_solo_sweep_on_evals_and_wall():
+    """The acceptance ledger: racing K configs with a shared board
+    costs STRICTLY fewer total bound evals than running the K solos,
+    and no more wall than the sequential sweep, at the bit-identical
+    optimum."""
+    inst = PFSPInstance.synthetic(jobs=11, machines=5, seed=7)
+    base = SearchRequest(p_times=inst.p_times, lb_kind=1, chunk=128,
+                         capacity=1 << 16, min_seed=64,
+                         segment_iters=32)
+    srv = SearchServer(n_submeshes=4, share_incumbent=True)
+    try:
+        plan = plan_members(base, get_problem("pfsp"), 3,
+                            parent_tag="sweep")
+        solo_walls, solo_evals, bests = [], [], []
+        for lap in ("warm", "timed"):       # warm lap pays compiles
+            solo_walls, solo_evals, bests = [], [], []
+            for i, (mreq, _) in enumerate(plan):
+                sreq = dataclasses.replace(
+                    mreq, share_group=f"solo-{lap}-{i}",
+                    tag=f"{lap}-{i}")
+                t0 = time.perf_counter()
+                rec = srv.result(srv.submit(sreq), timeout=300)
+                solo_walls.append(time.perf_counter() - t0)
+                assert rec.state == "DONE"
+                solo_evals.append(int(rec.result.explored_tree))
+                bests.append(int(rec.result.best))
+        assert len(set(bests)) == 1          # every tier, same optimum
+        t0 = time.perf_counter()
+        rec = srv.result(
+            srv.submit(dataclasses.replace(base, portfolio=3,
+                                           tag="the-race")),
+            timeout=300)
+        race_wall = time.perf_counter() - t0
+        assert rec.state == "DONE"
+        assert int(rec.result.best) == bests[0]       # bit-identical
+        for mrid in rec.portfolio_members:            # losers settle
+            srv.result(mrid, timeout=120)
+        race_evals = sum(
+            int(m.result.explored_tree)
+            for m in (srv.records[rid] for rid in rec.portfolio_members)
+            if m.result is not None)
+        assert race_evals < sum(solo_evals), \
+            (race_evals, solo_evals)
+        assert race_wall <= 1.15 * sum(solo_walls), \
+            (race_wall, solo_walls)
+    finally:
+        srv.close()
+
+
+def test_portfolio_off_is_exact_pre_portfolio_path(fresh_obs):
+    """No ``portfolio`` on the request (and no env default): node
+    counts bit-identical to standalone distributed.search at the
+    submesh worker count, zero race state, zero race trace events."""
+    log, _ = fresh_obs
+    inst = small(0)
+    base = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                              n_devices=4, **KW)
+    srv = SearchServer(n_submeshes=2)
+    try:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DONE"
+        assert (rec.result.explored_tree, rec.result.explored_sol,
+                rec.result.best) == (base.explored_tree,
+                                     base.explored_sol, base.best)
+        assert rec.portfolio_members is None
+        assert rec.portfolio_parent is None
+        assert "portfolio" not in rec.snapshot()
+        assert srv.portfolio.races == {}
+    finally:
+        srv.close()
+    assert not [r for r in log.records()
+                if r["name"].startswith("portfolio.")]
+
+
+def test_portfolio_env_default_fans_out_and_max_caps(monkeypatch):
+    """TTS_PORTFOLIO=K races requests that did not ask; the admission
+    cap TTS_PORTFOLIO_MAX clamps it. The resolved K is pinned onto the
+    journaled request so replay re-races identically."""
+    monkeypatch.setenv("TTS_PORTFOLIO", "5")
+    monkeypatch.setenv("TTS_PORTFOLIO_MAX", "2")
+    inst = small(1)
+    srv = SearchServer(n_submeshes=2, share_incumbent=True)
+    try:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DONE"
+        assert len(rec.portfolio_members) == 2       # capped
+        assert rec.request.portfolio == 2            # pinned for replay
+        # members must not recurse into their own races
+        for mrid in rec.portfolio_members:
+            assert srv.records[mrid].portfolio_members is None
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------------------ durability
+
+
+def crash(srv):
+    """Hard-death stand-in (same shape as test_ledger.crash): stop the
+    daemons without close()'s graceful cancellation sweep."""
+    srv._closing.set()
+    with srv._lock:
+        for slot in srv.slots:
+            for rec in slot.records:
+                if rec is not None and rec.stop_reason is None:
+                    rec.stop_reason = "shutdown"
+            if slot.stop_event is not None:
+                slot.stop_event.set()
+    for slot in srv.slots:
+        if slot.thread is not None:
+            slot.thread.join(timeout=60)
+    if srv._scheduler is not None:
+        srv._scheduler.join(timeout=60)
+
+
+def test_portfolio_race_replays_across_restart(tmp_path):
+    inst = small(3, jobs=8)
+    opt = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             n_devices=4, **KW).best
+    wd, ld = tmp_path / "wd", tmp_path / "led"
+    # boot 1: admit the race but never run it (autostart=False), then
+    # die hard — only the ledger knows the race exists
+    srv = SearchServer(n_submeshes=2, workdir=wd, ledger_dir=str(ld),
+                       autostart=False, share_incumbent=True)
+    rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                   portfolio=3, tag="race", **KW))
+    members_before = list(srv.records[rid].portfolio_members)
+    crash(srv)
+
+    # boot 2: replay re-arms the race (parent unqueued, members
+    # requeued) and runs it to the bit-identical optimum
+    srv2 = SearchServer(n_submeshes=2, workdir=wd, ledger_dir=str(ld),
+                        share_incumbent=True)
+    try:
+        rec = srv2.records[rid]
+        assert rec.portfolio_members == members_before
+        assert rid in srv2.portfolio.races
+        out = srv2.result(rid, timeout=300)
+        assert out.state == "DONE"
+        assert int(out.result.best) == int(opt)
+        winner = out.portfolio_winner
+        for mrid in members_before:
+            srv2.result(mrid, timeout=120)
+    finally:
+        srv2.close()
+
+    # boot 3: the finished race replays terminal — recorded winner and
+    # result, zero fresh work, and the tag re-serves idempotently
+    srv3 = SearchServer(n_submeshes=2, workdir=wd, ledger_dir=str(ld),
+                        share_incumbent=True)
+    try:
+        rec3 = srv3.records[rid]
+        assert rec3.state == "DONE"
+        assert int(rec3.result.best) == int(opt)
+        assert rec3.portfolio_winner == winner
+        assert rec3.portfolio_config is not None
+        again = srv3.submit(SearchRequest(p_times=inst.p_times,
+                                          lb_kind=1, portfolio=3,
+                                          tag="race", **KW))
+        assert again == rid
+    finally:
+        srv3.close()

@@ -99,6 +99,11 @@ def row_mode(row: dict):
         # a batched requests/s figure must never rate-judge against
         # solo serving history — different execution modes entirely
         return ("megabatch", row["megabatch"])
+    if row.get("portfolio") is not None:
+        # the portfolio-speedup family (service/portfolio): a K=3
+        # race ratio must never be judged against a differently-sized
+        # race's history — cross-width rows SKIP, never FAIL
+        return ("portfolio", row["portfolio"])
     if row.get("fused") is not None:
         # the fused Pallas bound+prune+compact route (TTS_FUSED,
         # ops/pallas_fused): a fused step's allocation profile or rate

@@ -66,7 +66,11 @@ def summarize(records: list[dict]) -> dict[str, dict]:
             "terminal_ts": None, "dispatches": 0, "preemptions": 0,
             "checkpoints": 0, "retries": 0, "faults": 0, "exec_s": 0.0,
             "failures": 0, "failure_log": [], "remediations": 0,
-            "submeshes": set()})
+            "submeshes": set(),
+            # bound-portfolio racing (service/portfolio): set on the
+            # PARENT row by the portfolio.fanout / portfolio.win events
+            "pf_k": None, "pf_winner": None, "pf_config": None,
+            "pf_cancelled": None})
 
     for r in sorted(records, key=lambda r: (r.get("ts", 0.0),
                                             r.get("seq", 0))):
@@ -113,6 +117,12 @@ def summarize(records: list[dict]) -> dict[str, dict]:
                 {"submesh": r.get("submesh"),
                  "attempt": r.get("attempt"),
                  "error": r.get("error")})
+        elif name == "portfolio.fanout":
+            s["pf_k"] = r.get("k")
+        elif name == "portfolio.win":
+            s["pf_winner"] = r.get("winner")
+            s["pf_config"] = r.get("config")
+            s["pf_cancelled"] = r.get("cancelled")
         elif name.startswith("remediation."):
             s["remediations"] += 1
         elif name.startswith("request.") \
@@ -126,7 +136,8 @@ def summarize(records: list[dict]) -> dict[str, dict]:
 def render(reqs: dict[str, dict]) -> str:
     hdr = (f"{'request':<10} {'state':<9} {'wait_s':>8} {'latency_s':>10} "
            f"{'exec_s':>8} {'disp':>4} {'pre':>4} {'fail':>4} "
-           f"{'ckpt':>4} {'retry':>5}  submeshes")
+           f"{'ckpt':>4} {'retry':>5} {'sibs':>4} {'winner':<9} "
+           f"{'cxl':>3}  submeshes")
     lines = [hdr, "-" * len(hdr)]
 
     def f(a, b):
@@ -142,7 +153,10 @@ def render(reqs: dict[str, dict]) -> str:
             f"{s['exec_s']:>8.3f} {s['dispatches']:>4} "
             f"{s['preemptions']:>4} {s['failures']:>4} "
             f"{s['checkpoints']:>4} "
-            f"{s['retries']:>5}  "
+            f"{s['retries']:>5} "
+            f"{str(s['pf_k']) if s['pf_k'] is not None else '-':>4} "
+            f"{s['pf_winner'] or '-':<9} "
+            f"{str(s['pf_cancelled']) if s['pf_cancelled'] is not None else '-':>3}  "
             f"{sorted(s['submeshes'])}")
     n_pre = sum(s["preemptions"] for s in rows.values())
     n_fail = sum(s["failures"] for s in rows.values())
@@ -150,6 +164,17 @@ def render(reqs: dict[str, dict]) -> str:
     lines.append(f"{len(rows)} request(s), {n_pre} preemption(s), "
                  f"{n_fail} dispatch failure(s), "
                  f"{n_rem} remediation record(s)")
+    # the per-race story of every portfolio parent: siblings raced,
+    # winning config, losers cancelled (the win event's full payload —
+    # the table columns above are the compressed view)
+    for rid in sorted(rows):
+        s = rows[rid]
+        if s["pf_k"] is None:
+            continue
+        lines.append(f"\nportfolio {rid}: siblings={s['pf_k']} "
+                     f"winner={s['pf_winner'] or '-'} "
+                     f"cancelled={s['pf_cancelled']} "
+                     f"winner_config={s['pf_config']}")
     # the per-failure story for anything that failed (a dead-lettered
     # request's trail: which submesh, which attempt, which error)
     for rid in sorted(rows):

@@ -482,6 +482,11 @@ def _client_parser(sub):
     p.add_argument("--tag", type=str, default=None,
                    help="checkpoint tag; resubmitting a DEADLINE "
                         "request's tag with a larger budget extends it")
+    p.add_argument("--portfolio", type=int, default=None, metavar="K",
+                   help="bound-portfolio racing: fan out as K sibling "
+                        "configs (bound tiers, tuned chunk plans) "
+                        "sharing one incumbent board; first proof "
+                        "wins, losers cancel (service/portfolio.py)")
     p.add_argument("--timeout", type=float, default=None,
                    help="give up waiting for the result after N seconds")
 
@@ -722,6 +727,8 @@ def run_client(args) -> int:
                "tag": args.tag}
     if args.lb is not None:
         payload["lb"] = args.lb
+    if args.portfolio is not None:
+        payload["portfolio"] = args.portfolio
     if args.problem == "pfsp" and args.inst is not None:
         payload["inst"] = args.inst
         payload["ub"] = "opt" if args.ub == 1 else None
@@ -893,6 +900,9 @@ def run_doctor(args) -> int:
                 led_col = (f" restarts={s.get('restarts')}"
                            f" recovered={s.get('recovered_requests')}"
                            f" ledger_lag_s={s.get('ledger_lag_s')}")
+            pf = s.get("portfolio")
+            pf_col = (f" portfolio={pf['active']}a/{pf['won']}w"
+                      f"/{pf['cancelled_members']}cxl" if pf else "")
             fo_col = ""
             if s.get("failover_mode") is not None or s.get("fenced"):
                 fo_col = (f" failover={s.get('failover_mode')}"
@@ -905,7 +915,7 @@ def run_doctor(args) -> int:
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
                   f"requests={s.get('requests')}{aot_col}{rem_col}"
-                  f"{led_col}{fo_col}")
+                  f"{pf_col}{led_col}{fo_col}")
         for r in lease_report or []:
             state = ("released" if r["released"] else
                      "EXPIRED" if r["expired"] else "live")

@@ -1006,9 +1006,11 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     executable/tuning/checkpoint key carries the problem name. A
     checkpoint records its problem and a cross-problem resume is
     REFUSED — a pool of TSP tours re-homed under a PFSP step would be
-    silent garbage. The `-C` host tier is a PFSP-only capability
-    (plugin.supports_host_tier); passing host_fraction > 0 for another
-    problem raises."""
+    silent garbage. The `-C` host tier follows plugin opt-in
+    (supports_host_tier): PFSP gets the native runtime, TSP/knapsack
+    the generic host_children session (hybrid.PyHostSession);
+    host_fraction > 0 for a problem without one raises the typed
+    problems/base.HostTierUnsupported."""
     from ..utils import config as _cfg
     from . import checkpoint, hybrid, incumbent as inc_mod
 
@@ -1019,9 +1021,8 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
     n_dev = mesh.devices.size
     jobs = prob.slots(table)
     if host_fraction > 0 and not prob.supports_host_tier:
-        raise ValueError(
-            f"the -C host tier is not supported for problem "
-            f"{prob.name!r} (native host kernels are PFSP-only)")
+        from ..problems.base import HostTierUnsupported
+        raise HostTierUnsupported(prob.name)
     rung_profile = None
     fused_mode = pallas_fused.resolve_mode(None)
     if chunk is None or balance_period is None:
@@ -1171,13 +1172,13 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
                 host_state, h_prmu, h_depth = hybrid.pop_host_share(
                     host_state, host_fraction)
             if len(h_depth):
-                session = hybrid.HostSession(
-                    table, h_prmu, h_depth, lb_kind,
+                session = hybrid.make_session(
+                    prob, table, h_prmu, h_depth, lb_kind,
                     int(np.asarray(host_state.best).min()),
                     n_threads=host_threads)
         elif len(saved_d):
             host_state = hybrid.restore_host_share(
-                host_state, saved_p, saved_d, table)
+                host_state, saved_p, saved_d, table, problem=prob)
         fr = Frontier(prmu=np.zeros((0, jobs), np.int16),
                       depth=np.zeros(0, np.int16),
                       tree=int(meta.get("warmup_tree", 0)),
@@ -1195,9 +1196,9 @@ def search(p_times: np.ndarray, lb_kind: int = 1, init_ub: int | None = None,
         dmask, h_prmu, h_depth = hybrid.split_host_share(
             fr.prmu, fr.depth, host_fraction)
         if len(h_depth):
-            session = hybrid.HostSession(table, h_prmu, h_depth,
-                                         lb_kind, init_best,
-                                         n_threads=host_threads)
+            session = hybrid.make_session(prob, table, h_prmu, h_depth,
+                                          lb_kind, init_best,
+                                          n_threads=host_threads)
             fr.prmu, fr.depth = fr.prmu[dmask], fr.depth[dmask]
         fr.aux = prob.seed_aux(table, fr.prmu, fr.depth)
         state = driver.seed(fr, capacity, jobs, init_best)

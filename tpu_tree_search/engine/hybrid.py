@@ -98,6 +98,107 @@ class HostSession:
         return state
 
 
+class PyHostSession:
+    """Generic host tier: the same concurrent-session API as
+    :class:`HostSession`, but a Python DFS thread over the problem
+    plugin's `host_children` oracle instead of the native PFSP
+    runtime. Any plugin that sets `supports_host_tier` and implements
+    `host_children` gets `-C` for free (TSP, knapsack); PFSP keeps the
+    native session (this one would be ~100x slower on its kernels).
+    `n_threads` is accepted for signature parity and ignored — a GIL
+    DFS gains nothing from more threads, and exactly-once accounting
+    stays trivial with one."""
+
+    def __init__(self, problem, table, prmu, depth, lb_kind: int,
+                 init_ub: int, n_threads: int = 0):
+        import threading
+
+        del n_threads
+        self._prob = problem
+        self._table = np.asarray(table)
+        self._lb_kind = int(lb_kind)
+        self._lock = threading.Lock()
+        self._best = int(init_ub)
+        self._stack = [(np.asarray(p, np.int16), int(d))
+                       for p, d in zip(np.asarray(prmu),
+                                       np.asarray(depth))]
+        self.seeded = int(len(depth))
+        self.exchanges = self.host_improved = self.dev_improved = 0
+        self.joined = None
+        self._tree = self._sol = self._expanded = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        prob, table, lb = self._prob, self._table, self._lb_kind
+        slots = prob.slots(table)
+        stack, leaf_in_evals = self._stack, prob.leaf_in_evals
+        while stack:
+            node, depth = stack.pop()
+            self._expanded += 1
+            if not leaf_in_evals and depth == slots:
+                self._sol += 1
+                continue
+            best = self._best      # one snapshot per expansion
+            for child, cdepth, bound, is_leaf in prob.host_children(
+                    table, node, depth, best, lb_kind=lb):
+                if leaf_in_evals and is_leaf:
+                    self._sol += 1
+                    if bound < best:
+                        with self._lock:
+                            if bound < self._best:
+                                self._best = bound
+                        best = min(best, bound)
+                elif bound < best:
+                    stack.append((child, cdepth))
+                    self._tree += 1
+
+    def merge(self, dev_best: int) -> int:
+        """Two-way exchange, same contract as the native session."""
+        with self._lock:
+            host_best = self._best
+            merged = min(int(dev_best), host_best)
+            self._best = merged
+        self.exchanges += 1
+        if host_best < dev_best:
+            self.host_improved += 1
+        elif dev_best < host_best:
+            self.dev_improved += 1
+        return merged
+
+    def offer(self, best: int) -> None:
+        with self._lock:
+            self._best = min(self._best, int(best))
+
+    def join(self):
+        """(tree, sol, best, expanded); idempotent, blocks until the
+        DFS thread drains its subtree."""
+        if self.joined is None:
+            self._thread.join()
+            self.joined = (self._tree, self._sol, self._best,
+                           self._expanded)
+        return self.joined
+
+    post_segment = HostSession.post_segment
+
+
+def make_session(problem, table, prmu, depth, lb_kind: int,
+                 init_ub: int, n_threads: int = 0):
+    """The `-C` session factory: native runtime for PFSP, the generic
+    Python session for any other opted-in plugin, a typed refusal
+    otherwise (problems/base.HostTierUnsupported — callers surface it
+    as a rejection, not a crash)."""
+    from ..problems import base as problems_base
+
+    if not problem.supports_host_tier:
+        raise problems_base.HostTierUnsupported(problem.name)
+    if problem.name == "pfsp":
+        return HostSession(table, prmu, depth, lb_kind, init_ub,
+                           n_threads=n_threads)
+    return PyHostSession(problem, table, prmu, depth, lb_kind, init_ub,
+                         n_threads=n_threads)
+
+
 def split_host_share(prmu, depth, host_fraction: int):
     """Stride-split a frontier (roundRobin_distribution semantics,
     multigpu:159-263): every host_fraction-th node goes to the host
@@ -111,27 +212,30 @@ def split_host_share(prmu, depth, host_fraction: int):
     return ~hmask, prmu[hmask], depth[hmask]
 
 
-def restore_host_share(host_state, h_prmu, h_depth, p_times):
+def restore_host_share(host_state, h_prmu, h_depth, p_times,
+                       problem=None):
     """Resume WITHOUT `-C` of a checkpoint whose host tier held carved
     nodes (they ride the checkpoint meta — see the search drivers): push
     them back into the least-loaded pool so no subtree is lost. The aux
-    rows are recomputed from the permutations."""
+    rows are recomputed from the permutations via the problem plugin's
+    `seed_aux` (default PFSP for pre-plugin callers)."""
     import jax.numpy as jnp
-
-    from ..ops import reference as ref
 
     n = len(h_depth)
     if n == 0:
         return host_state
+    if problem is None:
+        from ..problems import get as _get_problem
+        problem = _get_problem("pfsp")
     prmu = np.asarray(host_state.prmu).copy()
     depth = np.asarray(host_state.depth).copy()
     aux = np.asarray(host_state.aux).copy()
     size = np.atleast_1d(np.asarray(host_state.size)).copy()
     stacked = prmu.ndim == 3
     M = aux.shape[-2]
-    rows = ref.prefix_front_remain(
+    rows = np.asarray(problem.seed_aux(
         np.asarray(p_times), np.asarray(h_prmu),
-        np.asarray(h_depth))[:, :M]
+        np.asarray(h_depth)))[:, :M]
     w = int(size.argmin())
     s = int(size[w])
     if s + n > prmu.shape[-1]:

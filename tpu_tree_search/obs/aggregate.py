@@ -177,7 +177,10 @@ def merge(fleet: dict) -> dict:
                # mode (snapshot parity with a PR-12 server)
                "fenced": None, "lease_epoch": None,
                "failover_mode": None, "peers_down": None,
-               "takeovers": None}
+               "takeovers": None,
+               # bound-portfolio racing (service/portfolio): None on a
+               # server that never raced (snapshot parity)
+               "portfolio": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -218,6 +221,11 @@ def merge(fleet: dict) -> dict:
                     row["peers_down"] = sum(
                         1 for p in peers
                         if p.get("expired") and not p.get("released"))
+            # the portfolio-racing totals (service/portfolio): active/
+            # won races and members cancelled at first proof — the
+            # doctor's portfolio column; per-race winner configs ride
+            # each parent request snapshot's `portfolio` block below
+            row["portfolio"] = st.get("portfolio")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             for rid, snap in reqs.items():

@@ -65,6 +65,14 @@ REGISTRY: dict[str, Metric] = _table(
            "requests per closed batch"),
     Metric("tts_batch_requests_total", "counter", "",
            "requests dispatched through a multi-request batch"),
+    # --- bound-portfolio racing (service/portfolio)
+    Metric("tts_portfolio_races_total", "counter", "outcome",
+           "portfolio races by outcome (won/deadline/cancelled/"
+           "failed)"),
+    Metric("tts_portfolio_members_total", "counter", "role",
+           "portfolio members by terminal role (winner/lost_*)"),
+    Metric("tts_portfolio_active", "gauge", "",
+           "portfolio races currently unresolved"),
     Metric("tts_queue_depth", "gauge", "", "live admission-queue depth"),
     Metric("tts_queue_peak_depth", "gauge", "",
            "high-water queue depth since server start"),

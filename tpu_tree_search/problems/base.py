@@ -58,6 +58,21 @@ import numpy as np
 I32_MAX = 2**31 - 1
 
 
+class HostTierUnsupported(ValueError):
+    """Typed refusal for `-C host_fraction > 0` on a problem whose
+    plugin has no host tier (`Problem.supports_host_tier` is False).
+    Subclasses ValueError so pre-existing callers that caught the old
+    untyped refusal keep working; service admission and the CLI match
+    on the type to reject the request instead of crashing the worker."""
+
+    def __init__(self, problem: str):
+        self.problem = problem
+        super().__init__(
+            f"the -C host tier is not supported for problem "
+            f"{problem!r} (no host_children/host-session support; "
+            f"set supports_host_tier on the plugin to enable it)")
+
+
 class BranchOut(NamedTuple):
     """One step's dense child grid, feature-major like the pool.
 
@@ -88,9 +103,11 @@ class Problem:
     # surviving children are pushed (complete nodes included), a popped
     # complete node counts as a solution; branched + pruned == evals.
     leaf_in_evals: bool = True
-    # the -C heterogeneous native host tier (engine/hybrid) is a
-    # PFSP-only capability until the native runtime grows per-problem
-    # kernels; the engine rejects host_fraction > 0 for others
+    # the -C heterogeneous host tier (engine/hybrid): PFSP runs the
+    # native C++ runtime, other opted-in plugins get the generic
+    # Python session over host_children (hybrid.PyHostSession). The
+    # engine raises HostTierUnsupported for host_fraction > 0 on a
+    # plugin that has not opted in.
     supports_host_tier: bool = False
     # whether make_step consumes the fused Pallas route's mode
     # (ops/pallas_fused — PFSP-only): drivers and tuning-cache keys
@@ -178,7 +195,7 @@ class Problem:
                 sol += 1
                 continue
             for child, cdepth, bound, is_leaf in self.host_children(
-                    table, node, depth, best):
+                    table, node, depth, best, lb_kind=lb_kind):
                 if self.leaf_in_evals and is_leaf:
                     sol += 1
                     if bound < best:
@@ -197,11 +214,14 @@ class Problem:
                         best=best)
 
     def host_children(self, table: np.ndarray, node: np.ndarray,
-                      depth: int, best: int):
+                      depth: int, best: int, *, lb_kind: int = 1):
         """Host-side oracle branching: yield (child, child_depth,
         bound, is_leaf) for every evaluated child of one node —
-        the warm-up generator and the conformance tests' reference
-        semantics. Must match `branch`+`bound` exactly."""
+        the warm-up generator, the `-C` host tier's generic session
+        (engine/hybrid.PyHostSession) and the conformance tests'
+        reference semantics. Must match `branch`+`bound` exactly for
+        the same `lb_kind` (plugins with one bound tier may ignore
+        the keyword)."""
         raise NotImplementedError
 
     # ------------------------------------------------- jittable engine

@@ -16,6 +16,19 @@ admissible (the integer optimum is an integer below the real-valued
 relaxation). An over-capacity "take" child is infeasible and bounds to
 I32_MAX. A child at depth n is a leaf whose bound is exactly -value.
 
+`lb_kind=2` is the Martello–Toth refinement: with break item `k` (the
+first sorted item past `s` that no longer fits after the greedy fill,
+residual r̄), the integer optimum either SKIPS k — at most
+U0 = z̄ + floor(r̄·v[k+1]/w[k+1]) (items past k are no denser than
+k+1) — or TAKES k, which must displace w[k]−r̄ weight of density at
+least v[k-1]/w[k-1] from the greedy prefix:
+U1 = z̄ + v[k] − ceil((w[k]−r̄)·v[k-1]/w[k-1]). U2 = max(U0, U1)
+covers both cases and never exceeds the Dantzig bound. Subproblem
+twist the textbook form doesn't need: items before `s` are FIXED, so
+U1 is only valid when the greedy prefix is non-empty (k−1 ≥ s); when
+k == s the take-k case is infeasible outright and U2 = U0. The ceil
+(not floor) on the displaced-value term keeps U1 admissible.
+
 Instance table (3, n) int32: row 0 weights (>= 1), row 1 values
 (>= 0), row 2 is [capacity, 0, ...].
 """
@@ -79,6 +92,30 @@ def _fractional_ub(w: np.ndarray, v: np.ndarray, start: int,
     return total
 
 
+def _mt_ub(w: np.ndarray, v: np.ndarray, start: int,
+           rem_cap: int) -> int:
+    """Host-side Martello–Toth bound over sorted items[start:] (the
+    lb_kind=2 oracle the traced bound must match). See the module
+    docstring for the U0/U1/U2 derivation and the k-1 >= start
+    subproblem validity twist."""
+    n = len(w)
+    r = int(rem_cap)
+    z = 0
+    k = start
+    while k < n and int(w[k]) <= r:
+        r -= int(w[k])
+        z += int(v[k])
+        k += 1
+    if k >= n:
+        return z
+    u0 = z + ((r * int(v[k + 1])) // int(w[k + 1]) if k + 1 < n else 0)
+    if k - 1 >= start:
+        need = int(w[k]) - r
+        lost = -((-need * int(v[k - 1])) // int(w[k - 1]))  # ceil div
+        return max(u0, z + int(v[k]) - lost)
+    return u0
+
+
 @dataclasses.dataclass(frozen=True)
 class KnapsackInstance:
     """A knapsack instance plus test helpers."""
@@ -127,8 +164,8 @@ GOLDEN = {
 class KnapsackProblem(base.Problem):
     name = "knapsack"
     leaf_in_evals = True
-    supports_host_tier = False
-    lb_kinds = (1,)
+    supports_host_tier = True    # generic host tier over host_children
+    lb_kinds = (1, 2)        # 1 = Dantzig fractional, 2 = Martello–Toth
     default_lb = 1
     telemetry_labels = {"objective": "neg_value"}
 
@@ -188,9 +225,10 @@ class KnapsackProblem(base.Problem):
         return out
 
     def host_children(self, table: np.ndarray, node: np.ndarray,
-                      depth: int, best: int):
+                      depth: int, best: int, *, lb_kind: int = 1):
         w, v, cap, _ = _sorted_items(table)
         n = len(w)
+        ub_fn = _mt_ub if lb_kind == 2 else _fractional_ub
         taken = node[:depth] > 0
         weight = int(w[:depth][taken].sum())
         value = int(v[:depth][taken].sum())
@@ -203,8 +241,7 @@ class KnapsackProblem(base.Problem):
             if cw > cap:
                 bound = I32_MAX
             else:
-                bound = -(cv + _fractional_ub(w, v, depth + 1,
-                                              cap - cw))
+                bound = -(cv + ub_fn(w, v, depth + 1, cap - cw))
             yield child, depth + 1, bound, is_leaf
 
     # ------------------------------------------------ jittable engine
@@ -254,13 +291,34 @@ class KnapsackProblem(base.Problem):
         k = s + can.sum(axis=1, dtype=jnp.int32)      # first overflow
         has_frac = k < n
         kc = jnp.clip(k, 0, n - 1)
-        wk = jnp.take(tables.w, kc)
-        vk = jnp.take(tables.v, kc)
-        frac = jnp.where(
-            has_frac,
-            ((r - taken_w).astype(jnp.int64) * vk.astype(jnp.int64))
-            // jnp.maximum(wk, 1).astype(jnp.int64),
-            0).astype(jnp.int32)
+        wk = jnp.take(tables.w, kc).astype(jnp.int64)
+        vk = jnp.take(tables.v, kc).astype(jnp.int64)
+        rbar = (r - taken_w).astype(jnp.int64)        # residual at k
+        if lb_kind == 2:
+            # Martello–Toth U2 = max(U0, U1) — module docstring has the
+            # derivation; all products in int64 (sums are <= 2^30 by
+            # admission, but products of two such cross int32)
+            kp = jnp.clip(k + 1, 0, n - 1)
+            wk1 = jnp.take(tables.w, kp).astype(jnp.int64)
+            vk1 = jnp.take(tables.v, kp).astype(jnp.int64)
+            u0 = jnp.where(k + 1 < n, (rbar * vk1) // wk1, 0)
+            km = jnp.clip(k - 1, 0, n - 1)
+            wm = jnp.take(tables.w, km).astype(jnp.int64)
+            vm = jnp.take(tables.v, km).astype(jnp.int64)
+            need = wk - rbar
+            lost = (need * vm + wm - 1) // wm          # ceil division
+            u1 = vk - lost
+            # items before s are fixed: U1 needs a non-empty greedy
+            # prefix to displace from (k-1 >= s), else take-k is
+            # infeasible and U0 alone covers the skip-k case
+            frac = jnp.where(
+                has_frac,
+                jnp.where(k - 1 >= s, jnp.maximum(u0, u1), u0),
+                0).astype(jnp.int32)
+        else:
+            frac = jnp.where(has_frac,
+                             (rbar * vk) // jnp.maximum(wk, 1),
+                             0).astype(jnp.int32)
         ub = V + int_val + frac
         return jnp.where(feasible, -ub, I32_MAX).astype(jnp.int32)
 

@@ -88,7 +88,7 @@ class NQueensProblem(base.Problem):
                 np.zeros(1, np.int16))
 
     def host_children(self, table: np.ndarray, node: np.ndarray,
-                      depth: int, best: int):
+                      depth: int, best: int, *, lb_kind: int = 1):
         n = self.slots(table)
         for j in range(depth, n):
             ok = is_safe(node, depth, int(node[j]))

@@ -158,7 +158,9 @@ class PFSPProblem(base.Problem):
                                       init_ub, target)
 
     def host_children(self, table: np.ndarray, node: np.ndarray,
-                      depth: int, best: int):
+                      depth: int, best: int, *, lb_kind: int = 1):
+        # the host oracle stays on lb1 regardless of lb_kind — PFSP's
+        # native -C tier (engine/hybrid.HostSession) owns lb2 hosting
         from ..ops import reference as ref
         p = np.asarray(table)
         jobs = p.shape[1]

@@ -23,6 +23,23 @@ branching permutes within the suffix), so the whole child grid bounds
 in O(n) vector ops per parent. `aux` carries one row: the prefix path
 cost, maintained incrementally like PFSP's front vectors.
 
+`lb_kind=2` is the Held–Karp spanning-tree relaxation (the 1-tree
+family): the remaining route of any child of a parent at depth `d` is a
+Hamiltonian path from the appended city through the unvisited cities
+back to the start — a spanning tree of S = {suffix cities} ∪ {start},
+and S is the SAME set for every child of one parent. So one MST per
+POPPED PARENT (not per child) lower-bounds every child's completion:
+
+    LB2(child) = prefix_cost + D[endpoint, appended] + MST(S)
+
+Weights are symmetrized (`wsym = min(D, D.T)`) so the undirected MST
+stays admissible for asymmetric instances. The traced MST is a
+vectorized Prim — n-1 masked min-reductions over a (B, n) candidate
+distance matrix with first-index argmin tie-breaks; any tie-break
+yields the same TOTAL weight (the MST value is unique even when the
+tree is not), so the host oracle needs no tie-break coordination.
+Leaf children keep the exact closing-edge objective under both tiers.
+
 The instance table is the (n, n) int32 distance matrix (asymmetric
 allowed; the diagonal is ignored).
 """
@@ -43,6 +60,7 @@ class TSPTables(NamedTuple):
     d: object        # (n, n) int32 distance matrix
     dt: object       # (n, n) int32 transpose (leaf return-edge gathers)
     minout: object   # (n,) int32 min outgoing edge per city
+    wsym: object     # (n, n) int32 min(D, D.T): lb2's undirected weights
 
 
 def _minout(d: np.ndarray) -> np.ndarray:
@@ -50,6 +68,31 @@ def _minout(d: np.ndarray) -> np.ndarray:
     masked = d.astype(np.int64) + np.where(np.eye(n, dtype=bool),
                                            np.int64(2**31), 0)
     return masked.min(axis=1).astype(np.int32)
+
+
+def _wsym(d: np.ndarray) -> np.ndarray:
+    d = np.asarray(d, np.int32)
+    return np.minimum(d, d.T)
+
+
+def _host_mst(wsym: np.ndarray, members: np.ndarray, start: int) -> int:
+    """Prim over the member vertex set — the lb2 host oracle. Mirrors
+    the traced loop in :meth:`TSPProblem.bound` structurally; the MST
+    total is tie-break independent, so exact agreement is free."""
+    INF = np.int64(2**62)
+    w = wsym.astype(np.int64)
+    in_tree = np.zeros(len(members), bool)
+    in_tree[start] = True
+    dist = np.where(members & ~in_tree, w[start], INF)
+    total = 0
+    for _ in range(int(members.sum())):
+        j = int(dist.argmin())
+        if dist[j] >= INF:
+            break
+        total += int(dist[j])
+        in_tree[j] = True
+        dist = np.where(members & ~in_tree, np.minimum(dist, w[j]), INF)
+    return total
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,8 +147,8 @@ GOLDEN_OPTIMUM = 95
 class TSPProblem(base.Problem):
     name = "tsp"
     leaf_in_evals = True
-    supports_host_tier = False
-    lb_kinds = (1,)          # the NN-sum bound is the one bound tier
+    supports_host_tier = True    # generic host tier over host_children
+    lb_kinds = (1, 2)        # 1 = NN-sum, 2 = Held–Karp MST relaxation
     default_lb = 1
     telemetry_labels = {"objective": "tour_length"}
 
@@ -130,7 +173,8 @@ class TSPProblem(base.Problem):
         import jax.numpy as jnp
         d = np.asarray(table, np.int32)
         return TSPTables(d=jnp.asarray(d), dt=jnp.asarray(d.T.copy()),
-                         minout=jnp.asarray(_minout(d)))
+                         minout=jnp.asarray(_minout(d)),
+                         wsym=jnp.asarray(_wsym(d)))
 
     def root(self, table: np.ndarray):
         n = self.slots(table)
@@ -150,7 +194,7 @@ class TSPProblem(base.Problem):
         return out
 
     def host_children(self, table: np.ndarray, node: np.ndarray,
-                      depth: int, best: int):
+                      depth: int, best: int, *, lb_kind: int = 1):
         d = np.asarray(table, np.int64)
         mo = _minout(np.asarray(table)).astype(np.int64)
         n = len(node)
@@ -158,6 +202,14 @@ class TSPProblem(base.Problem):
         cost = int(d[prefix[:-1], prefix[1:]].sum())
         suffix_mo = int(mo[node[depth:].astype(np.int64)].sum())
         end = int(node[depth - 1])
+        if lb_kind == 2 and depth + 1 < n:
+            # one MST per parent: S = suffix ∪ {start} is child-invariant
+            members = np.zeros(n, bool)
+            members[node[depth:].astype(np.int64)] = True
+            members[int(node[0])] = True
+            mst = _host_mst(_wsym(table), members, int(node[0]))
+        else:
+            mst = 0
         for i in range(depth, n):
             child = node.copy()
             child[depth], child[i] = child[i], child[depth]
@@ -165,6 +217,8 @@ class TSPProblem(base.Problem):
             new_cost = cost + int(d[end, appended])
             if depth + 1 == n:
                 bound = new_cost + int(d[appended, int(node[0])])
+            elif lb_kind == 2:
+                bound = new_cost + mst
             else:
                 bound = new_cost + suffix_mo
             yield child, depth + 1, bound, depth + 1 == n
@@ -198,24 +252,63 @@ class TSPProblem(base.Problem):
                                  p_depth).reshape(B * n, n).T
         child_depth = jnp.broadcast_to((p_depth + 1)[:, None], (B, n)) \
             .reshape(-1).astype(jnp.int16)
+        # lb2's per-parent MST vertex set S = suffix ∪ {start} in city
+        # space (a permutation scatter); carried for every tier — XLA
+        # dead-code-eliminates it when bound() never reads it (lb1)
+        members = jnp.zeros((B, n), bool).at[
+            jnp.arange(B)[:, None], board].set(pos >= p_depth[:, None])
+        members = members.at[jnp.arange(B), board[:, 0]].set(True)
         return base.BranchOut(
             children=children, child_depth=child_depth,
             child_aux=new_cost.reshape(1, -1),
             evaluated=evaluated,
             extras=(ret.reshape(-1),
                     jnp.broadcast_to(suffix_mo[:, None],
-                                     (B, n)).reshape(-1)))
+                                     (B, n)).reshape(-1),
+                    members, board[:, 0]))
 
     def bound(self, tables: TSPTables, lb_kind: int, br, best):
         import jax.numpy as jnp
         n = tables.d.shape[0]
-        ret, suffix_mo = br.extras
+        ret, suffix_mo, members, start = br.extras
         new_cost = br.child_aux[0]
         leaf = br.child_depth.astype(jnp.int32) == n
+        if lb_kind == 2:
+            # Held–Karp MST relaxation, one Prim run per popped parent
+            # (see module docstring): n-1 masked min-reductions over the
+            # (B, n) candidate-edge matrix, scanned with fori_loop
+            import jax
+            B = members.shape[0]
+            INF = jnp.int64(2**62)
+            rows = jnp.arange(B)
+            w = tables.wsym.astype(jnp.int64)
+            in_tree = jnp.zeros((B, n), bool).at[rows, start].set(True)
+            dist = jnp.where(members & ~in_tree,
+                             jnp.take(w, start, axis=0), INF)
+
+            def prim_step(_, carry):
+                in_tree, dist, total = carry
+                j = jnp.argmin(dist, axis=1)        # first-index ties
+                dmin = jnp.take_along_axis(dist, j[:, None], axis=1)[:, 0]
+                add = dmin < INF
+                total = total + jnp.where(add, dmin, 0)
+                in_tree = in_tree.at[rows, j].set(in_tree[rows, j] | add)
+                wj = jnp.take(w, j, axis=0)          # (B, n)
+                dist = jnp.where(members & ~in_tree,
+                                 jnp.minimum(dist, wj), INF)
+                return in_tree, dist, total
+
+            total = jnp.zeros(B, jnp.int64)
+            _, _, mst = jax.lax.fori_loop(
+                0, n - 1, prim_step, (in_tree, dist, total))
+            lb = jnp.broadcast_to(mst[:, None].astype(jnp.int32),
+                                  (B, n)).reshape(-1)
+        else:
+            lb = suffix_mo
         # a complete tour's "bound" is its exact length (closing edge
         # back to the start) — the LB==objective-at-leaves convention
         return jnp.where(leaf, new_cost + ret,
-                         new_cost + suffix_mo).astype(jnp.int32)
+                         new_cost + lb).astype(jnp.int32)
 
 
 PROBLEM = base.register(TSPProblem())

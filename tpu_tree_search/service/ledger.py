@@ -258,6 +258,23 @@ class LedgerState:
         e["spent_s"] = max(e["spent_s"],
                            float(e["terminal"].get("spent_s") or 0.0))
 
+    def _apply_portfolio(self, rec: dict) -> None:
+        """Parent -> member linkage of a portfolio race
+        (service/portfolio). Stamped onto the ENTRIES (parent gets the
+        member list, each member a back-pointer + its raced config), so
+        the linkage rides compaction for free — `_apply_restore`
+        carries entry dicts verbatim."""
+        e = self._entry(rec)
+        if e is None:
+            return
+        members = [dict(m) for m in rec.get("members") or []]
+        e["portfolio_members"] = members
+        for m in members:
+            me = self.requests.get(m.get("rid") or "")
+            if me is not None:
+                me["portfolio_parent"] = rec["rid"]
+                me["portfolio_config"] = m.get("config")
+
     def _apply_quarantine(self, rec: dict) -> None:
         self.quarantined[int(rec["submesh"])] = str(
             rec.get("reason") or "")

@@ -103,6 +103,20 @@ class SearchRequest:
     # content-hash key); a share_group narrows that to requests naming
     # the same group — the tenant/tag-family isolation knob
     share_group: str | None = None
+    # bound-portfolio racing (service/portfolio.py): K >= 2 fans this
+    # request out as K sibling sub-requests over distinct
+    # configurations (bound tiers, tuned chunk plans) sharing one
+    # incumbent board; the first sibling to complete with a proof wins
+    # and the losers cancel. None (default) = the exact pre-portfolio
+    # path; the server may fill in TTS_PORTFOLIO when set
+    portfolio: int | None = None
+
+    def __post_init__(self):
+        # wire payloads carry portfolio as a plain int; normalize the
+        # off spellings (0, 1 = a race of one = no race) to None so
+        # `portfolio` is truthy exactly when a race is requested
+        if self.portfolio in (0, 1):
+            self.portfolio = None
 
     def validate(self) -> str | None:
         """Admission-side validation; returns a rejection reason or
@@ -127,6 +141,15 @@ class SearchRequest:
             return f"deadline_s must be positive, got {self.deadline_s}"
         if self.chunk is not None and self.chunk < 1:
             return f"chunk must be >= 1 (or None = tuned), got {self.chunk}"
+        if self.portfolio is not None:
+            from ..utils import config
+            cap = config.env_int("TTS_PORTFOLIO_MAX",
+                                 config.PORTFOLIO_MAX_DEFAULT)
+            if not 2 <= self.portfolio <= cap:
+                return (f"portfolio must be 2..{cap} "
+                        f"(TTS_PORTFOLIO_MAX), got {self.portfolio}")
+            if self.faults:
+                return "portfolio cannot combine with per-request faults"
         return None
 
 
@@ -209,6 +232,17 @@ class RequestRecord:
     result: object | None = None        # DistResult (final or partial)
     seq: int = 0                        # FIFO tiebreak within a priority
     stop_reason: str | None = None      # why the current stop was asked
+    # bound-portfolio racing (service/portfolio.py). A PARENT record
+    # (portfolio_members set) is never queued or dispatched — it
+    # finalizes from its members' terminals: first proof wins, the
+    # rest cancel. A MEMBER record (portfolio_parent set) runs through
+    # the ordinary scheduler; its terminal feeds the parent's race.
+    portfolio_members: list | None = None   # member rids, fan-out order
+    portfolio_parent: str | None = None     # parent rid on members
+    portfolio_winner: str | None = None     # winning member rid (parent)
+    portfolio_config: dict | None = None    # member's raced config, or
+    #                                         the winner's on the parent
+    portfolio_cancelled: int = 0            # losers cancelled (parent)
     done_event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -267,6 +301,21 @@ class RequestRecord:
                 else None),
             "progress": dict(self.progress),
         }
+        if self.portfolio_members is not None:
+            out["portfolio"] = {
+                "k": len(self.portfolio_members),
+                "members": list(self.portfolio_members),
+                "winner": self.portfolio_winner,
+                "winner_config": (dict(self.portfolio_config)
+                                  if self.portfolio_config else None),
+                "cancelled": self.portfolio_cancelled,
+            }
+        elif self.portfolio_parent is not None:
+            out["portfolio"] = {
+                "parent": self.portfolio_parent,
+                "config": (dict(self.portfolio_config)
+                           if self.portfolio_config else None),
+            }
         res = self.result
         if res is not None:
             out["result"] = {

@@ -399,6 +399,12 @@ class SearchServer:
         from .remediate import RemediationController
         self.remediation = RemediationController(
             self, enabled=remediate, registry=self.metrics)
+        # bound-portfolio racing (service/portfolio): always
+        # constructed (a pure coordination object; zero cost when no
+        # request carries `portfolio`). Must exist BEFORE the ledger
+        # replays — replayed races reconcile through it.
+        from .portfolio import PortfolioCoordinator
+        self.portfolio = PortfolioCoordinator(self)
         # crash-safe serving (service/ledger): a write-ahead journal of
         # every request state transition, replayed here at boot so a
         # hard-killed server's queued/active requests re-admit with
@@ -572,7 +578,8 @@ class SearchServer:
     # ------------------------------------------------------------ client API
 
     def submit(self, request: SearchRequest, *,
-               spool_id: str | None = None) -> str:
+               spool_id: str | None = None,
+               _portfolio_member: bool = False) -> str:
         """Admit a request; returns its id. Raises AdmissionError (with
         `.reason`) when the queue is full, the request is invalid, or
         the server is closed — rejection is immediate and explicit, the
@@ -615,6 +622,20 @@ class SearchServer:
             tracelog.event("request.reject",
                            reason=f"invalid request: {reason}")
             raise AdmissionError(f"invalid request: {reason}")
+        if not _portfolio_member:
+            # bound-portfolio racing: an explicit `portfolio: K` (or
+            # the TTS_PORTFOLIO server default, capped at the
+            # admission bound) fans out instead of queueing. Members
+            # resubmit through this method with the guard flag — the
+            # env default must not fan a member out recursively
+            k = request.portfolio
+            if k is None:
+                k = cfg.env_int(cfg.PORTFOLIO_ENV, 0)
+                k = min(k, cfg.env_int("TTS_PORTFOLIO_MAX",
+                                       cfg.PORTFOLIO_MAX_DEFAULT))
+            if k and k >= 2:
+                return self._submit_portfolio(request, int(k),
+                                              spool_id=spool_id)
         with self._lock:
             if self.ledger is not None and request.tag:
                 # idempotent re-serve: a duplicate tag whose recorded
@@ -709,6 +730,114 @@ class SearchServer:
                            priority=request.priority,
                            deadline_s=request.deadline_s,
                            resumable=rec.spent_prev_s > 0)
+            return rid
+
+    def _submit_portfolio(self, request: SearchRequest, k: int, *,
+                          spool_id: str | None) -> str:
+        """Admit a ``portfolio: K`` request: create the (never-queued,
+        never-dispatched) PARENT record, fan out K member sub-requests
+        over distinct configurations (service/portfolio.plan_members),
+        journal the parent->member linkage, and arm the race. The
+        parent id is what the client polls/awaits; it finalizes DONE
+        with the first member to complete a proof (losers cancel), or
+        inherits the least-bad outcome when none does."""
+        import dataclasses as _dc
+
+        from .. import problems
+        from . import portfolio as portfolio_mod
+        prob = problems.get(request.problem)
+        # pin the resolved K on the parent request (it may have come
+        # from the TTS_PORTFOLIO server default): the journaled admit
+        # payload must replay the same race width on the next boot
+        request = _dc.replace(request, portfolio=int(k))
+        with self._lock:
+            if self.ledger is not None and request.tag:
+                # same idempotent re-serve rule as the solo path: a
+                # duplicate tag whose recorded terminal is DONE
+                # returns the recorded result instead of re-racing
+                done = next(
+                    (r for r in self.records.values()
+                     if r.state == DONE
+                     and (r.request.tag or r.id) == request.tag), None)
+                if done is not None:
+                    prior = done.request
+                    if (prior.problem == request.problem
+                            and np.array_equal(
+                                np.asarray(prior.p_times),
+                                np.asarray(request.p_times))
+                            and prior.lb_kind == request.lb_kind
+                            and prior.init_ub == request.init_ub):
+                        tracelog.event("request.reserved_terminal",
+                                       request_id=done.id,
+                                       tag=request.tag)
+                        return done.id
+            seq = next(self._seq)
+            rid = f"req-{seq:04d}"
+            tag = request.tag or rid
+            path = str(self.workdir / f"{tag}.ckpt.npz")
+            holder = next(
+                (r for r in self.records.values()
+                 if r.checkpoint_path == path
+                 and r.state not in TERMINAL_STATES), None)
+            if holder is not None:
+                self.queue.rejected += 1
+                tracelog.event("request.reject", tag=tag,
+                               reason=f"tag active on {holder.id}")
+                raise AdmissionError(
+                    f"tag {tag!r} is already active on request "
+                    f"{holder.id} ({holder.state}); wait for it to "
+                    "finish or cancel it first")
+            parent = RequestRecord(
+                id=rid, request=request,
+                submitted_t=time.monotonic(), seq=seq,
+                checkpoint_path=path,
+                spent_prev_s=_prior_spent_s(path))
+            self.records[rid] = parent
+            self._m_submitted.inc()
+            if self.ledger is not None:
+                from .spool import payload_from_request
+                self.ledger.journal(
+                    "admit", rid=rid, tag=tag, seq=seq,
+                    payload=payload_from_request(request),
+                    spool_id=spool_id,
+                    spent_s=round(parent.spent_prev_s, 3))
+            tracelog.event("request.admit", request_id=rid, tag=tag,
+                           priority=request.priority,
+                           deadline_s=request.deadline_s,
+                           portfolio=k,
+                           resumable=parent.spent_prev_s > 0)
+            plan = portfolio_mod.plan_members(
+                request, prob, k, parent_tag=tag, tuner=self.tuner,
+                n_workers=self.slots[0].mesh.devices.size)
+            members: list = []
+            try:
+                for mreq, config in plan:
+                    mrid = self.submit(mreq, _portfolio_member=True)
+                    mrec = self.records[mrid]
+                    mrec.portfolio_parent = rid
+                    mrec.portfolio_config = dict(config)
+                    members.append((mrid, config))
+            except AdmissionError as e:
+                # partial fan-out (queue filled mid-race): a half
+                # portfolio is not the race the client asked for —
+                # unwind the admitted members and refuse the parent
+                for mrid, _ in members:
+                    mrec = self.records.get(mrid)
+                    if mrec is not None \
+                            and mrec.state not in TERMINAL_STATES:
+                        self._finalize(mrec, CANCELLED,
+                                       error="portfolio fan-out aborted")
+                self._finalize(
+                    parent, FAILED,
+                    error=f"portfolio fan-out failed at member "
+                          f"{len(members)} of {k}: {e}")
+                raise
+            if self.ledger is not None:
+                self.ledger.journal(
+                    "portfolio", rid=rid,
+                    members=[{"rid": m, "config": c}
+                             for m, c in members])
+            self.portfolio.register(parent, members)
             return rid
 
     def status(self, request_id: str) -> dict:
@@ -1164,11 +1293,29 @@ class SearchServer:
                                if self.incumbents is not None else None),
                 "tuner": (self.tuner.snapshot()
                           if self.tuner is not None else None),
+                "portfolio": self._portfolio_snapshot(),
                 "counters": self.counters,
                 "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()
                              for rid, rec in self.records.items()},
             }
+
+    def _portfolio_snapshot(self) -> dict | None:
+        """status_snapshot()'s `portfolio` key: None when no request
+        ever raced (snapshot parity with the pre-portfolio server),
+        else the race totals the doctor's column reads — per-race
+        detail (siblings, winner config, cancelled counts) lives on
+        each parent's request snapshot `portfolio` block."""
+        parents = [r for r in self.records.values()
+                   if r.portfolio_members is not None]
+        if not parents:
+            return None
+        return {"parents": len(parents),
+                "active": sum(1 for r in parents
+                              if r.state not in TERMINAL_STATES),
+                "won": sum(1 for r in parents if r.state == DONE),
+                "cancelled_members": sum(r.portfolio_cancelled
+                                         for r in parents)}
 
     def _failover_snapshot(self) -> dict | None:
         """status_snapshot()'s `failover` key: None outside fleet mode
@@ -1244,6 +1391,12 @@ class SearchServer:
                                error=repr(e))
         if max_seq >= 0:
             self._seq = itertools.count(max_seq + 1)
+        # re-arm replayed portfolio races AFTER every entry landed
+        # (members replay after their lower-seq parent): a race the
+        # crash interrupted mid-decision resolves right here — a
+        # pre-kill winner decides, members of an already-terminal
+        # parent cancel instead of re-running a finished race
+        self.portfolio.reconcile()
         if st.requests:
             tracelog.event("ledger.recovered", restarts=st.boots,
                            **self._recovered)
@@ -1278,6 +1431,16 @@ class SearchServer:
             excluded = set()
         rec.excluded_submeshes = excluded
         rec.error = entry.get("error")
+        # portfolio linkage (the `portfolio` journal record stamped it
+        # on the entries; _apply_restore carries it through compaction
+        # verbatim) — restored BEFORE the state branch so a parent is
+        # recognized and never requeued
+        pf_members = entry.get("portfolio_members")
+        if pf_members:
+            rec.portfolio_members = [m.get("rid") for m in pf_members]
+        if entry.get("portfolio_parent"):
+            rec.portfolio_parent = str(entry["portfolio_parent"])
+            rec.portfolio_config = entry.get("portfolio_config")
         state = entry.get("state")
         if state in TERMINAL_STATES:
             rec.state = state
@@ -1285,6 +1448,12 @@ class SearchServer:
             if snap.get("result") is not None:
                 rec.result = _ReplayedResult(snap["result"])
             rec.error = snap.get("error", rec.error)
+            if rec.portfolio_members is not None:
+                pf = snap.get("portfolio") or {}
+                rec.portfolio_winner = pf.get("winner")
+                rec.portfolio_config = (pf.get("winner_config")
+                                        or rec.portfolio_config)
+                rec.portfolio_cancelled = int(pf.get("cancelled") or 0)
             rec.done_event.set()
             self._recovered["terminal"] += 1
         elif state == PREEMPTED and entry.get("hold"):
@@ -1297,7 +1466,11 @@ class SearchServer:
             rec.state = QUEUED
             self._recovered["active" if state == RUNNING
                             else "queued"] += 1
-            self.queue.requeue(rec)
+            if rec.portfolio_members is None:
+                # a portfolio PARENT is a coordination object: it waits
+                # on its members' terminals, it never queues — the
+                # post-replay reconcile() re-arms its race instead
+                self.queue.requeue(rec)
         with self._lock:
             self.records[rid] = rec
         if entry.get("spool_id"):
@@ -1673,6 +1846,14 @@ class SearchServer:
             # prior run's partial checkpoint).
             self._unlink_checkpoints(rec)
         rec.done_event.set()
+        # bound-portfolio racing hooks (service/portfolio; the lock is
+        # an RLock, so the resolution's nested _finalize calls — a
+        # member's DONE finalizing the parent, a parent's terminal
+        # cancelling queued losers — re-enter here safely)
+        if rec.portfolio_parent is not None:
+            self.portfolio.on_member_terminal(rec)
+        if rec.portfolio_members is not None:
+            self.portfolio.on_parent_terminal(rec)
 
     def _unlink_checkpoints(self, rec: RequestRecord) -> None:
         if not rec.checkpoint_path:

@@ -334,6 +334,22 @@ MEGABATCH_FLAG = "TTS_MEGABATCH"
 BATCH_MAX_DEFAULT = 8          # TTS_BATCH_MAX — close a batch at size
 BATCH_AGE_S_DEFAULT = 0.25     # TTS_BATCH_AGE_S — or at this age
 
+# Bound-portfolio racing (service/portfolio.py + request `portfolio: K`
+# + client --portfolio). A request submitted with portfolio K fans out
+# as K sibling sub-requests over DISTINCT configurations (bound tiers
+# from the problem's lb_kinds ladder, per-tier tuned chunk plans from
+# the Autotuner, chunk variants when tiers run out) that share ONE
+# incumbent board via share_group — each sibling's incumbents tighten
+# the others' pruning. The first sibling to finish with a PROOF wins:
+# the parent finalizes DONE with the winner's result and every losing
+# sibling is cancelled through the ordinary member-level stop path at
+# its next segment boundary. TTS_PORTFOLIO sets a default K for
+# requests that don't carry an explicit `portfolio` (0 = off, the
+# default — a portfolio-less request takes the exact pre-portfolio
+# path); TTS_PORTFOLIO_MAX caps K at admission.
+PORTFOLIO_ENV = "TTS_PORTFOLIO"
+PORTFOLIO_MAX_DEFAULT = 8      # TTS_PORTFOLIO_MAX — admission cap on K
+
 # Self-healing (service/remediate.py + serve --remediate).
 # TTS_REMEDIATE=1 lets the RemediationController EXECUTE its policy
 # table (stall -> preempt+exclude, repeated localized failures ->
@@ -529,6 +545,15 @@ KNOBS: dict[str, Knob] = _knob_table(
     Knob("TTS_BATCH_AGE_S", "float", BATCH_AGE_S_DEFAULT,
          "megabatch: close a forming batch once its oldest member has "
          "waited this long (a lone request closes as a batch of one)"),
+    # --- bound-portfolio racing (service/portfolio.py; semantics per
+    #     README "Portfolio racing")
+    Knob("TTS_PORTFOLIO", "int", 0,
+         "serve: default portfolio width K for requests without an "
+         "explicit `portfolio` (0 = off — a portfolio-less request "
+         "takes the exact pre-portfolio path, bit-identical)"),
+    Knob("TTS_PORTFOLIO_MAX", "int", PORTFOLIO_MAX_DEFAULT,
+         "serve: admission cap on a request's portfolio width K "
+         "(reject beyond)"),
     # --- fleet failover (service/lease.py + service/failover.py;
     #     semantics per README "High availability & failover")
     Knob("TTS_FLEET_DIR", "str", None,
@@ -600,6 +625,16 @@ KNOBS: dict[str, Knob] = _knob_table(
          "through one serve session)", "bench"),
     Knob("TTS_BENCH_SERVE_N", "int", 8,
          "bench: serve-rps request count", "bench"),
+    Knob("TTS_BENCH_PORTFOLIO", "flag", True,
+         "bench: emit the portfolio-racing speedup row (K-way race "
+         "with a shared incumbent board vs the best member solo)",
+         "bench"),
+    Knob("TTS_BENCH_PORTFOLIO_K", "int", 3,
+         "bench: portfolio-speedup race width", "bench"),
+    Knob("TTS_BENCH_PORTFOLIO_JOBS", "int", 11,
+         "bench: portfolio-speedup synthetic instance jobs (large "
+         "enough that runs span many segments — the race only saves "
+         "bound evals when losers cancel mid-tree)", "bench"),
     Knob("TTS_BENCH_HBM", "flag", True,
          "bench: emit the step-HBM-bytes row (fused-mode channel; "
          "compiled-loop memory_analysis temp bytes on every backend "
