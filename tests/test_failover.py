@@ -37,12 +37,16 @@ import numpy as np
 import pytest
 
 from tpu_tree_search.engine import checkpoint, distributed
+from tpu_tree_search.obs import journey as journey_mod
+from tpu_tree_search.obs import store as store_mod
+from tpu_tree_search.obs import tracelog
 from tpu_tree_search.problems.pfsp import PFSPInstance
 from tpu_tree_search.service import (SearchRequest, SearchServer,
                                      TERMINAL_STATES)
 from tpu_tree_search.service import lease as lease_mod
 from tpu_tree_search.service.ledger import LedgerState, RequestLedger
 from tpu_tree_search.service.lease import LeaseKeeper, LeaseLost
+from tpu_tree_search.service.spool import payload_from_request
 from tpu_tree_search.utils import faults
 
 KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
@@ -97,6 +101,12 @@ def crash(srv):
     srv.remediation.close()
     if srv.aot is not None:
         srv.aot.close()
+    if srv.obs_store is not None:
+        # a dead host stops feeding the shared flight-recorder store;
+        # detach from the GLOBAL tracelog or the corpse would keep
+        # journaling the survivor's events under its own writer id
+        tracelog.get().remove_listener(srv.obs_store.on_trace_event)
+        srv.obs_store.close()
     if srv.ledger is not None:
         srv.ledger.close()
 
@@ -261,6 +271,10 @@ def test_takeover_resumes_bit_identical_and_fences_stale_restart(
     restarted A finds the adopter's LIVE lease, boots fenced and
     commits nothing."""
     monkeypatch.setenv("TTS_LEASE_TTL_S", "0.8")
+    # both lifetimes feed one shared flight-recorder store: the journey
+    # + segment assertions below need every host's segments present
+    store_dir = tmp_path / "store"
+    monkeypatch.setenv("TTS_OBS_STORE", str(store_dir))
     fleet = tmp_path / "fleet"
     a_dir, b_dir = fleet / "a", fleet / "b"
     inst = small(5, jobs=8)
@@ -297,6 +311,26 @@ def test_takeover_resumes_bit_identical_and_fences_stale_restart(
         json.dumps(snap)
         assert snap["failover"]["takeovers"] == 1
         assert snap["failover"]["mode"] == "act"
+
+        # ONE stitched journey across both hosts (obs/journey over the
+        # fleet's ledgers): one logical admit, one terminal, the
+        # takeover link machine-readable, budget monotone + cumulative
+        (j,) = srv_b.journeys(tag="move1")
+        assert j["admits"] == 1 and j["terminals"] == 1
+        assert j["state"] == "DONE"
+        assert j["takeovers"] == 1
+        assert j["budget_monotone"] is True
+        assert j["spent_s"] >= spent_at_crash
+        assert {lt["owner"] for lt in j["lifetimes"]} == {"a", "b"}
+        assert [r["origin"] for r in j["rids"]] == [
+            None, ["a", rid]]
+        # both lifetimes' store segments are present in the shared dir,
+        # and the adopter's durable terminal history is non-empty (the
+        # slo_* burn rules' cross-lifetime window source)
+        writers = {r["w"] for r in store_mod.read_store(store_dir)}
+        assert len(writers) == 2
+        assert {w.rsplit("_", 1)[-1] for w in writers} == {"a", "b"}
+        assert len(srv_b.obs_store.terminal_history()) >= 1
 
         # the orphan ledger: epoch ratcheted to the adopter's, the
         # moved request tombstoned, zero stale discards (A never wrote
@@ -551,3 +585,100 @@ def test_racing_adopters_exactly_one_wins(tmp_path, monkeypatch):
     finally:
         srv_b.close()
         srv_c.close()
+
+
+def test_adopted_requests_lineage_and_series_retire_all_terminals(
+        tmp_path, monkeypatch):
+    """Satellite: every terminal state on the ADOPTED path both (a)
+    carries the origin_rid/origin_owner lineage through the record,
+    the admit journal and the stitched journey, and (b) retires the
+    dead request's per-request series (tts_phase_seconds +
+    tts_search_*) — an adopter accumulating orphans must not leak
+    gauge cardinality for requests that died on another host."""
+    from tpu_tree_search.engine import telemetry as tele
+
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.5")
+    fleet = tmp_path / "fleet"
+    a_dir = fleet / "a"
+    a_dir.mkdir(parents=True)
+    inst = small(2, jobs=7)
+    keeper = LeaseKeeper(a_dir)
+    keeper.acquire()
+    led = RequestLedger(a_dir, lease=keeper)
+    specs = {
+        "DONE": SearchRequest(p_times=inst.p_times, lb_kind=1, **KW),
+        "FAILED": SearchRequest(p_times=inst.p_times, lb_kind=1, **KW),
+        "DEADLINE": SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                  deadline_s=0.001, segment_iters=8,
+                                  **KW),
+        "CANCELLED": SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                   **KW),
+    }
+    for i, (want, req) in enumerate(specs.items()):
+        led.journal("admit", rid=f"req-{i:04d}", tag=f"adopt-{want}",
+                    seq=i, payload=payload_from_request(req),
+                    tenant="acme", spent_s=0.0)
+    led.close()
+    keeper._stop.set()
+    if keeper._thread is not None:
+        keeper._thread.join(timeout=5.0)
+    wait_until(lambda: lease_mod.read_lease(a_dir).expired(),
+               timeout=30, msg="orphan lease expires")
+
+    b_dir = fleet / "b"
+    srv = SearchServer(n_submeshes=1, ledger_dir=str(b_dir),
+                       workdir=tmp_path / "wd", autostart=False,
+                       service_retry_attempts=0, health_interval_s=0,
+                       share_incumbent=False)
+    try:
+        res = srv.adopt_ledger(str(a_dir))
+        assert res["outcome"] == "adopted" and res["moved"] == 4
+        with srv._lock:
+            rids = {r.request.tag.split("-", 1)[1]: r.id
+                    for r in srv.records.values()}
+        # lineage stamped on every adopted record AND its admit journal
+        for i, want in enumerate(specs):
+            rec = srv.records[rids[want]]
+            assert rec.origin_rid == f"req-{i:04d}"
+            assert rec.origin_owner == "a"
+            assert rec.request.tenant == "acme"
+        admits = [r for r in ledger_records(b_dir) if r["k"] == "admit"]
+        assert {(r["origin_rid"], r["origin_owner"])
+                for r in admits} == {(f"req-{i:04d}", "a")
+                                     for i in range(4)}
+        # the orphan's takeover record points forward at the adopter
+        takeover = next(r for r in ledger_records(a_dir)
+                        if r["k"] == "takeover")
+        assert takeover["adopter"] == "b"
+
+        # drive each adopted request to its terminal; pre-populate the
+        # per-request series the live publishers would have
+        srv.records[rids["FAILED"]].request.faults = \
+            "fail_host_fetch=99"
+        for rid in rids.values():
+            srv.metrics.gauge(tele.SERIES[0]).set(1, request=rid,
+                                                  bucket=0)
+            srv.metrics.gauge("tts_phase_seconds").set(
+                1, request=rid, phase="kernel")
+        assert srv.cancel(rids["CANCELLED"])
+        srv.start()
+        for want, rid in rids.items():
+            rec = srv.result(rid, timeout=300)
+            assert rec.state == want, (want, rec.state, rec.error)
+            for name in tele.SERIES + ("tts_phase_seconds",):
+                m = srv.metrics.gauge(name)
+                assert not [k for _, k, _ in m.samples()
+                            if ("request", rid) in k], (want, name)
+            # terminal counters carry the adopted tenant
+            assert srv.metrics.counter("tts_requests_total").value(
+                state=want.lower(), tenant="acme") == 1
+            # the journey stitches orphan admit -> adopted terminal as
+            # ONE logical request for every terminal flavor
+            (j,) = journey_mod.find_journeys(
+                ledger_dirs=[a_dir, b_dir], tag=f"adopt-{want}")
+            assert j["admits"] == 1 and j["takeovers"] == 1
+            assert j["state"] == want and j["terminals"] == 1
+            assert j["tenant"] == "acme"
+            assert j["budget_monotone"] is True
+    finally:
+        srv.close()

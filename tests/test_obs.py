@@ -300,7 +300,7 @@ def test_serve_session_flight_recorder_end_to_end(fresh_obs, baselines,
             # ---- HTTP surface
             m = urllib.request.urlopen(httpd.url + "/metrics",
                                        timeout=10).read().decode()
-            assert 'tts_requests_total{state="done"} 3' in m
+            assert 'tts_requests_total{state="done",tenant="-"} 3' in m
             assert "tts_executor_cache_hits_total" in m
             assert "tts_executor_cache_misses_total" in m
             assert "tts_preemptions_total 1" in m
@@ -386,7 +386,7 @@ def test_cli_serve_spool_http_smoke(fresh_obs, tmp_path):
     assert res["state"] == "DONE"
     m = urllib.request.urlopen(base + "/metrics",
                                timeout=10).read().decode()
-    assert 'tts_requests_total{state="done"} 1' in m
+    assert 'tts_requests_total{state="done",tenant="-"} 1' in m
     snap = json.loads(urllib.request.urlopen(base + "/status",
                                              timeout=10).read())
     assert snap["counters"]["done"] == 1

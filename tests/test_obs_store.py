@@ -1,0 +1,668 @@
+"""Fleet flight recorder: the durable obs store, request journeys and
+SLO burn-rate alerts (obs/store.py + obs/journey.py + the slo_* rules).
+
+The load-bearing assertions:
+
+- **store durability discipline**: CRC-JSONL append/replay roundtrip;
+  a torn/garbled tail truncates OWN segments to the exact last-good
+  offset and quarantines later own segments, while a PEER's torn tail
+  is skipped but never repaired (the peer may be alive mid-write);
+  rotation + time-based retention prune only own closed segments; two
+  writers sharing one directory never collide;
+- **counter resume**: whitelisted ``tts_*`` counters re-seed from the
+  newest replayed sample so /metrics continues across a restart;
+- **journey stitching**: ledger records spanning a kill -9 replay and
+  a takeover re-admission (``origin_rid`` lineage) reconstruct ONE
+  logical journey — one admit, one terminal, both lifetimes present,
+  cumulative budget monotone;
+- **SLO burn rates**: terminal history spanning two store lifetimes
+  (replayed + live) drives ``slo_error_burn`` to firing — budget spent
+  before the restart still burns after it;
+- **bit-identity**: serving with ``TTS_OBS_STORE`` set yields the
+  exact standalone totals; the store is observation-only.
+"""
+
+import json
+import os
+import pathlib
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_tree_search.engine import distributed
+from tpu_tree_search.obs import health, metrics, tracelog
+from tpu_tree_search.obs import journey as journey_mod
+from tpu_tree_search.obs import store as store_mod
+from tpu_tree_search.obs.httpd import start_http_server
+from tpu_tree_search.obs.store import ObsStore, read_store
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+@pytest.fixture
+def fresh_obs(tmp_path):
+    log = tracelog.TraceLog(capacity=1 << 16,
+                            sink_path=tmp_path / "trace.jsonl")
+    prev_log = tracelog.install(log)
+    reg = metrics.Registry()
+    prev_reg = metrics.install(reg)
+    try:
+        yield log, reg
+    finally:
+        tracelog.install(prev_log)
+        metrics.install(prev_reg)
+
+
+def drain(store, n, timeout=10.0):
+    """Wait until `n` records hit disk (the writer thread is async)."""
+    t0 = time.monotonic()
+    while store.records < n:
+        assert time.monotonic() - t0 < timeout, (store.records, n)
+        time.sleep(0.01)
+
+
+# ------------------------------------------------------- store durability
+
+
+def test_store_roundtrip_replay_and_boot_records(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    s.append("event", name="request.admit", request_id="r1", tag="t1")
+    s.append("sample", counters=[["tts_requests_total",
+                                  {"state": "done"}, 3]])
+    drain(s, 3)                     # boot + 2
+    s.close()
+
+    recs = read_store(tmp_path)
+    assert [r["k"] for r in recs] == ["boot", "event", "sample"]
+    assert all(r["w"] == "w1" for r in recs)
+    assert recs[0]["pid"] == os.getpid()
+    assert recs[1]["name"] == "request.admit"
+    # wall-clock stamped, ascending
+    ts = [r["t"] for r in recs]
+    assert ts == sorted(ts) and ts[0] > 1e9
+
+    s2 = ObsStore(tmp_path, "w1", fsync=False)
+    assert s2.replayed == 3 and s2.truncated == 0
+    assert [r["k"] for r in s2.records_replayed()] == ["boot", "event",
+                                                       "sample"]
+    drain(s2, 1)                    # its own boot
+    s2.close()
+    # second lifetime appended its own boot to the SAME writer family
+    boots = [r for r in read_store(tmp_path) if r["k"] == "boot"]
+    assert len(boots) == 2
+
+
+def test_store_truncates_own_torn_tail_at_exact_offset(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    for i in range(4):
+        s.append("event", name="request.admit", i=i)
+    drain(s, 5)
+    s.close()
+    (seg,) = sorted(tmp_path.glob("obs-w1-*.jsonl"))
+    data = seg.read_bytes()
+    lines = data.splitlines(keepends=True)
+    good = b"".join(lines[:3])
+    # a torn line (no newline, half a record) after 3 good ones
+    seg.write_bytes(good + lines[3][: len(lines[3]) // 2])
+
+    s2 = ObsStore(tmp_path, "w1", fsync=False)
+    assert s2.replayed == 3
+    assert s2.truncated == 1
+    # cut to last-good, exactly: the torn fragment is gone. s2's own
+    # async boot append may already have landed past the cut, so judge
+    # the prefix and the absence of the torn bytes, not whole-file
+    # equality.
+    now = seg.read_bytes()
+    assert now[: len(good)] == good
+    assert b'"request.admit"' not in now[len(good):]
+    # appends continue in the repaired segment family
+    s2.append("event", name="request.admit", i=99)
+    drain(s2, 2)
+    s2.close()
+    recs = read_store(tmp_path)
+    assert sum(1 for r in recs if r.get("i") == 99) == 1
+
+
+def test_store_crc_rejects_garbled_line_and_quarantines_later(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False, segment_records=2)
+    # one record per batch (rotation is batch-granular): wait each out
+    for i in range(6):
+        s.append("event", name="request.admit", i=i)
+        drain(s, i + 2)
+    s.close()
+    segs = sorted(tmp_path.glob("obs-w1-*.jsonl"))
+    assert len(segs) >= 3                     # rotation happened
+    # flip a payload byte inside the FIRST segment: CRC must catch it
+    data = bytearray(segs[0].read_bytes())
+    at = data.find(b'"request.admit"')
+    data[at + 2] ^= 0x01
+    segs[0].write_bytes(bytes(data))
+
+    s2 = ObsStore(tmp_path, "w1", fsync=False)
+    # later own segments are suspect after a corruption: set aside
+    assert s2.quarantined_segments == len(segs) - 1
+    assert s2.truncated >= 1
+    quarantined = sorted(tmp_path.glob("obs-w1-*.jsonl.corrupt"))
+    assert len(quarantined) == len(segs) - 1
+    s2.close()
+
+
+def test_store_peer_torn_tail_skipped_never_repaired(tmp_path):
+    a = ObsStore(tmp_path, "peera", fsync=False)
+    a.append("event", name="request.admit", who="a")
+    drain(a, 2)
+    a.close()
+    (seg_a,) = sorted(tmp_path.glob("obs-peera-*.jsonl"))
+    torn = seg_a.read_bytes()[:-7]            # a live peer mid-write
+    seg_a.write_bytes(torn)
+
+    b = ObsStore(tmp_path, "peerb", fsync=False)
+    b.append("event", name="request.admit", who="b")
+    drain(b, 2)
+    # replay merged the peer's good prefix...
+    assert any(r.get("w") == "peera" for r in b.records_replayed())
+    # ...but did NOT touch the peer's file, and counted no truncation
+    assert seg_a.read_bytes() == torn
+    assert b.truncated == 0 and b.quarantined_segments == 0
+    b.close()
+    # two writers, two segment families, no collisions
+    assert sorted(p.name for p in tmp_path.glob("obs-peerb-*.jsonl"))
+
+
+def test_store_rotation_and_time_retention_own_segments_only(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False, segment_records=2,
+                 retain_s=3600.0)
+    # a peer's ancient segment must survive retention
+    peer = tmp_path / "obs-old_peer-00000001.jsonl"
+    peer.write_bytes(store_mod._line({"k": "boot", "t": 1.0,
+                                      "w": "old_peer"}))
+    os.utime(peer, (1.0, 1.0))
+    for i in range(6):
+        s.append("event", name="request.admit", i=i)
+        drain(s, i + 2)
+    own = sorted(tmp_path.glob("obs-w1-*.jsonl"))
+    assert len(own) >= 3
+    # age the closed own segments past the window; the next rotation
+    # prunes them but never the peer's
+    for seg in own[:-1]:
+        os.utime(seg, (1.0, 1.0))
+    for i in range(4):
+        s.append("event", name="request.admit", i=100 + i)
+        drain(s, 8 + i)
+    s.close()
+    assert peer.exists()
+    left = sorted(tmp_path.glob("obs-w1-*.jsonl"))
+    assert len(left) < len(own) + 2           # old ones pruned
+
+
+def test_resume_counters_seeds_only_whitelist_from_newest_sample(
+        tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    s.append("sample", counters=[
+        ["tts_requests_total", {"state": "done", "tenant": "-"}, 2]])
+    s.append("sample", counters=[
+        ["tts_requests_total", {"state": "done", "tenant": "-"}, 5],
+        ["tts_preemptions_total", {}, 1],
+        ["tts_ledger_records_total", {"kind": "admit"}, 9],   # not ours
+        ["tts_bogus_total", {}, 3]])                          # not ours
+    drain(s, 3)
+    s.close()
+
+    reg = metrics.Registry()
+    s2 = ObsStore(tmp_path, "w1", registry=reg, fsync=False)
+    seeded = store_mod.resume_counters(reg, s2.records_replayed(),
+                                       "w1")
+    assert seeded == 2                        # the NEWEST sample only
+    c = reg.counter("tts_requests_total")
+    assert c.value(state="done", tenant="-") == 5
+    assert reg.counter("tts_preemptions_total").value() == 1
+    # the ledger-fed and unknown counters were not seeded
+    assert reg.counter("tts_ledger_records_total").value(
+        kind="admit") == 0
+    # store's own replay counters published
+    assert reg.counter("tts_obs_store_replayed_total").value() == 3
+    s2.close()
+
+
+def test_store_terminal_history_spans_lifetimes(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    s.append("event", name="request.done", request_id="r1",
+             spent_s=1.5, tenant="acme")
+    s.append("event", name="request.failed", request_id="r2",
+             spent_s=0.5)
+    drain(s, 3)
+    s.close()
+    s2 = ObsStore(tmp_path, "w1", fsync=False)
+    s2.append("event", name="request.deadline", request_id="r3",
+              spent_s=9.0)
+    rows = s2.terminal_history()
+    assert [r[1] for r in rows] == ["DONE", "FAILED", "DEADLINE"]
+    assert rows[0][2] == 1.5 and rows[0][3] == "acme"
+    assert rows[1][3] == "-"
+    # the window filter
+    assert len(s2.terminal_history(since_s=time.time() + 60)) == 0
+    s2.close()
+
+
+def test_store_tracelog_listener_whitelists_control_plane(tmp_path):
+    log = tracelog.TraceLog()
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    log.add_listener(s.on_trace_event)
+    log.event("request.admit", request_id="r1", tag="t")
+    log.event("search.telemetry", popped=100)       # firehose: dropped
+    log.event("alert.firing", rule="stall")
+    with log.span("request.execute"):               # spans: dropped
+        pass
+    drain(s, 3)                                     # boot + 2 events
+    s.close()
+    names = [r.get("name") for r in read_store(tmp_path)
+             if r["k"] == "event"]
+    assert names == ["request.admit", "alert.firing"]
+
+
+# ------------------------------------------------------ journey stitching
+
+
+def _ledger_write(d, recs):
+    """Hand-author a CRC ledger segment (the service/ledger format)."""
+    d.mkdir(parents=True, exist_ok=True)
+    import zlib
+
+    def line(rec):
+        body = json.dumps(rec, sort_keys=True,
+                          separators=(",", ":")).encode()
+        return json.dumps({"c": zlib.crc32(body), "r": rec},
+                          sort_keys=True,
+                          separators=(",", ":")).encode() + b"\n"
+
+    (d / "seg-00000001.jsonl").write_bytes(
+        b"".join(line(r) for r in recs))
+
+
+def test_journey_one_timeline_across_kill_and_takeover():
+    """The acceptance shape, distilled: owner A admits, checkpoints,
+    dies; A's replay (same rid, second lifetime) runs more; A dies for
+    good; B adopts under a fresh rid with origin_rid lineage and
+    finishes. ONE journey: one admit, one terminal, all three
+    lifetimes, budget monotone and cumulative."""
+    t0 = 1_700_000_000.0
+    a = [
+        {"k": "boot", "t": t0 + 0, "pid": 11},
+        {"k": "admit", "t": t0 + 1, "rid": "req-0000", "tag": "j1",
+         "seq": 0, "tenant": "acme", "spent_s": 0.0},
+        {"k": "dispatch", "t": t0 + 2, "rid": "req-0000", "submesh": 0},
+        {"k": "budget", "t": t0 + 3, "rid": "req-0000", "spent_s": 1.0},
+        # kill -9; replay keeps the SAME rid in lifetime 2
+        {"k": "boot", "t": t0 + 10, "pid": 12},
+        {"k": "dispatch", "t": t0 + 11, "rid": "req-0000",
+         "submesh": 1},
+        {"k": "budget", "t": t0 + 12, "rid": "req-0000", "spent_s": 2.5},
+        # dead for good; B's takeover journals into the orphan
+        {"k": "takeover", "t": t0 + 30, "e": 2, "owner": "b",
+         "adopter": "b"},
+        {"k": "forget", "t": t0 + 30.1, "rid": "req-0000"},
+    ]
+    b = [
+        {"k": "boot", "t": t0 + 25, "pid": 21},
+        {"k": "admit", "t": t0 + 30.2, "rid": "req-0007", "tag": "j1",
+         "seq": 7, "tenant": "acme", "spent_s": 2.5,
+         "origin_rid": "req-0000", "origin_owner": "a"},
+        {"k": "dispatch", "t": t0 + 31, "rid": "req-0007",
+         "submesh": 0},
+        {"k": "budget", "t": t0 + 33, "rid": "req-0007", "spent_s": 4.0},
+        {"k": "terminal", "t": t0 + 35, "rid": "req-0007",
+         "state": "DONE", "snapshot": {"spent_s": 4.2,
+                                       "tenant": "acme"}},
+    ]
+    (j,) = journey_mod.build_journeys({"a": a, "b": b})
+    assert j["tag"] == "j1" and j["tenant"] == "acme"
+    assert j["state"] == "DONE"
+    assert j["admits"] == 1                  # the re-admission is NOT
+    assert j["terminals"] == 1               # a second logical admit
+    assert j["takeovers"] == 1
+    assert j["budget_monotone"] is True
+    assert j["spent_s"] == pytest.approx(4.2)
+    assert j["root"] == {"owner": "a", "rid": "req-0000"}
+    # every lifetime present: A#1, A#2 (the kill -9 replay), B#1
+    lanes = [(lt["owner"], lt["lifetime"]) for lt in j["lifetimes"]]
+    assert lanes == [("a", 1), ("a", 2), ("b", 1)]
+    # per-lifetime budget ends are cumulative across the chain
+    ends = [lt.get("spent_end_s") for lt in j["lifetimes"]]
+    assert ends == [1.0, 2.5, 4.2]
+    # rid lineage is machine-readable
+    rids = {r["rid"]: r for r in j["rids"]}
+    assert rids["req-0007"]["origin"] == ["a", "req-0000"]
+    assert rids["req-0000"]["origin"] is None
+
+
+def test_journey_lost_budget_witness_breaks_monotone():
+    t0 = 1_700_000_000.0
+    a = [
+        {"k": "boot", "t": t0, "pid": 1},
+        {"k": "admit", "t": t0 + 1, "rid": "r0", "tag": "j", "seq": 0,
+         "spent_s": 5.0},
+        {"k": "budget", "t": t0 + 2, "rid": "r0", "spent_s": 1.0},
+    ]
+    (j,) = journey_mod.build_journeys({"a": a})
+    assert j["budget_monotone"] is False
+    assert j["state"] == "LIVE"
+
+
+def test_find_journeys_tag_filter_fleet_scan_and_store_enrichment(
+        tmp_path):
+    t0 = 1_700_000_000.0
+    _ledger_write(tmp_path / "fleet" / "a", [
+        {"k": "boot", "t": t0, "pid": 1},
+        {"k": "admit", "t": t0 + 1, "rid": "r0", "tag": "one",
+         "seq": 0},
+        {"k": "terminal", "t": t0 + 2, "rid": "r0", "state": "DONE",
+         "snapshot": {"spent_s": 1.0}},
+        {"k": "admit", "t": t0 + 3, "rid": "r1", "tag": "two",
+         "seq": 1},
+    ])
+    store = ObsStore(tmp_path / "store", "a", fsync=False)
+    store.append("event", name="request.done", request_id="r0",
+                 tag="one", spent_s=1.0)
+    store.append("event", name="alert.firing", rule="stall")
+    drain(store, 3)
+    store.close()
+
+    js = journey_mod.find_journeys(fleet_dir=tmp_path / "fleet",
+                                   store=tmp_path / "store")
+    assert {j["tag"] for j in js} == {"one", "two"}
+    (j,) = journey_mod.find_journeys(
+        fleet_dir=tmp_path / "fleet", store=tmp_path / "store",
+        tag="one")
+    assert j["tag"] == "one"
+    # store events matched by rid/tag ride along; unrelated ones don't
+    assert [e["name"] for e in j["store_events"]] == ["request.done"]
+    # render + json are stdlib-safe
+    assert "tag=one" in journey_mod.render_journey(j)
+    json.loads(journey_mod.to_json(js))
+
+
+def test_journey_cli_subcommand_and_trace_summary_store_format(
+        tmp_path, capsys):
+    from tpu_tree_search import cli
+    t0 = 1_700_000_000.0
+    _ledger_write(tmp_path / "led" / "a", [
+        {"k": "boot", "t": t0, "pid": 1},
+        {"k": "admit", "t": t0 + 1, "rid": "r0", "tag": "cli1",
+         "seq": 0},
+        {"k": "terminal", "t": t0 + 2, "rid": "r0", "state": "DONE",
+         "snapshot": {"spent_s": 1.0}},
+    ])
+    rc = cli.main(["journey", "--ledger", str(tmp_path / "led" / "a"),
+                   "--tag", "cli1", "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["journeys"][0]["tag"] == "cli1"
+    # a tag with no match is an error (the CI leg's assertion relies
+    # on it), and no inputs at all is usage error 2
+    assert cli.main(["journey", "--ledger",
+                     str(tmp_path / "led" / "a"),
+                     "--tag", "nope"]) == 1
+    capsys.readouterr()
+    assert cli.main(["journey"]) == 2
+    capsys.readouterr()
+
+    # tools/trace_summary.py reads the store directory as a third
+    # input format and renders the per-journey table
+    store = ObsStore(tmp_path / "store", "a", fsync=False)
+    store.append("event", name="request.admit", request_id="r0",
+                 tag="t1")
+    store.append("event", name="request.done", request_id="r0",
+                 tag="t1", spent_s=2.0)
+    drain(store, 3)
+    store.close()
+    import trace_summary
+    assert trace_summary.main([str(tmp_path / "store")]) == 0
+    text = capsys.readouterr().out
+    assert "journeys" in text and "t1" in text
+    # and a single segment FILE parses too (CRC format autodetected)
+    (seg,) = sorted((tmp_path / "store").glob("obs-a-*.jsonl"))
+    assert trace_summary.main([str(seg)]) == 0
+    capsys.readouterr()
+
+
+# ------------------------------------------------------- SLO burn rates
+
+
+def test_slo_error_burn_fires_across_store_lifetimes(tmp_path):
+    """Error-budget burn computed over the DURABLE terminal history:
+    failures journaled by a previous lifetime still burn after the
+    restart, and the alert needs BOTH windows hot."""
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    for i in range(6):
+        s.append("event", name="request.failed", request_id=f"a{i}",
+                 spent_s=0.1)
+    drain(s, 7)
+    s.close()
+
+    s2 = ObsStore(tmp_path, "w1", fsync=False)
+    assert len(s2.terminal_history()) == 6    # replay seeded
+    for i in range(4):
+        s2.append("event", name="request.done", request_id=f"b{i}",
+                  spent_s=0.1)
+    try:
+        reg = metrics.Registry()
+        th = health.Thresholds(slo_error_budget=0.01,
+                               slo_burn_threshold=2.0)
+        mon = health.HealthMonitor(registry=reg, thresholds=th,
+                                   interval_s=0, store=s2)
+        snap = mon.evaluate_now()
+        (al,) = [a for a in snap["alerts"]
+                 if a["rule"] == "slo_error_burn"]
+        # 6/10 bad over a 1% budget = burn 60 on both windows
+        assert al["detail"]["burn_fast"] == pytest.approx(60.0)
+        assert al["detail"]["burn_slow"] == pytest.approx(60.0)
+        assert al["detail"]["bad_slow"] == 6
+        assert al["detail"]["total_slow"] == 10
+        assert al["state"] == "firing"        # for_s=0: fires at once
+        g = reg.gauge("tts_slo_burn_rate")
+        assert g.value(slo="error", window="fast") == pytest.approx(
+            60.0)
+        assert g.value(slo="error", window="slow") == pytest.approx(
+            60.0)
+        mon.close()
+    finally:
+        s2.close()
+
+
+def test_slo_latency_burn_and_no_store_inactive(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    for i in range(5):
+        s.append("event", name="request.done", request_id=f"r{i}",
+                 spent_s=30.0)                # all over target
+    try:
+        reg = metrics.Registry()
+        th = health.Thresholds(slo_latency_target_s=10.0,
+                               slo_latency_budget=0.05,
+                               slo_burn_threshold=2.0)
+        mon = health.HealthMonitor(registry=reg, thresholds=th,
+                                   interval_s=0, store=s)
+        snap = mon.evaluate_now()
+        (al,) = [a for a in snap["alerts"]
+                 if a["rule"] == "slo_latency_burn"]
+        assert al["state"] == "firing"
+        assert al["detail"]["burn_fast"] == pytest.approx(20.0)
+        mon.close()
+
+        # no store attached -> the whole family is inert (the
+        # TTS_OBS_STORE=0 bit-identity stance)
+        reg2 = metrics.Registry()
+        mon2 = health.HealthMonitor(registry=reg2, thresholds=th,
+                                    interval_s=0)
+        snap2 = mon2.evaluate_now()
+        assert not [a for a in snap2["alerts"]
+                    if a["rule"].startswith("slo_")]
+        assert "tts_slo_burn_rate" not in reg2.to_prometheus()
+        mon2.close()
+    finally:
+        s.close()
+
+
+def test_slo_latency_burn_off_without_target(tmp_path):
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    s.append("event", name="request.done", request_id="r0",
+             spent_s=1e9)
+    try:
+        th = health.Thresholds(slo_latency_target_s=0.0)   # 0 = off
+        mon = health.HealthMonitor(registry=metrics.Registry(),
+                                   thresholds=th, interval_s=0,
+                                   store=s)
+        snap = mon.evaluate_now()
+        assert not [a for a in snap["alerts"]
+                    if a["rule"] == "slo_latency_burn"
+                    and a["state"] != "inactive"]
+        mon.close()
+    finally:
+        s.close()
+
+
+# ----------------------------------------- serve sessions with the store
+
+
+@pytest.fixture(scope="module")
+def baseline7():
+    inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=6)
+    got = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             n_devices=8, **KW)
+    return inst, (got.explored_tree, got.explored_sol, got.best)
+
+
+def test_serve_with_store_bit_identical_and_resumes_counters(
+        fresh_obs, baseline7, tmp_path, monkeypatch):
+    """TTS_OBS_STORE on: totals stay exactly the standalone counts
+    (observation-only), the terminal lands in the store, and a second
+    server lifetime resumes the whitelisted counters + journey."""
+    inst, base = baseline7
+    store_dir = tmp_path / "store"
+    monkeypatch.setenv("TTS_OBS_STORE", str(store_dir))
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                       ledger_dir=str(tmp_path / "led"),
+                       resource_sample_s=0.2)
+    try:
+        assert srv.obs_store is not None
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       tag="store1", tenant="acme",
+                                       **KW))
+        out = srv.result(rid, timeout=300)
+        assert out.state == "DONE"
+        res = out.result
+        assert (res.explored_tree, res.explored_sol, res.best) == base
+        assert srv.metrics.counter("tts_requests_total").value(
+            state="done", tenant="acme") == 1
+        # one explicit durable snapshot so the DONE counter is in the
+        # newest sample regardless of the sampler's cadence
+        srv.obs_store.sample_now(srv._obs_sample)
+        srv.obs_store.flush()
+        # HTTP journey endpoint serves the stitched view
+        httpd = start_http_server(srv)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.port}/journey?tag=store1",
+                timeout=10).read())
+            assert body["enabled"] and body["count"] == 1
+            (j,) = body["journeys"]
+            assert j["state"] == "DONE" and j["tenant"] == "acme"
+        finally:
+            httpd.close()
+    finally:
+        srv.close()
+    recs = read_store(store_dir)
+    assert any(r.get("name") == "request.done" for r in recs)
+    assert any(r["k"] == "sample" for r in recs)
+
+    # lifetime 2: same ledger + store -> counters resume, burn history
+    # non-empty, journey still ONE timeline (same rid via replay)
+    srv2 = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                        ledger_dir=str(tmp_path / "led"),
+                        resource_sample_s=0)
+    try:
+        assert srv2.obs_store.replayed > 0
+        assert srv2.metrics.counter("tts_requests_total") \
+            .value_matching(state="done") == 1
+        assert srv2.counters["done"] == 1
+        assert len(srv2.obs_store.terminal_history()) == 1
+        (j,) = srv2.journeys(tag="store1")
+        assert j["admits"] == 1 and j["terminals"] == 1
+        assert j["state"] == "DONE"
+        assert j["budget_monotone"] is True
+    finally:
+        srv2.close()
+
+
+def test_store_off_is_bit_identical_and_store_free(fresh_obs,
+                                                   baseline7,
+                                                   tmp_path,
+                                                   monkeypatch):
+    """TTS_OBS_STORE unset: no store object, no store files, no slo_*
+    alerts — and the exact standalone totals."""
+    inst, base = baseline7
+    monkeypatch.delenv("TTS_OBS_STORE", raising=False)
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd")
+    try:
+        assert srv.obs_store is None
+        assert srv.health.store is None
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        out = srv.result(rid, timeout=300)
+        assert out.state == "DONE"
+        res = out.result
+        assert (res.explored_tree, res.explored_sol, res.best) == base
+        text = srv.metrics.to_prometheus()
+        assert "tts_obs_store_records_total" not in text
+        assert "tts_slo_burn_rate" not in text
+    finally:
+        srv.close()
+    assert not list(tmp_path.glob("**/obs-*.jsonl"))
+
+
+def test_tenant_label_threads_submit_to_metrics_and_journey(
+        fresh_obs, tmp_path):
+    """Satellite: the optional `tenant` payload field rides admit ->
+    terminal counters -> journey records; unattributed requests stay
+    '-' and the exposition keeps both series separable."""
+    from tpu_tree_search.service.spool import (payload_from_request,
+                                               request_from_payload)
+    req = request_from_payload({"p_times": [[1, 2], [3, 4]], "lb": 1,
+                                "tenant": "acme"})
+    assert req.tenant == "acme"
+    assert payload_from_request(req)["tenant"] == "acme"
+    # the unattributed default is OMITTED from the payload (admit
+    # records stay byte-identical to pre-tenant ones)
+    req2 = request_from_payload({"p_times": [[1, 2], [3, 4]], "lb": 1})
+    assert req2.tenant == "-"
+    assert "tenant" not in payload_from_request(req2)
+
+    inst = PFSPInstance.synthetic(jobs=5, machines=3, seed=3)
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                       ledger_dir=str(tmp_path / "led"))
+    try:
+        ra = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                      tag="ta", tenant="acme", **KW))
+        rb = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                      tag="tb", **KW))
+        assert srv.result(ra, timeout=300).state == "DONE"
+        assert srv.result(rb, timeout=300).state == "DONE"
+        c = srv.metrics.counter("tts_requests_total")
+        assert c.value(state="done", tenant="acme") == 1
+        assert c.value(state="done", tenant="-") == 1
+        assert c.value_matching(state="done") == 2
+        assert srv.counters["done"] == 2
+        text = srv.metrics.to_prometheus()
+        assert 'tts_requests_total{state="done",tenant="acme"} 1' \
+            in text
+        (ja,) = srv.journeys(tag="ta")
+        assert ja["tenant"] == "acme"
+        (jb,) = srv.journeys(tag="tb")
+        assert jb["tenant"] == "-"
+    finally:
+        srv.close()

@@ -1,8 +1,10 @@
 """Per-segment search-telemetry table from a flight-recorder trace —
 and self-time attribution from an on-demand profiler capture.
 
-Given a FILE, reads either trace artifact (the JSONL event log or the
-Chrome trace-event JSON — same detection as tools/trace_summary.py) and
+Given a FILE, reads any trace artifact (the JSONL event log, the
+Chrome trace-event JSON, or a durable-store ``obs-*.jsonl`` segment —
+same detection as tools/trace_summary.py; a store directory works too
+and renders the per-journey tables) and
 folds the ``search.telemetry`` events the segmented engine driver emits
 when TTS_SEARCH_TELEMETRY / --search-telemetry is on
 (engine/checkpoint.run_segmented; the on-device block itself is
@@ -40,6 +42,7 @@ the trace, the workflow runs this on it).
 """
 
 import argparse
+import glob
 import os
 import sys
 
@@ -47,7 +50,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(
     0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from trace_summary import load_records  # noqa: E402
+from trace_summary import (journeys_from_store,  # noqa: E402
+                           load_records, render_journeys)
 
 TELEMETRY_EVENT = "search.telemetry"
 SEGMENT_SPAN = "segment"
@@ -205,11 +209,15 @@ def main(argv=None) -> int:
     ap.add_argument("--top", type=int, default=20,
                     help="ops listed in the self-time table")
     args = ap.parse_args(argv)
-    if os.path.isdir(args.trace):
+    if os.path.isdir(args.trace) and not glob.glob(
+            os.path.join(args.trace, "obs-*.jsonl")):
+        # no durable-store segments -> an XLA profiler artifact dir
+        # (a store directory falls through to load_records below)
         table = render_selftime(args.trace, top=args.top)
         if table is None:
             print(f"error: no XLA trace events under {args.trace} "
-                  "(expected plugins/profile/<run>/*.trace.json.gz)",
+                  "(expected plugins/profile/<run>/*.trace.json.gz, "
+                  "or obs-*.jsonl store segments)",
                   file=sys.stderr)
             return 1
         print(table)
@@ -222,6 +230,12 @@ def main(argv=None) -> int:
     groups = fold(records)
     gaps = segment_gaps(records)
     if not groups and not gaps:
+        # the durable store persists the control-plane subset, not the
+        # telemetry firehose: its report IS the per-journey view
+        journeys = journeys_from_store(records)
+        if journeys:
+            print(render_journeys(journeys))
+            return 0
         print(f"error: {len(records)} records but no "
               f"'{TELEMETRY_EVENT}' events or '{SEGMENT_SPAN}' spans "
               f"in {args.trace} — was the run started with "

@@ -1,19 +1,28 @@
 """Per-request latency/preemption table from a flight-recorder trace.
 
-Reads either trace artifact the observability layer produces —
+Reads any trace artifact the observability layer produces —
 
 - the JSONL event log (obs/tracelog's file sink, `serve --trace-file`,
-  TTS_TRACE_FILE, the campaign's `trace_file` row pointer), or
+  TTS_TRACE_FILE, the campaign's `trace_file` row pointer),
 - the Chrome trace-event JSON (obs/chrome_trace.write_chrome, the
-  `/trace` endpoint) — detected by the leading ``{"traceEvents": ...}``
+  `/trace` endpoint) — detected by the leading ``{"traceEvents": ...}``,
+- the DURABLE flight-recorder store (obs/store; TTS_OBS_STORE): a
+  store directory or one ``obs-*.jsonl`` CRC segment — detected by the
+  wrapped ``{"c": <crc>, "r": {...}}`` line format
 
 — and prints one row per request: terminal state, queue wait, total
 latency, execution seconds (summed `request.execute` spans), dispatch /
-preemption / checkpoint-save counts. Doubles as the CI artifact's
-well-formedness check (tests/test_obs.py runs it against both formats).
+preemption / checkpoint-save counts. Store input additionally renders
+PER-JOURNEY tables (one logical request across lifetimes/hosts:
+lifetimes, writers, preemptions, batch/portfolio membership, budget
+spent per lifetime) — store records span process lifetimes, so the
+cross-restart story exists only there. Doubles as the CI artifact's
+well-formedness check (tests/test_obs.py runs it against the formats).
 
     python tools/trace_summary.py /tmp/tts-trace.jsonl
     python tools/trace_summary.py /tmp/tts-trace.chrome.json
+    python tools/trace_summary.py /tmp/tts-store/          # store dir
+    python tools/trace_summary.py /tmp/tts-store/obs-host-ldg-00000001.jsonl
 """
 
 import argparse
@@ -31,11 +40,57 @@ TERMINALS = ("done", "cancelled", "deadline", "failed")
 SERVER_ROW = "<server>"
 
 
+def _store_to_records(store_recs: list[dict]) -> list[dict]:
+    """Durable-store records (obs/store schema: ``{"k", "t", "w", ...}``)
+    normalized to tracelog shape. Events keep their flattened
+    attributes; ``boot`` records become ``store.boot`` markers (the
+    lifetime delimiters the journey tables count); ``sample``
+    time-series records are dropped (no request story in them). Every
+    record keeps its ``writer`` — the per-host identity the single-
+    process trace formats never needed."""
+    out = []
+    for r in store_recs:
+        kind = r.get("k")
+        if kind == "event":
+            rec = {key: v for key, v in r.items()
+                   if key not in ("k", "t", "w")}
+            rec.setdefault("name", "?")
+        elif kind == "boot":
+            rec = {"name": "store.boot", "pid": r.get("pid")}
+        else:
+            continue
+        rec["ts"] = float(r.get("t", 0.0))
+        rec["writer"] = r.get("w", "?")
+        out.append(rec)
+    return out
+
+
 def load_records(path: str) -> list[dict]:
-    """Normalize either trace format to tracelog-shaped records
-    (name/ts[s]/dur[s] + flat attributes)."""
+    """Normalize any trace format to tracelog-shaped records
+    (name/ts[s]/dur[s] + flat attributes). A directory, or a file whose
+    first line is a CRC-wrapped ``{"c": ..., "r": {...}}`` record, is
+    read as the durable flight-recorder store (obs/store)."""
+    if os.path.isdir(path):
+        from tpu_tree_search.obs.store import read_store
+        return _store_to_records(read_store(path))
     with open(path) as f:
         head = f.read(4096).lstrip()
+    if head.startswith("{"):
+        try:
+            first = json.loads(head.splitlines()[0])
+        except (json.JSONDecodeError, IndexError):
+            first = None
+        if isinstance(first, dict) and set(first) == {"c", "r"}:
+            # one store segment: CRC-scan it exactly the way the store
+            # replays its own files (stop at the first damaged line)
+            from tpu_tree_search.obs.store import _scan_segment
+            recs = []
+            with open(path, "rb") as f:
+                for rec, _end in _scan_segment(f.read()):
+                    if rec is None:
+                        break
+                    recs.append(rec)
+            return _store_to_records(recs)
     if head.startswith("{") and '"traceEvents"' in head:
         # Chrome trace: events carry the original attributes in `args`,
         # timestamps/durations in µs
@@ -189,10 +244,96 @@ def render(reqs: dict[str, dict]) -> str:
     return "\n".join(lines)
 
 
+def journeys_from_store(records: list[dict]) -> dict[str, dict]:
+    """Per-JOURNEY summaries from store-shaped records (they carry
+    ``writer``): one logical request per tag, followed across process
+    lifetimes and hosts. A lifetime is one (writer, boot era) — the
+    ``store.boot`` markers delimit eras; a journey spanning two
+    lifetimes of one writer is a crash+restart, spanning two writers a
+    failover takeover. Budget is the max ``spent_s`` witnessed per
+    lifetime — cumulative across the journey when the ledger carried it
+    over (the budget-continuity check the CI journey leg pins)."""
+    era: dict[str, int] = {}
+    journeys: dict[str, dict] = {}
+    for r in sorted(records, key=lambda r: r.get("ts", 0.0)):
+        w = r.get("writer", "?")
+        name = r.get("name", "")
+        if name == "store.boot":
+            era[w] = era.get(w, 0) + 1
+            continue
+        if not name.startswith("request."):
+            continue
+        tag = r.get("tag") or r.get("request_id")
+        if tag is None:
+            continue
+        j = journeys.setdefault(str(tag), {
+            "rids": [], "writers": [], "lifetimes": {},
+            "preemptions": 0, "dispatches": 0, "takeovers": 0,
+            "batches": [], "pf_k": None, "state": "LIVE",
+            "tenant": "-"})
+        rid = r.get("request_id")
+        if rid is not None and rid not in j["rids"]:
+            j["rids"].append(rid)
+        if w not in j["writers"]:
+            j["writers"].append(w)
+        life = (w, era.get(w, 1))
+        lf = j["lifetimes"].setdefault(life, {
+            "events": 0, "dispatches": 0, "preemptions": 0,
+            "spent_end_s": 0.0})
+        lf["events"] += 1
+        if r.get("spent_s") is not None:
+            lf["spent_end_s"] = max(lf["spent_end_s"],
+                                    float(r["spent_s"]))
+        if r.get("tenant") not in (None, "-"):
+            j["tenant"] = r["tenant"]
+        if name == "request.preempt":
+            j["preemptions"] += 1
+            lf["preemptions"] += 1
+        elif name == "request.dispatch":
+            j["dispatches"] += 1
+            lf["dispatches"] += 1
+        elif name == "request.adopted":
+            j["takeovers"] += 1
+        elif name == "portfolio.fanout":
+            j["pf_k"] = r.get("k")
+        elif name.split(".", 1)[-1] in TERMINALS:
+            j["state"] = name.split(".", 1)[-1].upper()
+        b = r.get("batch") or r.get("batch_id")
+        if b is not None and b not in j["batches"]:
+            j["batches"].append(b)
+    return journeys
+
+
+def render_journeys(journeys: dict[str, dict]) -> str:
+    lines = ["request journeys (durable store: one logical request "
+             "across lifetimes/hosts)"]
+    for tag in sorted(journeys):
+        j = journeys[tag]
+        lines.append(
+            f"\njourney {tag}: state={j['state']} "
+            f"tenant={j['tenant']} rids={j['rids']} "
+            f"lifetimes={len(j['lifetimes'])} "
+            f"writers={len(j['writers'])} "
+            f"takeovers={j['takeovers']} "
+            f"dispatches={j['dispatches']} "
+            f"preemptions={j['preemptions']} "
+            f"batches={j['batches'] or '-'} "
+            f"portfolio_k={j['pf_k'] if j['pf_k'] is not None else '-'}")
+        for (w, n) in sorted(j["lifetimes"]):
+            lf = j["lifetimes"][(w, n)]
+            lines.append(
+                f"  lifetime {w}#{n}: events={lf['events']} "
+                f"dispatches={lf['dispatches']} "
+                f"preempts={lf['preemptions']} "
+                f"budget_end_s={lf['spent_end_s']:.3f}")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-request latency/preemption table from a "
-                    "flight-recorder trace (JSONL or Chrome JSON)")
+                    "flight-recorder trace (JSONL, Chrome JSON, or an "
+                    "obs-store directory/segment)")
     ap.add_argument("trace", help="trace file path")
     args = ap.parse_args(argv)
     records = load_records(args.trace)
@@ -206,6 +347,11 @@ def main(argv=None) -> int:
               f"{args.trace} (not a service trace?)", file=sys.stderr)
         return 1
     print(render(reqs))
+    if any("writer" in r for r in records):
+        journeys = journeys_from_store(records)
+        if journeys:
+            print()
+            print(render_journeys(journeys))
     return 0
 
 

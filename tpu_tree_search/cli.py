@@ -931,6 +931,56 @@ def run_doctor(args) -> int:
     return 1
 
 
+def _journey_parser(sub):
+    p = sub.add_parser(
+        "journey",
+        help="reconstruct request journeys from durable state "
+             "(obs/journey): one stitched cross-lifetime timeline per "
+             "logical request, chained through ledger admits, "
+             "failover takeovers and portfolio fan-outs — reads "
+             "ledger/fleet dirs and the flight-recorder store "
+             "straight off storage, no server required")
+    p.add_argument("--ledger", action="append", default=[],
+                   metavar="DIR",
+                   help="request-ledger directory (repeatable)")
+    p.add_argument("--fleet-dir", type=str, default=None,
+                   help="shared fleet root (TTS_FLEET_DIR): read EVERY "
+                        "peer ledger under it")
+    p.add_argument("--store", type=str, default=None,
+                   help="flight-recorder store directory "
+                        "(TTS_OBS_STORE): fold its trace events into "
+                        "each journey's timeline")
+    p.add_argument("--tag", type=str, default=None,
+                   help="only journeys whose tag (or any member rid) "
+                        "matches")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable journeys instead of the "
+                        "human report")
+
+
+def run_journey(args) -> int:
+    from .obs import journey as journey_mod
+
+    if not args.ledger and not args.fleet_dir:
+        print("journey: need --ledger and/or --fleet-dir",
+              file=sys.stderr)
+        return 2
+    journeys = journey_mod.find_journeys(
+        ledger_dirs=args.ledger or None, fleet_dir=args.fleet_dir,
+        store=args.store, tag=args.tag)
+    if args.json:
+        print(journey_mod.to_json(journeys))
+    elif not journeys:
+        print("no journeys"
+              + (f" matching tag {args.tag!r}" if args.tag else ""))
+    else:
+        for j in journeys:
+            print(journey_mod.render_journey(j))
+    # tag given but nothing matched: nonzero, so the CI leg's
+    # one-journey assertion can't silently pass on an empty answer
+    return 0 if journeys or not args.tag else 1
+
+
 def _nq_parser(sub):
     p = sub.add_parser("nqueens", help="N-Queens backtracking")
     d = NQueensConfig()
@@ -1375,6 +1425,7 @@ def main(argv=None) -> int:
     _client_parser(sub)
     _profile_parser(sub)
     _doctor_parser(sub)
+    _journey_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
                         "gpu_info, common/gpu_util.cu:5-17)")
@@ -1391,6 +1442,9 @@ def main(argv=None) -> int:
         # pure scraper: skip the compile cache / backend bootstrap —
         # the doctor must never touch (or wait for) an accelerator
         return run_doctor(args)
+    if args.cmd == "journey":
+        # pure storage reader (stdlib-only, same stance as doctor)
+        return run_journey(args)
     if args.platform:
         # Env vars alone are read too early (the environment preloads jax
         # via sitecustomize); flip the platform through jax.config.

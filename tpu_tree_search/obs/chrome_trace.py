@@ -61,6 +61,24 @@ COUNTER_KEYS = ("pruning_rate", "frontier_depth", "pool",
 RESOURCE_EVENT = "resource.sample"
 
 
+def _lifeline_of(rec: dict) -> str | None:
+    """The per-request LIFELINE lane a record also lands on: every
+    ``request.*`` lifecycle event repeats as an instant on one
+    ``request-<tag or id>`` track, so a single request's whole story —
+    admit, dispatches, preemptions, adoption, terminal — reads as one
+    horizontal line instead of being scattered across the submesh lanes
+    it actually ran on. Keyed by tag when the record carries one (the
+    tag is the identity that SURVIVES a failover re-admission under a
+    fresh rid, so both lifetimes land on the same lane)."""
+    name = str(rec.get("name", ""))
+    if not name.startswith("request."):
+        return None
+    ident = rec.get("tag") or rec.get("request_id")
+    if ident is None:
+        return None
+    return f"request-{ident}"
+
+
 def _counter_samples(rec: dict) -> list[tuple[str, float]]:
     """(counter_name, value) pairs a record contributes to Perfetto
     counter tracks; empty for non-counter events."""
@@ -91,7 +109,9 @@ def to_chrome(records: list[dict]) -> dict:
     ``search.telemetry`` events additionally emit ``C`` counter samples
     (COUNTER_KEYS), so Perfetto draws per-submesh counter tracks; the
     instant event is kept too — its args carry the full per-segment
-    record for tools/search_report.py's Chrome-format path."""
+    record for tools/search_report.py's Chrome-format path.
+    ``request.*`` lifecycle events additionally repeat on a
+    per-request LIFELINE lane (see :func:`_lifeline_of`)."""
     tids: dict[str, int] = {}
     events = []
     for rec in records:
@@ -117,6 +137,11 @@ def to_chrome(records: list[dict]) -> dict:
                     "name": f"{key} ({track})",
                     "ts": base["ts"],
                     "args": {key.split(" ")[-1]: val}})
+            lifeline = _lifeline_of(rec)
+            if lifeline is not None and lifeline != track:
+                lf_tid = tids.setdefault(lifeline, len(tids))
+                events.append({**base, "tid": lf_tid,
+                               "ph": "i", "s": "t"})
     meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": tid,
              "args": {"name": track}} for track, tid in tids.items()]
     # sorted lanes first, then events in timestamp order: Perfetto does

@@ -89,6 +89,14 @@ class Thresholds:
     pruning_min_nodes: float = cfg.HEALTH_PRUNING_MIN_NODES_DEFAULT
     audit_window_s: float = cfg.HEALTH_AUDIT_WINDOW_S_DEFAULT
     perf_json: str | None = None
+    # SLO burn-rate rules (durable-store terminal history; see the
+    # config module's SLO_* block for the window semantics)
+    slo_error_budget: float = cfg.SLO_ERROR_BUDGET_DEFAULT
+    slo_latency_target_s: float = cfg.SLO_LATENCY_TARGET_S_DEFAULT
+    slo_latency_budget: float = cfg.SLO_LATENCY_BUDGET_DEFAULT
+    slo_burn_fast_s: float = cfg.SLO_BURN_FAST_S_DEFAULT
+    slo_burn_slow_s: float = cfg.SLO_BURN_SLOW_S_DEFAULT
+    slo_burn_threshold: float = cfg.SLO_BURN_THRESHOLD_DEFAULT
 
     @classmethod
     def from_env(cls) -> "Thresholds":
@@ -103,7 +111,14 @@ class Thresholds:
             pruning_min_nodes=cfg.env_float(
                 "TTS_HEALTH_PRUNING_MIN_NODES"),
             audit_window_s=cfg.env_float("TTS_HEALTH_AUDIT_WINDOW_S"),
-            perf_json=cfg.env_str("TTS_HEALTH_PERF_JSON"))
+            perf_json=cfg.env_str("TTS_HEALTH_PERF_JSON"),
+            slo_error_budget=cfg.env_float("TTS_SLO_ERROR_BUDGET"),
+            slo_latency_target_s=cfg.env_float(
+                "TTS_SLO_LATENCY_TARGET_S"),
+            slo_latency_budget=cfg.env_float("TTS_SLO_LATENCY_BUDGET"),
+            slo_burn_fast_s=cfg.env_float("TTS_SLO_BURN_FAST_S"),
+            slo_burn_slow_s=cfg.env_float("TTS_SLO_BURN_SLOW_S"),
+            slo_burn_threshold=cfg.env_float("TTS_SLO_BURN_THRESHOLD"))
 
 
 @dataclasses.dataclass
@@ -351,6 +366,61 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
                       "mode": fo.get("mode"),
                       "takeovers": fo.get("takeovers")}
 
+    def _burn_windows(ctx, slo: str, bad_fn):
+        """Multi-window burn rate over the DURABLE store's terminal
+        history (obs/store.py): bad_fraction/budget per window, so a
+        budget spent across three restarts and a takeover still burns.
+        Publishes tts_slo_burn_rate{slo,window} and fires only when
+        BOTH windows exceed the threshold — fast alone is a blip, slow
+        alone is stale history. No store attached = never active
+        (bit-identical to the pre-store rule family)."""
+        store = getattr(ctx.monitor, "store", None)
+        if store is None:
+            return False, {}
+        budget = (th.slo_error_budget if slo == "error"
+                  else th.slo_latency_budget)
+        if budget <= 0:
+            return False, {}
+        now = time.time()
+        rows = store.terminal_history(now - th.slo_burn_slow_s)
+        burns = {}
+        counts = {}
+        for window, span in (("fast", th.slo_burn_fast_s),
+                             ("slow", th.slo_burn_slow_s)):
+            in_w = [r for r in rows if r[0] >= now - span]
+            bad = sum(1 for r in in_w if bad_fn(r))
+            burns[window] = ((bad / len(in_w)) / budget
+                             if in_w else 0.0)
+            counts[window] = (bad, len(in_w))
+        g = ctx.registry.gauge(
+            "tts_slo_burn_rate",
+            "SLO burn rate (bad_fraction/budget) per window, computed "
+            "over the durable store's terminal history")
+        for window, burn in burns.items():
+            g.set(round(burn, 4), slo=slo, window=window)
+        active = (burns["fast"] > th.slo_burn_threshold
+                  and burns["slow"] > th.slo_burn_threshold)
+        return active, {
+            "slo": slo, "budget": budget,
+            "burn_fast": round(burns["fast"], 4),
+            "burn_slow": round(burns["slow"], 4),
+            "bad_fast": counts["fast"][0],
+            "total_fast": counts["fast"][1],
+            "bad_slow": counts["slow"][0],
+            "total_slow": counts["slow"][1],
+            "threshold": th.slo_burn_threshold}
+
+    def slo_error_burn(ctx):
+        return _burn_windows(ctx, "error",
+                             lambda r: r[1] == "FAILED")
+
+    def slo_latency_burn(ctx):
+        target = th.slo_latency_target_s
+        if target <= 0:
+            return False, {}
+        return _burn_windows(ctx, "latency",
+                             lambda r: r[2] > target)
+
     def perf(ctx):
         path = th.perf_json
         if not path or not os.path.exists(path):
@@ -390,6 +460,14 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
              description="a fleet peer's ledger lease expired without "
                          "release (host down, requests orphaned; "
                          "observe-only fleets need an operator)"),
+        Rule("slo_error_burn", slo_error_burn, severity="critical",
+             description="error-budget burn over threshold in BOTH the "
+                         "fast and slow window (durable history — "
+                         "survives restarts and takeovers)"),
+        Rule("slo_latency_burn", slo_latency_burn, severity="warn",
+             description="latency-budget burn over threshold in both "
+                         "windows (spent_s over the target counts "
+                         "against the budget)"),
     ]
 
 
@@ -423,7 +501,12 @@ class HealthMonitor:
                  rules: list[Rule] | None = None,
                  thresholds: Thresholds | None = None,
                  interval_s: float | None = None,
-                 autostart: bool = True):
+                 autostart: bool = True, store=None):
+        # the durable obs store (obs/store.py) the slo_* burn rules
+        # window over; None (default) keeps the rule family exactly
+        # process-scoped. The server assigns it post-construction too
+        # (store wiring happens after the monitor exists).
+        self.store = store
         self.server = server
         self.registry = registry if registry is not None \
             else metrics.default()
@@ -497,6 +580,41 @@ class HealthMonitor:
         # retire the alert gauges: a closed server must not keep
         # publishing rule series (same valve as the resource sampler)
         self.registry.remove_matching("tts_alerts")
+        self.registry.remove_matching("tts_slo_burn_rate")
+
+    # --------------------------------------------------------- durability
+
+    def seed_history(self, samples: list[dict]) -> int:
+        """Refill the history rings from replayed obs-store ``sample``
+        records (boot resume): each record's ``history`` dict maps ring
+        name -> value at wall time ``t``. Rows older than what the ring
+        would have seen are kept anyway — the rings are bounded at
+        HISTORY either way. Returns rows seeded."""
+        seeded = 0
+        with self._lock:
+            for rec in samples:
+                hist = rec.get("history")
+                t = rec.get("t")
+                if not isinstance(hist, dict) or t is None:
+                    continue
+                for name, value in hist.items():
+                    if value is None:
+                        continue
+                    ring = self.history.setdefault(name, [])
+                    ring.append((round(float(t), 3), value))
+                    seeded += 1
+            for ring in self.history.values():
+                ring.sort(key=lambda row: row[0])
+                del ring[:-self.HISTORY]
+        return seeded
+
+    def history_sample(self) -> dict:
+        """The CURRENT history-ring signals as one dict — what the obs
+        store persists per sample record (the inverse of
+        :meth:`seed_history`)."""
+        with self._lock:
+            return {name: ring[-1][1]
+                    for name, ring in self.history.items() if ring}
 
     # -------------------------------------------------------- evaluation
 

@@ -19,6 +19,10 @@ FRONT of a running :class:`~tpu_tree_search.service.SearchServer`:
   snapshot (obs/health; the ``doctor`` CLI's verdict input);
 - ``GET /dashboard`` — self-contained HTML operational dashboard
   (obs/dashboard; stdlib only, zero external assets);
+- ``GET /journey?tag=`` — the flight recorder's request journeys
+  (obs/journey): one stitched cross-lifetime timeline per logical
+  request, reconstructed from the ledger/fleet dirs and the durable
+  event store; empty-but-valid without durable inputs;
 - ``POST /submit``  — admit a request; the JSON body uses the SAME
   payload schema as the file spool (service/spool.py: ``inst`` or
   ``p_times``, ``lb``, ``ub``, ``priority``, ``deadline_s``, ``tag``,
@@ -75,7 +79,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/alerts",
-                 "/dashboard", "/")
+                 "/dashboard", "/journey", "/")
     POST_PATHS = ("/submit", "/cancel", "/profile")
 
     def _query(self) -> dict:
@@ -88,6 +92,7 @@ class _Handler(BaseHTTPRequestHandler):
         self._route({"/healthz": obs.healthz, "/metrics": obs.metrics,
                      "/status": obs.status, "/trace": obs.trace,
                      "/alerts": obs.alerts, "/dashboard": obs.dashboard,
+                     "/journey": lambda: obs.journey(self._query()),
                      "/": obs.index}, other_method=self.POST_PATHS)
 
     def do_POST(self) -> None:  # noqa: N802 — http.server API
@@ -123,7 +128,8 @@ class _Handler(BaseHTTPRequestHandler):
                     {"error": f"unknown path {path!r}",
                      "endpoints": ["/healthz", "/metrics", "/status",
                                    "/trace", "/alerts", "/dashboard",
-                                   "/submit", "/cancel", "/profile"]})
+                                   "/journey", "/submit", "/cancel",
+                                   "/profile"]})
                     + "\n", "application/json")
                 return
             obs.http_requests.inc(path=path)
@@ -195,7 +201,7 @@ class ObsHttpd:
         return 200, json.dumps(
             {"service": "tpu_tree_search",
              "endpoints": ["/healthz", "/metrics", "/status", "/trace",
-                           "/alerts", "/dashboard",
+                           "/alerts", "/dashboard", "/journey",
                            "/submit", "/cancel", "/profile"]}) + "\n", \
             "application/json"
 
@@ -240,6 +246,20 @@ class ObsHttpd:
             body = {"enabled": False, "firing": 0, "alerts": []}
         else:
             body = {"enabled": True, **mon.alerts_snapshot()}
+        return 200, json.dumps(body) + "\n", "application/json"
+
+    def journey(self, query: dict):
+        """GET /journey?tag=: the flight recorder's cross-lifetime
+        request timelines (obs/journey), stitched from the server's
+        ledger/fleet dirs and durable event store. A server without
+        ledger or store answers an empty-but-valid document — journeys
+        need durable inputs, not a special-cased client."""
+        srv = self.server
+        if srv is None or not hasattr(srv, "journeys"):
+            body = {"enabled": False, "journeys": []}
+        else:
+            js = srv.journeys(tag=query.get("tag") or None)
+            body = {"enabled": True, "count": len(js), "journeys": js}
         return 200, json.dumps(body) + "\n", "application/json"
 
     def dashboard(self):

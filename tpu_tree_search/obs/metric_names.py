@@ -47,8 +47,9 @@ def _table(*rows: Metric) -> dict:
 REGISTRY: dict[str, Metric] = _table(
     # --- service: requests and queueing
     Metric("tts_requests_submitted_total", "counter", "", "admissions"),
-    Metric("tts_requests_total", "counter", "state",
-           "terminal states (done/cancelled/deadline/failed)"),
+    Metric("tts_requests_total", "counter", "state,tenant",
+           "terminal states (done/cancelled/deadline/failed) by "
+           "accounting tenant ('-' = unattributed)"),
     Metric("tts_preemptions_total", "counter", "",
            "higher-priority preemptions (checkpoint + requeue)"),
     Metric("tts_redispatches_total", "counter", "",
@@ -82,7 +83,7 @@ REGISTRY: dict[str, Metric] = _table(
            "submesh slots partitioned at startup"),
     Metric("tts_submeshes_busy", "gauge", "",
            "submeshes currently running a request"),
-    Metric("tts_phase_seconds", "gauge", "phase,worker,request",
+    Metric("tts_phase_seconds", "gauge", "phase,worker,request,tenant",
            "live kernel/gen_child/balance/idle attribution; series "
            "retire at the request's terminal state"),
     # --- executor + AOT caches
@@ -149,25 +150,26 @@ REGISTRY: dict[str, Metric] = _table(
     Metric("tts_ladder_switches_total", "counter", "direction",
            "chunk-ladder rung switches at segment boundaries"),
     # --- on-device search telemetry (TTS_SEARCH_TELEMETRY=1)
-    Metric("tts_search_popped", "gauge", "bucket,request,tag",
+    Metric("tts_search_popped", "gauge", "bucket,request,tag,tenant",
            "nodes popped by relative-depth bucket"),
-    Metric("tts_search_branched", "gauge", "bucket,request,tag",
+    Metric("tts_search_branched", "gauge", "bucket,request,tag,tenant",
            "children branched by relative-depth bucket"),
-    Metric("tts_search_pruned", "gauge", "bucket,request,tag",
+    Metric("tts_search_pruned", "gauge", "bucket,request,tag,tenant",
            "children pruned by relative-depth bucket"),
-    Metric("tts_search_bound_gap", "gauge", "outcome,bin,request,tag",
+    Metric("tts_search_bound_gap", "gauge",
+           "outcome,bin,request,tag,tenant",
            "child bound-value histogram, pruned vs surviving"),
-    Metric("tts_search_pruning_rate", "gauge", "request,tag",
+    Metric("tts_search_pruning_rate", "gauge", "request,tag,tenant",
            "pruned/evaluated ratio"),
-    Metric("tts_search_frontier_depth", "gauge", "request,tag",
+    Metric("tts_search_frontier_depth", "gauge", "request,tag,tenant",
            "mean relative frontier depth (0=root, 1=leaves)"),
-    Metric("tts_search_pool_highwater", "gauge", "request,tag",
+    Metric("tts_search_pool_highwater", "gauge", "request,tag,tenant",
            "peak pool occupancy"),
-    Metric("tts_search_steal_sent", "gauge", "request,tag",
+    Metric("tts_search_steal_sent", "gauge", "request,tag,tenant",
            "work-stealing rows sent"),
-    Metric("tts_search_steal_recv", "gauge", "request,tag",
+    Metric("tts_search_steal_recv", "gauge", "request,tag,tenant",
            "work-stealing rows received"),
-    Metric("tts_search_improvements", "gauge", "request,tag",
+    Metric("tts_search_improvements", "gauge", "request,tag,tenant",
            "incumbent improvements found"),
     # --- resources
     Metric("tts_device_bytes_in_use", "gauge", "device,platform",
@@ -213,6 +215,18 @@ REGISTRY: dict[str, Metric] = _table(
     Metric("tts_takeovers_total", "counter", "outcome",
            "expired peer leases handled by the failover watcher "
            "(outcome: adopted/observed/lost_race/error)"),
+    # --- fleet flight recorder (obs/store.py + SLO burn rules)
+    Metric("tts_obs_store_records_total", "counter", "",
+           "flight-recorder records appended to the durable store"),
+    Metric("tts_obs_store_replayed_total", "counter", "",
+           "flight-recorder records replayed at boot (all writers)"),
+    Metric("tts_obs_store_truncated_total", "counter", "",
+           "corrupt-tail flight-recorder records discarded at replay "
+           "(own segments truncated to last-good)"),
+    Metric("tts_slo_burn_rate", "gauge", "slo,window",
+           "SLO error-budget burn rate over the durable terminal "
+           "history (slo: error/latency; window: fast/slow; 1.0 = "
+           "spending exactly the budget)"),
     # --- health / audit / meta
     Metric("tts_alerts", "gauge", "rule,severity",
            "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),

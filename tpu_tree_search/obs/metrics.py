@@ -122,6 +122,16 @@ class Counter(_Metric):
         with self._lock:
             return self._series.get(_label_key(labels), 0)
 
+    def value_matching(self, **labels) -> float:
+        """Sum every series whose labels include these pairs — the
+        read-side aggregate for a family that grew an extra label
+        (tts_requests_total{state,tenant}: `value_matching(state="done")`
+        still answers "how many DONE" across all tenants)."""
+        want = {(str(k), str(v)) for k, v in labels.items()}
+        with self._lock:
+            return sum(v for k, v in self._series.items()
+                       if want <= set(k))
+
     def samples(self) -> list[tuple[str, tuple, float]]:
         # no synthetic zero sample when only labeled series exist (or
         # none yet): an unlabeled `name 0` that vanishes once the first

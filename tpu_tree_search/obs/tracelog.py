@@ -116,8 +116,23 @@ class TraceLog:
         self.max_sink_bytes = max(int(max_sink_bytes), 0)
         self.dropped = 0           # guarded-by: self._lock
         #                            (records evicted from the ring)
+        # fan-out listeners (the durable obs-store sink subscribes
+        # here): called OUTSIDE the lock with the finished record — a
+        # slow listener must not serialize the recorder — and a raising
+        # listener is dropped, never propagated
+        self._listeners: list = []
         if sink_path:
             self.set_sink(sink_path)
+
+    def add_listener(self, fn) -> None:
+        """Subscribe `fn(record)` to every emitted record."""
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     # ------------------------------------------------------------- sink
 
@@ -215,6 +230,11 @@ class TraceLog:
                     # a torn sink (disk full, closed fd) must never take
                     # the search down; the ring buffer keeps recording
                     self._sink = None
+        for fn in list(self._listeners):
+            try:
+                fn(rec)
+            except Exception:
+                self.remove_listener(fn)
 
     def event(self, name: str, **attrs) -> dict:
         """Record a point-in-time event; returns the record."""

@@ -193,6 +193,14 @@ class LedgerState:
             "dispatches": 0, "preemptions": 0, "failures": 0,
             "submesh": None, "failure_log": [], "excluded": [],
             "terminal": None, "error": None,
+            # accounting + failover lineage: the tenant label and (on
+            # an adoption re-admit) the rid/ledger-dir this request
+            # held under its dead owner — carried through compaction's
+            # restore records verbatim so the flight recorder can
+            # stitch one journey across the takeover
+            "tenant": rec.get("tenant") or "-",
+            "origin_rid": rec.get("origin_rid"),
+            "origin_owner": rec.get("origin_owner"),
         }
 
     def _apply_dispatch(self, rec: dict) -> None:

@@ -110,6 +110,13 @@ class SearchRequest:
     # and the losers cancel. None (default) = the exact pre-portfolio
     # path; the server may fill in TTS_PORTFOLIO when set
     portfolio: int | None = None
+    # accounting tenant: an OPAQUE label the client may stamp on the
+    # request ("-" = unattributed). Rides the admit ledger record, the
+    # request/phase/search metric families (behind the per-metric
+    # cardinality valve) and the flight-recorder journey, so per-team
+    # SLO burn and budget spend can be split without the server knowing
+    # anything about the teams. Never interpreted by scheduling.
+    tenant: str = "-"
 
     def __post_init__(self):
         # wire payloads carry portfolio as a plain int; normalize the
@@ -117,6 +124,10 @@ class SearchRequest:
         # `portfolio` is truthy exactly when a race is requested
         if self.portfolio in (0, 1):
             self.portfolio = None
+        # wire payloads may carry tenant as null/""; both mean
+        # unattributed — normalize so label values are never empty
+        if not self.tenant:
+            self.tenant = "-"
 
     def validate(self) -> str | None:
         """Admission-side validation; returns a rejection reason or
@@ -243,6 +254,14 @@ class RequestRecord:
     portfolio_config: dict | None = None    # member's raced config, or
     #                                         the winner's on the parent
     portfolio_cancelled: int = 0            # losers cancelled (parent)
+    # failover id lineage (service/failover.adopt_ledger): an adopted
+    # orphan re-admits under a FRESH rid; these point back at the rid
+    # it held in the dead owner's ledger (and that ledger's directory
+    # name), so the flight recorder can stitch ONE request journey
+    # across the takeover instead of two unrelated lifecycles. None on
+    # every locally-admitted request.
+    origin_rid: str | None = None
+    origin_owner: str | None = None
     done_event: threading.Event = dataclasses.field(
         default_factory=threading.Event)
 
@@ -279,6 +298,7 @@ class RequestRecord:
             # flight-recorder cross-reference: filter the JSONL event
             # log / Chrome trace by these to see this request's story
             "tag": self.request.tag or self.id,
+            "tenant": self.request.tenant,
             "share_group": self.request.share_group,
             "stop_reason": self.stop_reason,
             "hold": self.hold,
@@ -301,6 +321,12 @@ class RequestRecord:
                 else None),
             "progress": dict(self.progress),
         }
+        if self.origin_rid is not None:
+            # failover lineage: present only on adopted records, so the
+            # snapshot (and the terminal ledger record that embeds it)
+            # names the rid/owner this request continued from
+            out["origin_rid"] = self.origin_rid
+            out["origin_owner"] = self.origin_owner
         if self.portfolio_members is not None:
             out["portfolio"] = {
                 "k": len(self.portfolio_members),

@@ -56,6 +56,7 @@ import itertools
 import os
 import pathlib
 import shutil
+import socket
 import tempfile
 import threading
 import time
@@ -65,6 +66,7 @@ import numpy as np
 from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
 from ..obs import resource as obs_resource
+from ..obs import store as obs_store_mod
 from ..obs import tracelog
 from ..utils import config as cfg
 from ..utils import faults
@@ -460,12 +462,67 @@ class SearchServer:
                 self._replay_boot()
                 self.ledger.journal("boot", pid=os.getpid(),
                                    submeshes=len(self.slots))
+        # set BEFORE the watcher starts: its takeover thread journals
+        # our ledger-dir name as the `adopter` forward pointer
+        self._ledger_dir = ledger_dir or None
+        self._fleet_dir = fleet_dir or None
         if fleet_dir and not self.fenced:
             from .failover import FailoverWatcher
             self.watcher = FailoverWatcher(
                 self, fleet_dir, own_root=ledger_dir,
                 act=failover, registry=self.metrics)
             self.watcher.start()
+        # fleet flight recorder (obs/store): a durable metric/event
+        # store in the fleet/ledger dir, replayed here so dashboards,
+        # health history and whitelisted tts_* counters RESUME across
+        # restarts/takeovers, and the slo_* burn rules window over
+        # history older than this process. Unset TTS_OBS_STORE -> every
+        # store code path below is vacuous — bit-identical (test-pinned)
+        self.obs_store = None
+        store_dir = cfg.env_str(cfg.OBS_STORE_ENV)
+        if store_dir and not self.fenced:
+            # the writer id must be STABLE across restarts (counter
+            # resume keys on it) and DISTINCT across fleet peers: the
+            # host plus the ledger family when there is one
+            writer = socket.gethostname()
+            if ledger_dir:
+                writer += f"-{pathlib.Path(ledger_dir).name}"
+            else:
+                writer += f"-{os.getpid()}"
+            try:
+                self.obs_store = obs_store_mod.ObsStore(
+                    store_dir, writer, registry=self.metrics,
+                    segment_records=cfg.env_int(
+                        "TTS_OBS_STORE_SEGMENT_RECORDS"),
+                    retain_s=cfg.env_float("TTS_OBS_STORE_RETAIN_S"),
+                    queue_depth=cfg.env_int("TTS_OBS_STORE_QUEUE"))
+            except OSError as e:
+                # an unwritable store degrades to store-less serving —
+                # observability must not take the server down (the
+                # ledger's opposite stance is about DATA durability)
+                tracelog.event("obs_store.disabled", dir=store_dir,
+                               error=repr(e))
+            if self.obs_store is not None:
+                replayed = self.obs_store.records_replayed()
+                seeded = obs_store_mod.resume_counters(
+                    self.metrics, replayed, self.obs_store.writer)
+                self.health.store = self.obs_store
+                self.health.seed_history(
+                    [r for r in replayed if r.get("k") == "sample"
+                     and r.get("w") == self.obs_store.writer])
+                tracelog.get().add_listener(self.obs_store.on_trace_event)
+                interval = (resource_sample_s
+                            if resource_sample_s is not None
+                            else cfg.env_float("TTS_RESOURCE_SAMPLE_S"))
+                if interval > 0:
+                    self.obs_store.start_sampling(self._obs_sample,
+                                                  interval)
+                tracelog.event(
+                    "obs_store.open", dir=store_dir,
+                    writer=self.obs_store.writer,
+                    replayed=self.obs_store.replayed,
+                    truncated=self.obs_store.truncated,
+                    counters_seeded=seeded)
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
@@ -485,11 +542,13 @@ class SearchServer:
         pre-obs hand-rolled dict, kept as the JSON snapshot schema and
         for callers that read e.g. ``srv.counters["preemptions"]``)."""
         t = self._m_terminal
+        # value_matching, not value: terminal series carry a tenant
+        # label, so the lifecycle view sums across tenants
         return {"submitted": int(self._m_submitted.value()),
-                "done": int(t.value(state="done")),
-                "cancelled": int(t.value(state="cancelled")),
-                "deadline": int(t.value(state="deadline")),
-                "failed": int(t.value(state="failed")),
+                "done": int(t.value_matching(state="done")),
+                "cancelled": int(t.value_matching(state="cancelled")),
+                "deadline": int(t.value_matching(state="deadline")),
+                "failed": int(t.value_matching(state="failed")),
                 "preemptions": int(self._m_preempt.value()),
                 "redispatches": int(self._m_redispatch.value())}
 
@@ -567,6 +626,43 @@ class SearchServer:
             self.lease.release()
         for keeper in self._adopted:
             keeper.release()
+        # the obs store drains LAST so the close-path events above
+        # (server.close, lease.released) are on disk for the next
+        # lifetime's replay
+        if self.obs_store is not None:
+            tracelog.get().remove_listener(self.obs_store.on_trace_event)
+            self.obs_store.flush()
+            self.obs_store.close()
+
+    def _obs_sample(self) -> dict:
+        """One durable metrics snapshot (obs/store `sample` record):
+        whitelisted counters (the resume set), the history-ring gauge
+        signals, and the health rings' latest values."""
+        counters, gauges = [], []
+        for m in self.metrics.metrics():
+            if m.kind == "counter" \
+                    and m.name in obs_store_mod.RESUME_COUNTERS:
+                counters.extend([n, dict(k), v]
+                                for n, k, v in m.samples())
+        for reg in (self.metrics, obs_metrics.default()):
+            for m in reg.metrics():
+                if m.kind == "gauge" \
+                        and m.name in obs_store_mod.SAMPLE_GAUGES:
+                    gauges.extend([n, dict(k), v]
+                                  for n, k, v in m.samples())
+        return {"counters": counters, "gauges": gauges,
+                "history": self.health.history_sample()}
+
+    def journeys(self, tag: str | None = None) -> list[dict]:
+        """Stitched request journeys (obs/journey) over this server's
+        ledger, every fleet peer's ledger, and the durable store —
+        the GET /journey payload."""
+        from ..obs import journey as journey_mod
+        store_dir = (str(self.obs_store.root)
+                     if self.obs_store is not None else None)
+        return journey_mod.find_journeys(
+            ledger_dirs=[self._ledger_dir] if self._ledger_dir else [],
+            fleet_dir=self._fleet_dir, store=store_dir, tag=tag)
 
     def __enter__(self) -> "SearchServer":
         self.start()
@@ -725,10 +821,12 @@ class SearchServer:
                     "admit", rid=rid, tag=tag, seq=seq,
                     payload=payload_from_request(request),
                     spool_id=spool_id,
+                    tenant=request.tenant,
                     spent_s=round(rec.spent_prev_s, 3))
             tracelog.event("request.admit", request_id=rid, tag=tag,
                            priority=request.priority,
                            deadline_s=request.deadline_s,
+                           tenant=request.tenant,
                            resumable=rec.spent_prev_s > 0)
             return rid
 
@@ -1406,6 +1504,8 @@ class SearchServer:
         req = spool_mod.request_from_payload(entry.get("payload") or {})
         tag = entry.get("tag") or rid
         req.tag = tag
+        if entry.get("tenant"):
+            req.tenant = str(entry["tenant"])
         path = str(self.workdir / f"{tag}.ckpt.npz")
         rec = RequestRecord(
             id=rid, request=req, submitted_t=time.monotonic(),
@@ -1418,6 +1518,10 @@ class SearchServer:
             dispatches=int(entry.get("dispatches") or 0),
             preemptions=int(entry.get("preemptions") or 0),
             failures=int(entry.get("failures") or 0))
+        # adoption lineage survives the adopter's own restart: the
+        # replayed admit record carried it (see _adopt_entry)
+        rec.origin_rid = entry.get("origin_rid")
+        rec.origin_owner = entry.get("origin_owner")
         rec.failure_log = [dict(f) for f in
                            entry.get("failure_log") or []]
         # restored exclusions are re-capped against THIS lifetime's
@@ -1559,8 +1663,14 @@ class SearchServer:
         moved = reserved = failed = 0
         orphan = RequestLedger(orphan_dir, lease=keeper)
         try:
+            # `adopter` names OUR ledger directory: the forward pointer
+            # a journey reconstructor reading the orphan needs to know
+            # where the live requests went (origin_rid on our admits is
+            # the matching back pointer)
             orphan.journal("takeover", owner=keeper.owner,
-                           from_epoch=current_epoch, pid=os.getpid())
+                           from_epoch=current_epoch, pid=os.getpid(),
+                           adopter=(pathlib.Path(self._ledger_dir).name
+                                    if self._ledger_dir else None))
             entries = sorted(orphan.state.requests.values(),
                              key=lambda e: e.get("seq", 0))
             for entry in entries:
@@ -1601,6 +1711,8 @@ class SearchServer:
         req = spool_mod.request_from_payload(entry.get("payload") or {})
         tag = entry.get("tag") or rid_old
         req.tag = tag
+        if entry.get("tenant"):
+            req.tenant = str(entry["tenant"])
         src_dir = pathlib.Path(orphan_dir) / "workdir"
         path = str(self.workdir / f"{tag}.ckpt.npz")
         for suffix in ("", ".prev"):
@@ -1628,6 +1740,16 @@ class SearchServer:
                 dispatches=int(entry.get("dispatches") or 0),
                 preemptions=int(entry.get("preemptions") or 0),
                 failures=int(entry.get("failures") or 0))
+            # id lineage: the fresh rid continues the orphan's rid —
+            # stamped on the record, its admit journal and the adopted
+            # event, so the flight recorder's journey reconstructor
+            # chains ONE logical request across the takeover. If the
+            # entry itself was already an adoption (a second hop), the
+            # ORIGINAL lineage wins: chains stay one link deep to the
+            # first admit.
+            rec.origin_rid = entry.get("origin_rid") or rid_old
+            rec.origin_owner = (entry.get("origin_owner")
+                                or pathlib.Path(orphan_dir).name)
             rec.failure_log = [dict(f) for f in
                                entry.get("failure_log") or []]
             excluded = {int(s) for s in entry.get("excluded") or []
@@ -1648,7 +1770,10 @@ class SearchServer:
                     "admit", rid=rid, tag=tag, seq=seq,
                     payload=spool_mod.payload_from_request(req),
                     spool_id=entry.get("spool_id"),
-                    spent_s=round(rec.spent_prev_s, 3))
+                    spent_s=round(rec.spent_prev_s, 3),
+                    tenant=req.tenant,
+                    origin_rid=rec.origin_rid,
+                    origin_owner=rec.origin_owner)
                 if rec.excluded_submeshes:
                     self.ledger.journal(
                         "exclude", rid=rid,
@@ -1659,6 +1784,9 @@ class SearchServer:
             self.replayed_spool[str(entry["spool_id"])] = rid
         tracelog.event("request.adopted", request_id=rid,
                        orphan_id=rid_old, tag=tag, state=rec.state,
+                       tenant=req.tenant,
+                       origin_rid=rec.origin_rid,
+                       origin_owner=rec.origin_owner,
                        spent_s=round(rec.spent_prev_s, 3),
                        spool_id=entry.get("spool_id"))
         return rid
@@ -1815,7 +1943,7 @@ class SearchServer:
             # restart (and the forensic record of HOW it ended)
             self.ledger.journal("terminal", rid=rec.id, state=state,
                                snapshot=rec.snapshot())
-        self._m_terminal.inc(state=key)
+        self._m_terminal.inc(state=key, tenant=rec.request.tenant)
         self._m_spent.observe(rec.spent_s())
         # live-attribution series are per-request labeled; retire them
         # with the request or a long-serving process grows gauge
@@ -1831,6 +1959,8 @@ class SearchServer:
         for name in tele_mod.SERIES:
             self.metrics.remove_matching(name, request=rec.id)
         tracelog.event(f"request.{key}", request_id=rec.id,
+                       tag=rec.request.tag or rec.id,
+                       tenant=rec.request.tenant,
                        spent_s=round(rec.spent_s(), 3),
                        dispatches=rec.dispatches,
                        preemptions=rec.preemptions, error=rec.error)
@@ -2124,7 +2254,8 @@ class SearchServer:
                 from ..engine import telemetry as tele_mod
                 tele_mod.publish(rep.telemetry, self.metrics,
                                  request=rec.id,
-                                 tag=rec.request.tag or rec.id)
+                                 tag=rec.request.tag or rec.id,
+                                 tenant=rec.request.tenant)
                 rec.progress["telemetry"] = {
                     k: rep.telemetry[k] for k in
                     ("pruning_rate", "frontier_depth",
@@ -2413,7 +2544,8 @@ class SearchServer:
                 # progress snapshot
                 from ..engine import telemetry as tele_mod
                 tele_mod.publish(rep.telemetry, self.metrics,
-                                 request=rec.id, tag=req.tag or rec.id)
+                                 request=rec.id, tag=req.tag or rec.id,
+                                 tenant=req.tenant)
                 rec.progress["telemetry"] = {
                     k: rep.telemetry[k] for k in
                     ("pruning_rate", "frontier_depth",
@@ -2574,7 +2706,8 @@ class SearchServer:
             prof, elapsed=rec.spent_s(),
             evals=rep.per_worker["evals"], iters=rep.per_worker["iters"])
         phase_timing.publish_attribution(att, registry=self.metrics,
-                                         request=rec.id)
+                                         request=rec.id,
+                                         tenant=rec.request.tenant)
 
     def _on_finished(self, slot: _Slot, rec: RequestRecord,
                      res, error: str | None) -> None:

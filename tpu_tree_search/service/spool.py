@@ -85,6 +85,8 @@ def request_from_payload(payload: dict) -> SearchRequest:
         kwargs["deadline_s"] = float(payload["deadline_s"])
     if payload.get("share_group") is not None:
         kwargs["share_group"] = str(payload["share_group"])
+    if payload.get("tenant") is not None:
+        kwargs["tenant"] = str(payload["tenant"])
     if payload.get("portfolio") is not None:
         kwargs["portfolio"] = int(payload["portfolio"])
     if payload.get("checkpoint_meta") is not None:
@@ -140,6 +142,10 @@ def payload_from_request(req: SearchRequest) -> dict:
             payload[k] = int(v)
     if req.share_group is not None:
         payload["share_group"] = str(req.share_group)
+    if req.tenant != "-":
+        # "-" is the unattributed default; omitted so an unattributed
+        # request's admit record is byte-identical to pre-tenant ones
+        payload["tenant"] = str(req.tenant)
     if req.portfolio is not None:
         payload["portfolio"] = int(req.portfolio)
     if req.checkpoint_meta:

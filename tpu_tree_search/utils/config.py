@@ -171,6 +171,51 @@ OBS_METRIC_MAX_SERIES_DEFAULT = 2048  # per-metric label-set cap
                                       # degrade the metric, not the
                                       # process
 
+# Fleet flight recorder (obs/store.py + obs/journey.py). TTS_OBS_STORE
+# names the durable observability-store directory (usually inside the
+# fleet/ledger root so it survives the host): metric snapshots and
+# whitelisted trace events are appended as fsync'd CRC-stamped JSONL
+# segments under PER-WRITER file names (obs-<writer>-NNNNNNNN.jsonl —
+# the PR-16 quarantine rule, so N peers sharing the store never collide)
+# and replayed at boot, so dashboards, health history and tts_* counters
+# RESUME across restarts and takeovers instead of zeroing. Unset = off,
+# bit-identical to the store-less stack (the sink, the sampler and the
+# replay are all vacuous).
+OBS_STORE_ENV = "TTS_OBS_STORE"
+OBS_STORE_SEGMENT_RECORDS_DEFAULT = 4096  # TTS_OBS_STORE_SEGMENT_RECORDS
+#                                           — records per segment before
+#                                           rotation (the ledger's bound)
+OBS_STORE_RETAIN_S_DEFAULT = 86400.0  # TTS_OBS_STORE_RETAIN_S — whole
+#                                       segments whose newest record is
+#                                       older than this are pruned at
+#                                       rotation (time-series retention;
+#                                       the ledger compacts state, the
+#                                       store expires history)
+OBS_STORE_QUEUE_DEFAULT = 4096        # TTS_OBS_STORE_QUEUE — bounded
+#                                       sink-queue depth; a full queue
+#                                       DROPS the sample (observability
+#                                       must never block the scheduler)
+
+# SLO burn-rate rules (obs/health.py slo_error_burn / slo_latency_burn).
+# Classic multi-window burn: the error budget is TTS_SLO_ERROR_BUDGET
+# (allowed bad fraction of terminals) and the burn rate is
+# bad_fraction/budget over a window; the alert fires only when BOTH the
+# fast and the slow window burn above TTS_SLO_BURN_THRESHOLD — fast
+# alone is a blip, slow alone is stale history. Windows are computed
+# over the durable store's terminal history (wall-clock stamped), so a
+# budget spent across three restarts and a takeover still fires.
+SLO_ERROR_BUDGET_DEFAULT = 0.01       # TTS_SLO_ERROR_BUDGET
+SLO_LATENCY_TARGET_S_DEFAULT = 0.0    # TTS_SLO_LATENCY_TARGET_S — per-
+#                                       request spent_s above this is a
+#                                       latency violation (0 = latency
+#                                       SLO off)
+SLO_LATENCY_BUDGET_DEFAULT = 0.05     # TTS_SLO_LATENCY_BUDGET
+SLO_BURN_FAST_S_DEFAULT = 300.0       # TTS_SLO_BURN_FAST_S (5m window)
+SLO_BURN_SLOW_S_DEFAULT = 3600.0      # TTS_SLO_BURN_SLOW_S (1h window)
+SLO_BURN_THRESHOLD_DEFAULT = 2.0      # TTS_SLO_BURN_THRESHOLD — burn
+#                                       multiple both windows must
+#                                       exceed to fire
+
 # Operational-health defaults (obs/health.py — the SLO/anomaly rules
 # engine every serve session runs). Env-driven (TTS_HEALTH_*) for the
 # same respawn-survival reason as the knobs above; <= 0 interval
@@ -491,6 +536,37 @@ KNOBS: dict[str, Knob] = _knob_table(
     Knob("TTS_RESOURCE_SAMPLE_S", "float", OBS_RESOURCE_SAMPLE_S_DEFAULT,
          "resource-sampler cadence (device bytes + host RSS; <= 0 "
          "disables the daemon)"),
+    # --- fleet flight recorder (obs/store.py + obs/journey.py;
+    #     semantics per README "Flight recorder")
+    Knob("TTS_OBS_STORE", "str", None,
+         "durable observability-store directory (per-writer CRC JSONL "
+         "segments, replayed at boot; unset = off, bit-identical)"),
+    Knob("TTS_OBS_STORE_SEGMENT_RECORDS", "int",
+         OBS_STORE_SEGMENT_RECORDS_DEFAULT,
+         "obs store: records per segment before rotation"),
+    Knob("TTS_OBS_STORE_RETAIN_S", "float", OBS_STORE_RETAIN_S_DEFAULT,
+         "obs store: retention window — whole segments older than this "
+         "are pruned at rotation"),
+    Knob("TTS_OBS_STORE_QUEUE", "int", OBS_STORE_QUEUE_DEFAULT,
+         "obs store: bounded sink-queue depth (full queue drops the "
+         "sample, never blocks the scheduler)"),
+    # --- SLO burn-rate rules (obs/health.py; multi-window burn over
+    #     the durable store's terminal history)
+    Knob("TTS_SLO_ERROR_BUDGET", "float", SLO_ERROR_BUDGET_DEFAULT,
+         "error SLO: allowed failed fraction of terminal requests"),
+    Knob("TTS_SLO_LATENCY_TARGET_S", "float",
+         SLO_LATENCY_TARGET_S_DEFAULT,
+         "latency SLO: per-request spent_s above this is a violation "
+         "(0 = latency SLO off)"),
+    Knob("TTS_SLO_LATENCY_BUDGET", "float", SLO_LATENCY_BUDGET_DEFAULT,
+         "latency SLO: allowed violating fraction of terminals"),
+    Knob("TTS_SLO_BURN_FAST_S", "float", SLO_BURN_FAST_S_DEFAULT,
+         "burn-rate fast window (seconds)"),
+    Knob("TTS_SLO_BURN_SLOW_S", "float", SLO_BURN_SLOW_S_DEFAULT,
+         "burn-rate slow window (seconds)"),
+    Knob("TTS_SLO_BURN_THRESHOLD", "float", SLO_BURN_THRESHOLD_DEFAULT,
+         "burn multiple BOTH windows must exceed for the slo_* rules "
+         "to fire"),
     # --- audit
     Knob("TTS_AUDIT", "str", "1",
          "node-conservation auditor: '1' on (default), '0' off, "
