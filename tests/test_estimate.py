@@ -1,0 +1,556 @@
+"""Predictive observability: the online tree-size / progress / ETA
+estimator (obs/estimate.py) and everything threaded on top of it.
+
+The load-bearing assertions (ISSUE acceptance):
+
+- calibration on real engine runs of all three tier-1 workloads: the
+  published progress is monotone non-decreasing after warmup, strictly
+  below 1.0 mid-solve, and the mid-solve total-size estimate at the
+  true half-node point is within a factor of 4 of the real tree;
+- estimator state rides checkpoint meta: a DEADLINE'd request's
+  resubmission resumes the estimate WARM — including across a 4->2
+  elastic reshard — and the published progress never moves backwards
+  over the boundary;
+- `TTS_PROGRESS=0` is bit-identical to the pre-estimator server: no
+  estimator object, no snapshot keys, no gauges, and the health-rule
+  list itself omits the predictive pair;
+- the predictive rules fire from a snapshot (deadline_risk before the
+  DEADLINE terminal; slo_latency_risk against per-tenant targets);
+- per-tenant threshold overrides (TTS_HEALTH_TENANT_OVERRIDES) give
+  overridden tenants their own burn series without touching the
+  aggregate samples existing dashboards key on;
+- the IncrementalExporter ships each tracelog record at most once
+  across repeated flushes (serve --otel-interval-s), and an exporter
+  failure leaves the watermark so the tail retries.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from tpu_tree_search.engine import distributed
+from tpu_tree_search.obs import (estimate, health, journey as journey_mod,
+                                 metrics, otel, tracelog)
+from tpu_tree_search.obs.store import ObsStore
+from tpu_tree_search.problems.knapsack import KnapsackInstance
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.problems.tsp import TSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+PROGRESS_GAUGES = ("tts_progress_ratio", "tts_eta_seconds",
+                   "tts_est_tree_size")
+
+
+@pytest.fixture
+def fresh_obs(tmp_path):
+    log = tracelog.TraceLog(capacity=1 << 16,
+                            sink_path=tmp_path / "trace.jsonl")
+    prev_log = tracelog.install(log)
+    reg = metrics.Registry()
+    prev_reg = metrics.install(reg)
+    try:
+        yield log, reg
+    finally:
+        tracelog.install(prev_log)
+        metrics.install(prev_reg)
+
+
+# --------------------------------------------------- estimator unit tests
+
+
+def test_estimator_warmup_gate_and_monotone_publish():
+    e = estimate.ProgressEstimator(warmup_segments=2, warmup_nodes=100,
+                                   alpha=0.5)
+    # warmup: neither gate met -> nothing published
+    assert e.update(tree=60, pool=30, elapsed=0.5) is False
+    assert e.progress is None and e.est_total is None
+    assert e.eta_s() is None
+    assert e.snapshot() == {"segments": 1}
+    # segments met, nodes not
+    assert e.update(tree=90, pool=20, elapsed=1.0) is False
+    assert e.progress is None
+    # both met -> published
+    assert e.update(tree=150, pool=15, elapsed=1.5) is True
+    p1 = e.progress
+    assert p1 is not None and 0.0 < p1 < 1.0
+    assert e.est_total > e.nodes
+    assert e.eta_s(fallback_rate=100.0) > 0.0
+    # a pessimistic later window (pool explosion) cannot move the
+    # PUBLISHED value backwards
+    e.update(tree=160, pool=500, elapsed=2.0)
+    assert e.progress >= p1
+    # an empty pool says "raw progress 1.0" but published stays
+    # strictly below 1.0 until the terminal state finalizes
+    e.update(tree=200, pool=0, elapsed=2.5)
+    assert e.progress <= 0.999
+    e.finalize()
+    assert e.progress == 1.0
+    assert e.eta_s() == 0.0
+    assert e.est_total == e.nodes
+    snap = e.snapshot()
+    assert snap["progress_ratio"] == 1.0 and snap["eta_s"] == 0.0
+
+
+def test_estimator_state_roundtrip_and_foreign_meta():
+    e = estimate.ProgressEstimator(warmup_segments=1, warmup_nodes=1,
+                                   alpha=0.4, depth_hint=12)
+    e.update(tree=100, pool=40, elapsed=1.0)
+    e.update(tree=250, pool=30, elapsed=2.0)
+    vec = e.to_list()
+    e2 = estimate.ProgressEstimator.from_list(
+        vec, warmup_segments=1, warmup_nodes=1, alpha=0.4)
+    assert e2 is not None
+    assert e2.to_list() == vec
+    assert e2.segments == e.segments
+    assert e2.published == e.published
+    assert e2.depth_hint == 12.0
+    # a restored estimator is on a NEW dispatch: its rate clock must
+    # accept the reset elapsed origin without a negative-delta sample
+    assert e2.update(tree=260, pool=28, elapsed=0.5) is True
+    assert e2.progress >= e.published
+    # foreign / torn meta degrades to None (cold start), never raises
+    assert estimate.ProgressEstimator.from_list([2.0] + vec[1:]) is None
+    assert estimate.ProgressEstimator.from_list(vec[:5]) is None
+    assert estimate.ProgressEstimator.from_list("garbage") is None
+    assert estimate.ProgressEstimator.from_list(None) is None
+
+
+def test_estimator_depth_resolved_cascade_pinned():
+    """The survivor-ratio cascade, hand-computed. Bands 2..7 are
+    unvisited and inherit band 1's measured ratio; the infinite
+    geometric closure at the deepest band doubles every band's total
+    at rho=0.5."""
+    tele = {"popped":   [100, 50, 0, 0, 0, 0, 0, 0],
+            "branched": [300, 60, 0, 0, 0, 0, 0, 0],
+            "pruned":   [100, 35, 0, 0, 0, 0, 0, 0],
+            "frontier_depth": 1.0 / 7.0}
+    # rho0 = (300-100)/100 = 2.0 -> clamped 0.95; rho1 = 25/50 = 0.5;
+    # cascade[7] = 1/(1-0.5) = 2, and 1 + 0.5*2 = 2 all the way up to
+    # cascade[1]; frontier band = int(1/7 * 7) = 1 -> remaining =
+    # pool * 2
+    e = estimate.ProgressEstimator(warmup_segments=1, warmup_nodes=1)
+    assert e.update(tree=150, pool=30, elapsed=1.0, telemetry=tele)
+    assert e.est_total == pytest.approx(150 + 30 * 2)
+    assert e.progress == pytest.approx(150 / 210, abs=1e-4)
+    # with a depth hint the closure is FINITE: 16 levels / 8 buckets =
+    # 2 levels per bucket; at rho=0.5 a bucket's own progeny is
+    # 1 + 0.5 = 1.5 and it passes 0.25 survivors on, so
+    # T = 1.5 * (1 + 0.25 + ... + 0.25^6) + 0.25^6 * 0 ~= 1.9995
+    e2 = estimate.ProgressEstimator(warmup_segments=1, warmup_nodes=1,
+                                    depth_hint=16)
+    assert e2.update(tree=150, pool=30, elapsed=1.0, telemetry=tele)
+    t7 = 1.5
+    for _ in range(6):
+        t7 = 1.5 + 0.25 * t7
+    assert e2.est_total == pytest.approx(150 + 30 * t7)
+    # no usable per-bucket counts -> aggregate fallback:
+    # rho = 1 + d_pool/d_nodes = 1 + 40/100 -> clamp 0.95 ->
+    # remaining = pool / 0.05
+    e3 = estimate.ProgressEstimator(warmup_segments=1, warmup_nodes=1)
+    assert e3.update(tree=100, pool=40, elapsed=1.0)
+    assert e3.est_total == pytest.approx(100 + 40 / 0.05)
+
+
+# ------------------------------------------------- engine-run calibration
+
+
+CALIBRATION = {
+    "pfsp": lambda: (PFSPInstance.synthetic(jobs=8, machines=3,
+                                            seed=5).p_times,
+                     dict(lb_kind=1)),
+    "tsp": lambda: (TSPInstance.synthetic(9, 2).d, {}),
+    "knapsack": lambda: (KnapsackInstance.synthetic(18, 2).table, {}),
+}
+
+
+@pytest.mark.parametrize("problem", sorted(CALIBRATION))
+def test_calibration_monotone_and_half_point_factor_4(problem,
+                                                      monkeypatch):
+    """ISSUE acceptance, per tier-1 workload: drive the estimator from
+    REAL segment reports (heartbeat callback, depth-bucket telemetry
+    compiled in) and pin monotonicity plus factor-of-4 accuracy at the
+    true half-node point."""
+    monkeypatch.setenv("TTS_SEARCH_TELEMETRY", "1")
+    arr, kw = CALIBRATION[problem]()
+    est = estimate.ProgressEstimator(warmup_segments=2, warmup_nodes=100,
+                                     alpha=0.3, depth_hint=arr.shape[0])
+    trail = []
+
+    def hb(rep):
+        est.update(tree=rep.tree, pool=rep.pool_size,
+                   elapsed=rep.elapsed, telemetry=rep.telemetry)
+        trail.append((rep.tree, est.progress, est.est_total))
+
+    res = distributed.search(arr, problem=problem, n_devices=4,
+                             chunk=8, capacity=1 << 14, min_seed=8,
+                             segment_iters=4, heartbeat=hb, **kw)
+    total = res.explored_tree
+    assert trail[0][1] is None                   # warmup gated
+    pub = [(n, p, t) for n, p, t in trail if p is not None]
+    assert len(pub) >= 2, f"too few published samples: {trail}"
+    # monotone non-decreasing, strictly below 1.0 until finalize
+    assert all(b[1] >= a[1] for a, b in zip(pub, pub[1:]))
+    assert all(p < 1.0 for _, p, _ in pub)
+    est.finalize()
+    # the terminal pin: exactly 1.0, zero remaining (the last heartbeat
+    # may predate the final partial segment, so nodes <= the result)
+    assert est.progress == 1.0 and est.eta_s() == 0.0
+    assert est.est_total == est.nodes <= total
+    # the estimate at the published sample nearest the true half-node
+    # point is within a factor of 4 of the real total
+    nodes, _, est_total = min(pub, key=lambda r: abs(r[0] - total / 2))
+    assert total / 4 <= est_total <= total * 4, (
+        f"{problem}: est {est_total} at {nodes}/{total} nodes "
+        f"outside factor 4")
+
+
+# ------------------------------------- serve threading: resume + reshard
+
+
+def test_progress_rides_checkpoint_resume_and_reshard(
+        fresh_obs, tmp_path, monkeypatch):
+    """A DEADLINE'd request leaves its estimator state in checkpoint
+    meta; the resubmission (here ALSO resharded 4 -> 2 workers per
+    submesh) resumes the estimate warm and keeps the published
+    progress monotone across the boundary."""
+    monkeypatch.setenv("TTS_PROGRESS_WARMUP_SEGMENTS", "1")
+    monkeypatch.setenv("TTS_PROGRESS_WARMUP_NODES", "50")
+    inst = PFSPInstance.synthetic(jobs=9, machines=3, seed=1)
+    wd = tmp_path / "wd"
+    with SearchServer(n_submeshes=2, workdir=wd,
+                      segment_iters=8) as srv:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       tag="resume-me", deadline_s=1.0,
+                                       **KW))
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DEADLINE", (rec.state, rec.error)
+        assert rec.estimator is not None
+        seg0 = rec.estimator.segments
+        pub0 = rec.estimator.published
+        assert seg0 > 0
+        # DEADLINE retired the per-request gauges
+        for name in PROGRESS_GAUGES:
+            m = srv.metrics.gauge(name)
+            assert not [k for _, k, _ in m.samples()
+                        if ("request", rid) in k]
+    from tpu_tree_search.service.server import _prior_progress_est
+    vec = _prior_progress_est(str(wd / "resume-me.ckpt.npz"))
+    assert vec is not None
+    assert 0 < int(vec[1]) <= seg0               # estimator state rode meta
+
+    with SearchServer(n_submeshes=4, workdir=wd, segment_iters=8,
+                      autostart=False) as srv2:
+        rid2 = srv2.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                         tag="resume-me",
+                                         deadline_s=600.0, **KW))
+        est2 = srv2.records[rid2].estimator
+        assert est2 is not None
+        assert est2.segments == int(vec[1])      # warm, not cold
+        assert est2.published == pytest.approx(vec[6])
+        srv2.start()
+        t0 = time.monotonic()
+        while True:
+            s = srv2.status(rid2)
+            seg_now = (s["progress"].get("estimate")
+                       or {}).get("segments", 0)
+            if (seg_now > est2.segments
+                    or s["state"] not in ("QUEUED", "RUNNING")):
+                break
+            assert time.monotonic() - t0 < 300
+            time.sleep(0.05)
+        s = srv2.status(rid2)
+        assert s["state"] in ("QUEUED", "RUNNING", "DONE"), (
+            s["state"], s["error"])
+        snap_est = s["progress"].get("estimate") or {}
+        assert snap_est.get("segments", 0) > int(vec[1])  # continued warm
+        # published progress never moved backwards over resume+reshard
+        if snap_est.get("progress_ratio") is not None:
+            assert snap_est["progress_ratio"] >= round(pub0, 4) - 1e-9
+        if s["state"] != "DONE":                 # fast solves may finish
+            assert srv2.cancel(rid2)
+            assert srv2.result(rid2, timeout=300).state == "CANCELLED"
+        for name in PROGRESS_GAUGES:             # terminal retires again
+            m = srv2.metrics.gauge(name)
+            assert not [k for _, k, _ in m.samples()
+                        if ("request", rid2) in k]
+
+
+def test_progress_gauges_and_snapshot_live_during_solve(fresh_obs,
+                                                        tmp_path,
+                                                        monkeypatch):
+    """Mid-solve the tenant-labeled gauges and the status estimate are
+    live; at DONE progress is EXACTLY 1.0 and the gauges are gone."""
+    monkeypatch.setenv("TTS_PROGRESS_WARMUP_SEGMENTS", "1")
+    monkeypatch.setenv("TTS_PROGRESS_WARMUP_NODES", "50")
+    inst = PFSPInstance.synthetic(jobs=8, machines=3, seed=5)
+    with SearchServer(n_submeshes=1, workdir=tmp_path,
+                      segment_iters=8) as srv:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       tenant="acme", **KW))
+        live = None
+        while True:
+            s = srv.status(rid)
+            est = (s["progress"].get("estimate") or {})
+            if (live is None
+                    and est.get("progress_ratio") is not None
+                    and s["state"] == "RUNNING"):
+                g = srv.metrics.gauge("tts_progress_ratio")
+                live = (est, g.value(request=rid, tag=rid,
+                                     tenant="acme"))
+            if s["state"] != "RUNNING" and s["state"] != "QUEUED":
+                break
+            time.sleep(0.02)
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DONE"
+        if live is not None:                     # mid-solve witness
+            est, gauge_val = live
+            assert 0.0 < est["progress_ratio"] < 1.0
+            assert gauge_val == pytest.approx(est["progress_ratio"])
+        final = srv.status(rid)["progress"]["estimate"]
+        assert final["progress_ratio"] == 1.0    # exactly, at DONE
+        assert final["eta_s"] == 0.0
+        json.dumps(srv.status_snapshot())        # stays JSON-safe
+        for name in PROGRESS_GAUGES:
+            m = srv.metrics.gauge(name)
+            assert not list(m.samples())
+
+
+# ------------------------------------------------ TTS_PROGRESS=0 identity
+
+
+def test_progress_off_is_bit_identical(fresh_obs, tmp_path, monkeypatch):
+    monkeypatch.setenv("TTS_PROGRESS", "0")
+    # the rule LIST itself omits the predictive pair
+    names = [r.name for r in health.default_rules(health.Thresholds())]
+    assert "deadline_risk" not in names
+    assert "slo_latency_risk" not in names
+    inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=0)
+    with SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                      segment_iters=64) as srv:
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        rec = srv.result(rid, timeout=300)
+        assert rec.state == "DONE"
+        assert rec.estimator is None             # never attached
+        assert "estimate" not in srv.status(rid)["progress"]
+        prom = srv.metrics.to_prometheus()
+        for name in PROGRESS_GAUGES:
+            assert name not in prom
+    monkeypatch.setenv("TTS_PROGRESS", "1")
+    names = [r.name for r in health.default_rules(health.Thresholds())]
+    assert names[-2:] == ["deadline_risk", "slo_latency_risk"]
+
+
+# ------------------------------------------------------- predictive rules
+
+
+class _FakeServer:
+    """status_snapshot-only server stand-in for rule evaluation."""
+
+    def __init__(self, requests):
+        self._snap = {"requests": requests}
+
+    def status_snapshot(self):
+        return self._snap
+
+
+def _risk_rules(th):
+    return [r for r in health.default_rules(th)
+            if r.name in ("deadline_risk", "slo_latency_risk")]
+
+
+def test_deadline_risk_fires_before_the_miss(fresh_obs):
+    reqs = {
+        "r1": {"state": "RUNNING", "spent_s": 5.0, "deadline_s": 10.0,
+               "tenant": "acme",
+               "progress": {"estimate": {"progress_ratio": 0.1,
+                                         "eta_s": 60.0}}},
+        # no ETA yet (warmup): never judged
+        "r2": {"state": "RUNNING", "spent_s": 500.0, "deadline_s": 1.0,
+               "progress": {"estimate": {"segments": 1}}},
+        # comfortably inside its deadline: not at risk
+        "r3": {"state": "RUNNING", "spent_s": 1.0, "deadline_s": 100.0,
+               "progress": {"estimate": {"progress_ratio": 0.9,
+                                         "eta_s": 2.0}}},
+    }
+    th = health.Thresholds()
+    mon = health.HealthMonitor(server=_FakeServer(reqs),
+                               rules=_risk_rules(th),
+                               registry=metrics.Registry(),
+                               interval_s=0)
+    snap = mon.evaluate_now()
+    (al,) = [a for a in snap["alerts"] if a["rule"] == "deadline_risk"]
+    assert al["state"] == "firing"               # for_s=0: at once
+    d = al["detail"]
+    assert d["request"] == "r1" and d["tenant"] == "acme"
+    assert d["predicted_total_s"] == pytest.approx(65.0)
+    assert d["over_s"] == pytest.approx(55.0)
+    assert d["at_risk"] == 1
+    mon.close()
+
+
+def test_slo_latency_risk_uses_tenant_targets(fresh_obs):
+    reqs = {
+        # acme's override target is 10s -> predicted 30s fires
+        "a": {"state": "RUNNING", "spent_s": 10.0, "tenant": "acme",
+              "progress": {"estimate": {"progress_ratio": 0.3,
+                                        "eta_s": 20.0}}},
+        # same prediction under the flat 100s target: fine
+        "b": {"state": "RUNNING", "spent_s": 10.0, "tenant": "beta",
+              "progress": {"estimate": {"progress_ratio": 0.3,
+                                        "eta_s": 20.0}}},
+    }
+    th = health.Thresholds(
+        slo_latency_target_s=100.0,
+        tenant_overrides={"acme": {"slo_latency_target_s": 10.0}})
+    mon = health.HealthMonitor(server=_FakeServer(reqs),
+                               rules=_risk_rules(th),
+                               registry=metrics.Registry(),
+                               interval_s=0)
+    snap = mon.evaluate_now()
+    (al,) = [a for a in snap["alerts"]
+             if a["rule"] == "slo_latency_risk"]
+    assert al["state"] == "firing"
+    d = al["detail"]
+    assert d["request"] == "a" and d["tenant"] == "acme"
+    assert d["target_s"] == 10.0 and d["at_risk"] == 1
+    mon.close()
+
+
+# ------------------------------------------------- per-tenant thresholds
+
+
+def test_tenant_threshold_overrides_parse_and_merge(monkeypatch):
+    th = health.Thresholds(
+        slo_latency_target_s=10.0,
+        tenant_overrides={"acme": {"slo_latency_target_s": 2.0,
+                                   "not_a_field": 99.0}})
+    assert th.for_tenant("acme").slo_latency_target_s == 2.0
+    assert th.for_tenant("beta").slo_latency_target_s == 10.0
+    assert th.for_tenant(None).slo_latency_target_s == 10.0
+    # unknown keys in an override are ignored, not a crash
+    assert not hasattr(th.for_tenant("acme"), "not_a_field")
+    monkeypatch.setenv("TTS_HEALTH_TENANT_OVERRIDES",
+                       json.dumps({"acme": {"slo_error_budget": 0.5}}))
+    assert health.Thresholds.from_env() \
+        .tenant_overrides["acme"]["slo_error_budget"] == 0.5
+    # malformed JSON degrades to no overrides, never a boot failure
+    monkeypatch.setenv("TTS_HEALTH_TENANT_OVERRIDES", "{not json")
+    assert health.Thresholds.from_env().tenant_overrides == {}
+
+
+def test_per_tenant_burn_series_aggregate_untouched(tmp_path):
+    """An overridden tenant gets its own tenant-labeled burn samples;
+    the aggregate (un-tenanted) samples existing dashboards key on
+    stay exactly as before."""
+    s = ObsStore(tmp_path, "w1", fsync=False)
+    for i in range(3):
+        s.append("event", name="request.done", request_id=f"a{i}",
+                 spent_s=30.0, tenant="acme")
+        s.append("event", name="request.done", request_id=f"b{i}",
+                 spent_s=1.0, tenant="beta")
+    try:
+        reg = metrics.Registry()
+        th = health.Thresholds(
+            slo_latency_target_s=20.0, slo_latency_budget=0.05,
+            slo_burn_threshold=2.0,
+            tenant_overrides={"acme": {"slo_latency_target_s": 10.0}})
+        mon = health.HealthMonitor(registry=reg, thresholds=th,
+                                   interval_s=0, store=s)
+        snap = mon.evaluate_now()
+        (al,) = [a for a in snap["alerts"]
+                 if a["rule"] == "slo_latency_burn"]
+        assert al["state"] == "firing"
+        g = reg.gauge("tts_slo_burn_rate")
+        # aggregate (flat 20s target): 3/6 bad over 5% budget = 10.0,
+        # sample labels EXACTLY as before the per-tenant feature
+        assert g.value(slo="latency", window="fast") == pytest.approx(
+            10.0)
+        # acme (10s target): 3/3 bad over 5% budget = 20.0, its own
+        # tenant-labeled series
+        assert g.value(slo="latency", window="fast",
+                       tenant="acme") == pytest.approx(20.0)
+        assert [t["tenant"] for t in al["detail"]["tenants"]] == ["acme"]
+        mon.close()
+        # close() retires every burn sample, per-tenant included
+        assert not list(reg.gauge("tts_slo_burn_rate").samples())
+    finally:
+        s.close()
+
+
+# ------------------------------------------------- periodic OTel export
+
+
+def test_otel_incremental_export_ships_each_record_once(monkeypatch):
+    calls = []
+
+    def fake_export(records, **kw):
+        calls.append(list(records))
+        return len(records)
+
+    monkeypatch.setattr(otel, "export", fake_export)
+    exp = otel.IncrementalExporter(endpoint="http://collector:4318")
+    recs = [{"kind": "event", "name": f"e{i}", "ts": float(i), "seq": i}
+            for i in range(4)]
+    assert exp.flush(recs) == 4
+    # same ring re-flushed: NOTHING ships twice
+    assert exp.flush(recs) == 0
+    assert len(calls) == 1
+    # only the tail past the watermark ships on the next interval
+    recs.append({"kind": "event", "name": "e4", "ts": 4.0, "seq": 4})
+    assert exp.flush(recs) == 1
+    assert [r["seq"] for r in calls[1]] == [4]
+    assert exp.last_seq == 4 and exp.spans == 5 and exp.flushes == 2
+
+    # a collector failure leaves the watermark: the tail retries whole
+    def boom(records, **kw):
+        raise OSError("collector down")
+
+    monkeypatch.setattr(otel, "export", boom)
+    recs.append({"kind": "event", "name": "e5", "ts": 5.0, "seq": 5})
+    with pytest.raises(OSError):
+        exp.flush(recs)
+    assert exp.last_seq == 4
+    monkeypatch.setattr(otel, "export", fake_export)
+    assert exp.flush(recs) == 1
+    assert [r["seq"] for r in calls[-1]] == [5]
+
+
+def test_serve_has_otel_interval_flag():
+    import argparse
+
+    from tpu_tree_search.cli import _serve_parser
+    ap = argparse.ArgumentParser()
+    _serve_parser(ap.add_subparsers(dest="cmd"))
+    args = ap.parse_args(
+        ["serve", "--spool", "/tmp/x", "--otel-interval-s", "2.5"])
+    assert args.otel_interval_s == 2.5
+    assert ap.parse_args(["serve", "--spool", "/tmp/x"]) \
+        .otel_interval_s == 0.0
+
+
+# -------------------------------------------------- journey progress marks
+
+
+def test_journey_carries_progress_marks():
+    t0 = 1_700_000_000.0
+    a = [
+        {"k": "boot", "t": t0, "pid": 1},
+        {"k": "admit", "t": t0 + 1, "rid": "r0", "tag": "j", "seq": 0,
+         "spent_s": 0.0},
+        {"k": "budget", "t": t0 + 2, "rid": "r0", "spent_s": 1.0,
+         "progress": 0.25},
+        {"k": "budget", "t": t0 + 3, "rid": "r0", "spent_s": 2.0,
+         "progress": 0.75},
+        {"k": "terminal", "t": t0 + 4, "rid": "r0", "state": "DONE",
+         "snapshot": {"spent_s": 2.5}},
+    ]
+    (j,) = journey_mod.build_journeys({"a": a})
+    (lt,) = j["lifetimes"]
+    assert lt["progress_end"] == pytest.approx(0.75)
+    out = journey_mod.render_journey(j)
+    assert "progress_end=75.0%" in out

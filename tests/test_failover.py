@@ -270,7 +270,12 @@ def test_takeover_resumes_bit_identical_and_fences_stale_restart(
     standalone totals with the budget cumulative across hosts; a
     restarted A finds the adopter's LIVE lease, boots fenced and
     commits nothing."""
-    monkeypatch.setenv("TTS_LEASE_TTL_S", "0.8")
+    # 2 s, not sub-second: B must keep renewing the adopted orphan
+    # lease THROUGH its multi-second solve, and on a saturated 1-CPU
+    # runner a compile can starve the renewal thread past a 0.8 s TTL
+    # — the watcher then re-adopts and the exactly-one-takeover pin
+    # below reads 2
+    monkeypatch.setenv("TTS_LEASE_TTL_S", "2.0")
     # both lifetimes feed one shared flight-recorder store: the journey
     # + segment assertions below need every host's segments present
     store_dir = tmp_path / "store"

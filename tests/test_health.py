@@ -545,17 +545,23 @@ def test_every_terminal_state_retires_request_series(fresh_obs,
             p_times=inst.p_times, lb_kind=1, **KW))
         # pre-populate a per-request series for every request, as the
         # telemetry publisher would
+        progress_gauges = ("tts_progress_ratio", "tts_eta_seconds",
+                           "tts_est_tree_size")
         for rid in rids.values():
             srv.metrics.gauge(tele.SERIES[0]).set(1, request=rid,
                                                   bucket=0)
             srv.metrics.gauge("tts_phase_seconds").set(
                 1, request=rid, phase="kernel")
+            for name in progress_gauges:
+                srv.metrics.gauge(name).set(1, request=rid, tag=rid,
+                                            tenant="-")
         assert srv.cancel(rids["CANCELLED"])
         srv.start()
         for want, rid in rids.items():
             rec = srv.result(rid, timeout=300)
             assert rec.state == want, (want, rec.state, rec.error)
-            for name in tele.SERIES + ("tts_phase_seconds",):
+            for name in (tele.SERIES + ("tts_phase_seconds",)
+                         + progress_gauges):
                 m = srv.metrics.gauge(name)
                 assert not [k for _, k, _ in m.samples()
                             if ("request", rid) in k], (want, name)

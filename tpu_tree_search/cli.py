@@ -174,6 +174,13 @@ def _serve_parser(sub):
                         "shutdown (obs/otel.py; requires the "
                         "opentelemetry SDK — a clean no-op warning "
                         "when it is not installed)")
+    p.add_argument("--otel-interval-s", type=float, default=0.0,
+                   help="also flush the flight-recorder ring to "
+                        "--otel-endpoint every N seconds while serving "
+                        "(seq-watermarked: each flush ships only new "
+                        "records, so a crashed server has exported "
+                        "everything up to its last interval; <= 0 "
+                        "keeps the shutdown-only behavior)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="artifact root for POST /profile captures "
                         "(obs/profiler; one subdirectory per capture; "
@@ -578,6 +585,28 @@ def run_serve(args) -> int:
                      else _cfg.env_float("TTS_DRAIN_TIMEOUT_S"))
     _install_drain_handlers(drain_evt, drain_timeout)
     httpd = None
+    otel_exp = None
+    otel_stop = None
+    if args.otel_endpoint:
+        from .obs import otel
+        # ONE exporter for interval flushes AND the shutdown flush: its
+        # seq watermark is what keeps a record from shipping twice
+        otel_exp = otel.IncrementalExporter(endpoint=args.otel_endpoint)
+        if args.otel_interval_s and args.otel_interval_s > 0:
+            otel_stop = threading.Event()
+
+            def _otel_tick():
+                while not otel_stop.wait(args.otel_interval_s):
+                    try:
+                        otel_exp.flush(tracelog.get().records())
+                    except Exception:  # noqa: BLE001 — a flaky
+                        # collector must not kill the flusher; the next
+                        # tick (same watermark) retries the same tail
+                        pass
+            threading.Thread(target=_otel_tick, name="otel-flush",
+                             daemon=True).start()
+            print(f"otel: flushing to {args.otel_endpoint} every "
+                  f"{args.otel_interval_s:g}s", flush=True)
     try:
         with SearchServer(n_submeshes=args.submeshes,
                           workdir=args.workdir,
@@ -693,11 +722,14 @@ def run_serve(args) -> int:
     finally:
         if httpd is not None:
             httpd.close()
-        if args.otel_endpoint:
-            from .obs import otel
-            n = otel.export(tracelog.get().records(),
-                            endpoint=args.otel_endpoint)
-            print(f"otel: exported {n} span(s) to "
+        if otel_stop is not None:
+            otel_stop.set()
+        if otel_exp is not None:
+            # same instance as the interval flusher: only the tail past
+            # its watermark ships, never a duplicate of a prior flush
+            n = otel_exp.flush(tracelog.get().records())
+            print(f"otel: exported {n} span(s) at shutdown "
+                  f"({otel_exp.spans} total) to "
                   f"{args.otel_endpoint}", flush=True)
     watchdog = getattr(drain_evt, "watchdog", None)
     if watchdog is not None:
@@ -903,6 +935,13 @@ def run_doctor(args) -> int:
             pf = s.get("portfolio")
             pf_col = (f" portfolio={pf['active']}a/{pf['won']}w"
                       f"/{pf['cancelled_members']}cxl" if pf else "")
+            # the predictive columns (obs/estimate): absent while no
+            # request publishes an estimate (warmup / TTS_PROGRESS=0)
+            eta_col = ""
+            if s.get("progress_mean") is not None:
+                eta_col = f" progress={s['progress_mean'] * 100:.1f}%"
+            if s.get("eta_max_s") is not None:
+                eta_col += f" eta_s={s['eta_max_s']:g}"
             fo_col = ""
             if s.get("failover_mode") is not None or s.get("fenced"):
                 fo_col = (f" failover={s.get('failover_mode')}"
@@ -914,8 +953,8 @@ def run_doctor(args) -> int:
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
-                  f"requests={s.get('requests')}{aot_col}{rem_col}"
-                  f"{pf_col}{led_col}{fo_col}")
+                  f"requests={s.get('requests')}{eta_col}{aot_col}"
+                  f"{rem_col}{pf_col}{led_col}{fo_col}")
         for r in lease_report or []:
             state = ("released" if r["released"] else
                      "EXPIRED" if r["expired"] else "live")

@@ -180,7 +180,11 @@ def merge(fleet: dict) -> dict:
                "takeovers": None,
                # bound-portfolio racing (service/portfolio): None on a
                # server that never raced (snapshot parity)
-               "portfolio": None}
+               "portfolio": None,
+               # progress/ETA estimation (obs/estimate): None when no
+               # request carries a published estimate (warmup or
+               # TTS_PROGRESS=0 — snapshot parity)
+               "progress_mean": None, "eta_max_s": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -228,8 +232,24 @@ def merge(fleet: dict) -> dict:
             row["portfolio"] = st.get("portfolio")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
+            # the predictive columns: mean published progress over the
+            # server's RUNNING requests, and the LONGEST ETA (when this
+            # server expects to finish its current work)
+            progs, etas = [], []
             for rid, snap in reqs.items():
                 requests.append({"origin": origin, **snap})
+                if snap.get("state") != "RUNNING":
+                    continue
+                est = ((snap.get("progress") or {})
+                       .get("estimate") or {})
+                if est.get("progress_ratio") is not None:
+                    progs.append(float(est["progress_ratio"]))
+                if est.get("eta_s") is not None:
+                    etas.append(float(est["eta_s"]))
+            if progs:
+                row["progress_mean"] = round(sum(progs) / len(progs), 4)
+            if etas:
+                row["eta_max_s"] = round(max(etas), 1)
         al = s.get("alerts")
         if al is not None:
             row["firing"] = al.get("firing", 0)

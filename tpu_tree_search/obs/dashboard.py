@@ -144,9 +144,19 @@ def _alert_rows(alerts: list[dict], with_origin: bool = False) -> str:
     return "".join(rows)
 
 
+def _eta_cell(r: dict) -> tuple[str, str]:
+    """(progress, eta) cells from a request snapshot's estimate block
+    (obs/estimate) — em-dashes while warming up / estimation off."""
+    est = ((r.get("progress") or {}).get("estimate") or {})
+    p = est.get("progress_ratio")
+    eta = est.get("eta_s")
+    return (f"{p * 100:.1f}%" if p is not None else "—",
+            f"{eta:g}" if eta is not None else "—")
+
+
 def _request_rows(reqs: list[dict], with_origin: bool = False) -> str:
     if not reqs:
-        return '<tr><td colspan="9">no requests</td></tr>'
+        return '<tr><td colspan="11">no requests</td></tr>'
     rows = []
     for r in sorted(reqs, key=lambda r: str(r.get("id"))):
         origin = (f"<td>{_esc(r.get('origin', ''))}</td>"
@@ -154,6 +164,7 @@ def _request_rows(reqs: list[dict], with_origin: bool = False) -> str:
         prog = r.get("progress") or {}
         res = r.get("result") or {}
         best = res.get("best", prog.get("best", ""))
+        pct, eta = _eta_cell(r)
         rows.append(
             f"<tr>{origin}<td>{_esc(r.get('id'))}</td>"
             f"<td>{_esc(r.get('state'))}</td>"
@@ -161,6 +172,8 @@ def _request_rows(reqs: list[dict], with_origin: bool = False) -> str:
             f'<td class="num">{r.get("dispatches", 0)}</td>'
             f'<td class="num">{r.get("preemptions", 0)}</td>'
             f'<td class="num">{_esc(r.get("spent_s", ""))}</td>'
+            f'<td class="num">{_esc(pct)}</td>'
+            f'<td class="num">{_esc(eta)}</td>'
             f'<td class="num">{_esc(best)}</td>'
             f'<td class="mono">{_esc(r.get("error") or "")}</td></tr>')
     return "".join(rows)
@@ -275,7 +288,8 @@ def render_server(snapshot: dict | None, alerts: dict | None,
            if sparks else "")
         + "<h2>Requests</h2><table><tr><th>id</th><th>state</th>"
           "<th>submesh</th><th>disp</th><th>preempt</th>"
-          "<th>spent s</th><th>best</th><th>error</th></tr>"
+          "<th>spent s</th><th>progress</th><th>eta s</th>"
+          "<th>best</th><th>error</th></tr>"
         + _request_rows(list((snapshot.get("requests") or {}).values()))
         + "</table>")
     up = snapshot.get("uptime_s")
@@ -353,7 +367,8 @@ def render_fleet(merged: dict) -> str:
         "</table>"
         "<h2>Requests</h2><table><tr><th>origin</th><th>id</th>"
         "<th>state</th><th>submesh</th><th>disp</th><th>preempt</th>"
-        "<th>spent s</th><th>best</th><th>error</th></tr>"
+        "<th>spent s</th><th>progress</th><th>eta s</th>"
+        "<th>best</th><th>error</th></tr>"
         f"{_request_rows(merged.get('requests') or [], with_origin=True)}"
         "</table>")
     return _page("tpu_tree_search — fleet health",

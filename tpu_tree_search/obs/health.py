@@ -97,10 +97,42 @@ class Thresholds:
     slo_burn_fast_s: float = cfg.SLO_BURN_FAST_S_DEFAULT
     slo_burn_slow_s: float = cfg.SLO_BURN_SLOW_S_DEFAULT
     slo_burn_threshold: float = cfg.SLO_BURN_THRESHOLD_DEFAULT
+    # per-tenant overrides (TTS_HEALTH_TENANT_OVERRIDES, a JSON map
+    # tenant -> {field: value}): an overridden tenant is judged by its
+    # OWN thresholds in the SLO burn and predictive risk rules, with
+    # its own tenant-labeled burn series; every other tenant keeps the
+    # flat values above
+    tenant_overrides: dict = dataclasses.field(default_factory=dict)
+
+    def for_tenant(self, tenant: str | None) -> "Thresholds":
+        """This threshold set with `tenant`'s overrides applied (the
+        flat set itself for unknown tenants / unknown fields — a typo'd
+        override field degrades, never crashes a rule)."""
+        over = self.tenant_overrides.get(tenant or "-")
+        if not over:
+            return self
+        known = {f.name for f in dataclasses.fields(self)
+                 if f.name != "tenant_overrides"}
+        return dataclasses.replace(self, **{
+            k: v for k, v in over.items() if k in known})
 
     @classmethod
     def from_env(cls) -> "Thresholds":
+        raw = cfg.env_str("TTS_HEALTH_TENANT_OVERRIDES")
+        overrides: dict = {}
+        if raw:
+            try:
+                parsed = json.loads(raw)
+                if isinstance(parsed, dict):
+                    overrides = {str(t): dict(o)
+                                 for t, o in parsed.items()
+                                 if isinstance(o, dict)}
+            except (ValueError, TypeError):
+                # the repo-wide knob stance: a malformed env value
+                # degrades to the default, never takes the process down
+                pass
         return cls(
+            tenant_overrides=overrides,
             queue_wait_p99_s=cfg.env_float("TTS_HEALTH_QUEUE_WAIT_P99_S"),
             stall_s=cfg.env_float("TTS_HEALTH_STALL_S"),
             stall_warmup_s=cfg.env_float("TTS_HEALTH_STALL_WARMUP_S"),
@@ -166,7 +198,11 @@ class _Ctx:
     @property
     def snapshot(self) -> dict | None:
         if self._snapshot is None and self.server is not None:
-            self._snapshot = self.server.status_snapshot()
+            # duck-typed: rule tests attach bare stubs (a cache-only
+            # server has no request table, and that is fine)
+            fn = getattr(self.server, "status_snapshot", None)
+            if fn is not None:
+                self._snapshot = fn()
         return self._snapshot
 
     def gauge_samples(self, name: str) -> list[tuple[dict, float]]:
@@ -366,27 +402,34 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
                       "mode": fo.get("mode"),
                       "takeovers": fo.get("takeovers")}
 
-    def _burn_windows(ctx, slo: str, bad_fn):
+    def _burn_windows(ctx, slo: str, bad_fn, tth=None, tenant=None):
         """Multi-window burn rate over the DURABLE store's terminal
         history (obs/store.py): bad_fraction/budget per window, so a
         budget spent across three restarts and a takeover still burns.
         Publishes tts_slo_burn_rate{slo,window} and fires only when
         BOTH windows exceed the threshold — fast alone is a blip, slow
         alone is stale history. No store attached = never active
-        (bit-identical to the pre-store rule family)."""
+        (bit-identical to the pre-store rule family). With `tenant`,
+        the window narrows to that tenant's terminals, `tth` supplies
+        its overridden budget/threshold, and the burn series carries a
+        tenant label."""
         store = getattr(ctx.monitor, "store", None)
         if store is None:
             return False, {}
-        budget = (th.slo_error_budget if slo == "error"
-                  else th.slo_latency_budget)
+        tth = tth or th
+        budget = (tth.slo_error_budget if slo == "error"
+                  else tth.slo_latency_budget)
         if budget <= 0:
             return False, {}
         now = time.time()
-        rows = store.terminal_history(now - th.slo_burn_slow_s)
+        rows = store.terminal_history(now - tth.slo_burn_slow_s)
+        if tenant is not None:
+            rows = [r for r in rows
+                    if (r[3] if len(r) > 3 else "-") == tenant]
         burns = {}
         counts = {}
-        for window, span in (("fast", th.slo_burn_fast_s),
-                             ("slow", th.slo_burn_slow_s)):
+        for window, span in (("fast", tth.slo_burn_fast_s),
+                             ("slow", tth.slo_burn_slow_s)):
             in_w = [r for r in rows if r[0] >= now - span]
             bad = sum(1 for r in in_w if bad_fn(r))
             burns[window] = ((bad / len(in_w)) / budget
@@ -396,30 +439,130 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
             "tts_slo_burn_rate",
             "SLO burn rate (bad_fraction/budget) per window, computed "
             "over the durable store's terminal history")
+        extra = {} if tenant is None else {"tenant": tenant}
         for window, burn in burns.items():
-            g.set(round(burn, 4), slo=slo, window=window)
-        active = (burns["fast"] > th.slo_burn_threshold
-                  and burns["slow"] > th.slo_burn_threshold)
+            g.set(round(burn, 4), slo=slo, window=window, **extra)
+        active = (burns["fast"] > tth.slo_burn_threshold
+                  and burns["slow"] > tth.slo_burn_threshold)
         return active, {
             "slo": slo, "budget": budget,
+            **({"tenant": tenant} if tenant is not None else {}),
             "burn_fast": round(burns["fast"], 4),
             "burn_slow": round(burns["slow"], 4),
             "bad_fast": counts["fast"][0],
             "total_fast": counts["fast"][1],
             "bad_slow": counts["slow"][0],
             "total_slow": counts["slow"][1],
-            "threshold": th.slo_burn_threshold}
+            "threshold": tth.slo_burn_threshold}
+
+    def _tenant_burns(ctx, slo: str, bad_for) -> list[dict]:
+        """The per-tenant half of a burn rule: every overridden tenant
+        judged against ITS thresholds over ITS terminals (its own
+        tenant-labeled burn series). Returns the active details."""
+        fired = []
+        for tenant in sorted(th.tenant_overrides):
+            tth = th.for_tenant(tenant)
+            bad_fn = bad_for(tth)
+            if bad_fn is None:
+                continue
+            active, detail = _burn_windows(ctx, slo, bad_fn,
+                                           tth=tth, tenant=tenant)
+            if active:
+                fired.append(detail)
+        return fired
 
     def slo_error_burn(ctx):
-        return _burn_windows(ctx, "error",
-                             lambda r: r[1] == "FAILED")
+        bad = lambda r: r[1] == "FAILED"  # noqa: E731
+        active, detail = _burn_windows(ctx, "error", bad)
+        per_tenant = _tenant_burns(ctx, "error", lambda tth: bad)
+        if per_tenant:
+            detail = {**detail, "tenants": per_tenant}
+        return active or bool(per_tenant), detail
 
     def slo_latency_burn(ctx):
-        target = th.slo_latency_target_s
-        if target <= 0:
+        def bad_for(tth):
+            target = tth.slo_latency_target_s
+            if target <= 0:
+                return None
+            return lambda r: r[2] > target
+        active = False
+        detail: dict = {}
+        flat = bad_for(th)
+        if flat is not None:
+            active, detail = _burn_windows(ctx, "latency", flat)
+        per_tenant = _tenant_burns(ctx, "latency", bad_for)
+        if per_tenant:
+            detail = {**detail, "tenants": per_tenant}
+        return active or bool(per_tenant), detail
+
+    def _predicted(r) -> tuple[float, float] | None:
+        """(spent_s, predicted_total_s) for one RUNNING request block,
+        None without a published ETA (warmup / estimation off)."""
+        if r.get("state") != "RUNNING":
+            return None
+        est = (r.get("progress") or {}).get("estimate") or {}
+        eta = est.get("eta_s")
+        if eta is None:
+            return None
+        spent = float(r.get("spent_s") or 0.0)
+        return spent, spent + float(eta)
+
+    def deadline_risk(ctx):
+        """Predictive: fires BEFORE the deadline miss — a RUNNING
+        request whose estimated remaining time plus spent budget
+        exceeds its compute deadline, while there is still time to
+        preempt, re-tier or raise the budget (the terminal counter
+        only moves after the budget is gone)."""
+        reqs = (ctx.snapshot or {}).get("requests") or {}
+        worst, at_risk = None, 0
+        for rid, r in reqs.items():
+            d = r.get("deadline_s")
+            pred = _predicted(r)
+            if d is None or pred is None:
+                continue
+            spent, predicted = pred
+            over = predicted - float(d)
+            if over <= 0:
+                continue
+            at_risk += 1
+            if worst is None or over > worst["over_s"]:
+                worst = {"request": rid, "tenant": r.get("tenant"),
+                         "deadline_s": d,
+                         "spent_s": round(spent, 1),
+                         "predicted_total_s": round(predicted, 1),
+                         "over_s": round(over, 1)}
+        if worst is None:
             return False, {}
-        return _burn_windows(ctx, "latency",
-                             lambda r: r[2] > target)
+        return True, {**worst, "at_risk": at_risk}
+
+    def slo_latency_risk(ctx):
+        """The latency SLO's predictive twin: a RUNNING request whose
+        predicted total latency (spent + ETA) exceeds its TENANT's
+        latency target will land as an SLO violation at its terminal —
+        fire while it can still be helped. Overridden tenants are
+        judged by their own target (Thresholds.for_tenant)."""
+        reqs = (ctx.snapshot or {}).get("requests") or {}
+        worst, at_risk = None, 0
+        for rid, r in reqs.items():
+            tenant = r.get("tenant") or "-"
+            target = th.for_tenant(tenant).slo_latency_target_s
+            pred = _predicted(r)
+            if target <= 0 or pred is None:
+                continue
+            spent, predicted = pred
+            over = predicted - target
+            if over <= 0:
+                continue
+            at_risk += 1
+            if worst is None or over > worst["over_s"]:
+                worst = {"request": rid, "tenant": tenant,
+                         "target_s": target,
+                         "spent_s": round(spent, 1),
+                         "predicted_total_s": round(predicted, 1),
+                         "over_s": round(over, 1)}
+        if worst is None:
+            return False, {}
+        return True, {**worst, "at_risk": at_risk}
 
     def perf(ctx):
         path = th.perf_json
@@ -468,7 +611,19 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
              description="latency-budget burn over threshold in both "
                          "windows (spent_s over the target counts "
                          "against the budget)"),
-    ]
+    ] + ([
+        # the predictive pair exists only while progress estimation is
+        # on: with TTS_PROGRESS=0 the rule LIST itself is the pre-
+        # estimator one (the /alerts rules block stays bit-identical)
+        Rule("deadline_risk", deadline_risk, severity="warn",
+             description="a RUNNING request's spent + estimated "
+                         "remaining time exceeds its compute deadline "
+                         "(predictive — fires before the miss)"),
+        Rule("slo_latency_risk", slo_latency_risk, severity="warn",
+             description="a RUNNING request's predicted total latency "
+                         "exceeds its tenant's latency target "
+                         "(predictive; per-tenant thresholds)"),
+    ] if cfg.env_flag("TTS_PROGRESS") else [])
 
 
 def _running_ids(ctx) -> set | None:
@@ -725,6 +880,19 @@ class HealthMonitor:
             ages = getattr(srv, "heartbeat_ages", lambda: {})()
             push("heartbeat_age_max_s",
                  round(max(ages.values()), 3) if ages else 0.0)
+            # mean published progress over RUNNING requests (the
+            # dashboard's progress sparkline). Data-driven: with the
+            # estimator off no request ever carries an estimate, so the
+            # ring never exists — history output stays bit-identical
+            vals = [
+                ((r.get("progress") or {}).get("estimate") or {})
+                .get("progress_ratio")
+                for r in ((ctx.snapshot or {}).get("requests") or {})
+                .values() if r.get("state") == "RUNNING"]
+            vals = [v for v in vals if v is not None]
+            if vals:
+                push("progress_mean",
+                     round(sum(vals) / len(vals), 4))
         use = ctx.gauge_samples("tts_device_bytes_in_use")
         if use:
             push("device_bytes_in_use", sum(v for _, v in use))

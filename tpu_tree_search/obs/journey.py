@@ -193,6 +193,12 @@ def build_journeys(records_by_owner: dict[str, list[dict]],
                 ev["spent_s"] = n.carried_s
             elif kind == "budget":
                 ev["spent_s"] = float(rec.get("spent_s") or 0.0)
+                if rec.get("progress") is not None:
+                    # the estimator's published ratio rides the same
+                    # throttled budget record (service/server
+                    # _ledger_budget) — per-lifetime progress marks on
+                    # the timeline; absent when TTS_PROGRESS=0
+                    ev["progress"] = float(rec["progress"])
             elif kind == "preempt":
                 ev["spent_s"] = float(rec.get("spent_s") or 0.0)
                 ev["hold"] = bool(rec.get("hold"))
@@ -291,6 +297,13 @@ def _assemble(root_key: tuple, nodes: dict, members: list,
         sp = [e["spent_s"] for e in mine if "spent_s" in e]
         if sp:
             meta["spent_end_s"] = sp[-1]
+        # per-lifetime progress marks (estimator ratios riding the
+        # budget records): where the estimate stood when this lifetime
+        # ended — a resumed lifetime starting near its predecessor's
+        # progress_end is the warm-continuation witness
+        pr = [e["progress"] for e in mine if "progress" in e]
+        if pr:
+            meta["progress_end"] = pr[-1]
         lifes.append(meta)
 
     admits = sum(1 for e in events
@@ -420,10 +433,12 @@ def render_journey(j: dict) -> str:
         span = ""
         if lt.get("first_t") is not None and lt.get("last_t") is not None:
             span = f" span={lt['last_t'] - lt['first_t']:.1f}s"
+        prog = (f" progress_end={lt['progress_end'] * 100:.1f}%"
+                if lt.get("progress_end") is not None else "")
         lines.append(
             f"    {lt['owner']} #{lt['lifetime']} pid={lt.get('pid')} "
             f"events={lt.get('events', 0)}"
-            f" spent_end_s={lt.get('spent_end_s', '-')}"
+            f" spent_end_s={lt.get('spent_end_s', '-')}{prog}"
             f"{' TAKEOVER' if lt.get('takeover') else ''}{span}")
     return "\n".join(lines)
 

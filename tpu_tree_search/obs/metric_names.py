@@ -226,7 +226,20 @@ REGISTRY: dict[str, Metric] = _table(
     Metric("tts_slo_burn_rate", "gauge", "slo,window",
            "SLO error-budget burn rate over the durable terminal "
            "history (slo: error/latency; window: fast/slow; 1.0 = "
-           "spending exactly the budget)"),
+           "spending exactly the budget; per-tenant override series "
+           "add a tenant label)"),
+    # --- progress / ETA estimation (obs/estimate.py; per-request
+    #     series retire at the terminal state like every per-request
+    #     family)
+    Metric("tts_progress_ratio", "gauge", "request,tag,tenant",
+           "estimated fraction of the search tree explored (monotone "
+           "after warmup; published only past the warmup gate)"),
+    Metric("tts_eta_seconds", "gauge", "request,tag,tenant",
+           "estimated execution seconds remaining (estimated remaining "
+           "nodes over the measured node rate)"),
+    Metric("tts_est_tree_size", "gauge", "request,tag,tenant",
+           "estimated total search-tree size in nodes (Knuth-family "
+           "online estimate from depth-bucket branching/pruning)"),
     # --- health / audit / meta
     Metric("tts_alerts", "gauge", "rule,severity",
            "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),

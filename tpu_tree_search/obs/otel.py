@@ -44,7 +44,8 @@ import time
 import warnings
 import zlib
 
-__all__ = ["available", "records_to_otlp", "export"]
+__all__ = ["available", "records_to_otlp", "export",
+           "IncrementalExporter"]
 
 SERVICE_NAME = "tpu_tree_search"
 _SESSION_GROUP = "session"
@@ -281,3 +282,40 @@ def export(records: list[dict], endpoint: str | None = None,
         root.end(end_time=ns(hi))
     provider.shutdown()
     return n
+
+
+class IncrementalExporter:
+    """Repeated export without duplication: tracks the tracelog ``seq``
+    watermark (every record carries the process-wide monotonic counter)
+    and each :meth:`flush` ships only records newer than the last one
+    shipped. This is what ``serve --otel-interval-s`` drives — a
+    kill -9'd server has exported everything up to its last interval
+    instead of nothing — and a final shutdown flush through the SAME
+    instance ships only the tail. Span/trace ids are deterministic
+    (CRC of the record identity), so a request whose records land in
+    two flushes still renders as one trace on the backend."""
+
+    def __init__(self, endpoint: str | None = None,
+                 service_name: str = SERVICE_NAME):
+        self.endpoint = endpoint
+        self.service_name = service_name
+        self.last_seq = -1
+        self.spans = 0       # cumulative spans shipped
+        self.flushes = 0     # flushes that shipped anything
+
+    def flush(self, records: list[dict]) -> int:
+        """Export the records past the watermark; returns spans shipped
+        (0 when nothing is new or the SDK is absent)."""
+        fresh = [r for r in records
+                 if int(r.get("seq", -1)) > self.last_seq]
+        if not fresh:
+            return 0
+        n = export(fresh, endpoint=self.endpoint,
+                   service_name=self.service_name)
+        # watermark moves AFTER the export: an exporter exception leaves
+        # it in place so the next flush retries the same tail
+        self.last_seq = max(int(r.get("seq", -1)) for r in fresh)
+        if n:
+            self.spans += n
+            self.flushes += 1
+        return n

@@ -235,6 +235,12 @@ class RequestRecord:
     # re-discovers the incompatibility at the first re-batch
     solo_only: bool = False
     progress: dict = dataclasses.field(default_factory=dict)
+    # online tree-size/progress/ETA estimator (obs/estimate), attached
+    # at admission when TTS_PROGRESS is on — None otherwise, and with
+    # it every estimator surface (gauges, snapshot keys, checkpoint
+    # meta) is absent. Updated from the heartbeat thread; its state
+    # vector rides checkpoint meta so resume continues it warm
+    estimator: object | None = None
     # last time this request's cumulative spent_s was journaled to the
     # request ledger (service/ledger) — the heartbeat hook throttles
     # budget records to LEDGER_BUDGET_EVERY_S so a fast-heartbeating

@@ -95,6 +95,20 @@ def _prior_spent_s(checkpoint_path: str) -> float:
     return 0.0
 
 
+def _prior_progress_est(checkpoint_path: str) -> list | None:
+    """Progress-estimator state vector (obs/estimate's to_list) riding
+    an existing checkpoint under this tag, or None when there is none /
+    it predates the estimator — like spent_s, estimate continuity must
+    never block a submission."""
+    for cand in (checkpoint_path, checkpoint_path + ".prev"):
+        try:
+            with np.load(cand) as z:
+                return [float(x) for x in z["meta_progress_est"]]
+        except Exception:  # noqa: BLE001 — missing/torn/pre-estimator
+            continue
+    return None
+
+
 class _Slot:
     """One submesh and the request currently running on it."""
 
@@ -373,6 +387,11 @@ class SearchServer:
         # profiling to that dispatch — an opt-in production knob)
         self.phase_profile = phase_profile
         self._prof_cache: dict[tuple, dict] = {}
+        # online progress/ETA estimation (obs/estimate; static, read
+        # once): off = NO estimator objects, gauges, snapshot keys,
+        # checkpoint-meta keys or predictive rules — bit-identical to
+        # the pre-estimator server
+        self.progress_enabled = cfg.env_flag("TTS_PROGRESS")
         self.records: dict[str, RequestRecord] = {}  # guarded-by: self._lock
         self._lock = threading.RLock()
         self._seq = itertools.count()
@@ -805,6 +824,7 @@ class SearchServer:
                 # resubmitted tag gets the remainder of a larger
                 # budget, not a fresh one
                 spent_prev_s=_prior_spent_s(path))
+            self._progress_seed(rec)
             try:
                 self.queue.admit(rec)      # raises AdmissionError if full
             except AdmissionError as e:
@@ -1518,6 +1538,7 @@ class SearchServer:
             dispatches=int(entry.get("dispatches") or 0),
             preemptions=int(entry.get("preemptions") or 0),
             failures=int(entry.get("failures") or 0))
+        self._progress_seed(rec)
         # adoption lineage survives the adopter's own restart: the
         # replayed admit record carried it (see _adopt_entry)
         rec.origin_rid = entry.get("origin_rid")
@@ -1740,6 +1761,10 @@ class SearchServer:
                 dispatches=int(entry.get("dispatches") or 0),
                 preemptions=int(entry.get("preemptions") or 0),
                 failures=int(entry.get("failures") or 0))
+            # the copied checkpoint's meta seeds the estimate warm, so
+            # an adopted request's progress continues across the
+            # takeover like its budget clock does
+            self._progress_seed(rec)
             # id lineage: the fresh rid continues the orphan's rid —
             # stamped on the record, its admit journal and the adopted
             # event, so the flight recorder's journey reconstructor
@@ -1836,8 +1861,108 @@ class SearchServer:
         if now - rec.ledger_budget_t < cfg.LEDGER_BUDGET_EVERY_S_DEFAULT:
             return
         rec.ledger_budget_t = now
+        extra = {}
+        est = rec.progress.get("estimate") or {}
+        if est.get("progress_ratio") is not None:
+            # the journey timeline's per-lifetime progress marks ride
+            # the same throttled budget record (obs/journey reads them
+            # back; absent when TTS_PROGRESS=0 — record bit-identity)
+            extra["progress"] = est["progress_ratio"]
         self.ledger.journal("budget", rid=rec.id,
-                           spent_s=round(rec.spent_s(), 3))
+                           spent_s=round(rec.spent_s(), 3), **extra)
+
+    # ------------------------------------------------- progress estimation
+
+    def _progress_seed(self, rec: RequestRecord) -> None:
+        """Attach a ProgressEstimator (TTS_PROGRESS on), warm from any
+        existing checkpoint's meta vector so a resumed / resharded /
+        adopted request continues its estimate instead of restarting
+        cold (the spent_s continuity rule, estimator-shaped)."""
+        if not self.progress_enabled:
+            return
+        from ..obs import estimate as est_mod
+        # depth hint = the instance's first shape axis (jobs / cities /
+        # items): it bounds the estimator's cascade horizon so the
+        # early no-pruning expansion phase cannot inflate the estimate
+        # past the finite-depth tree
+        depth = int(np.asarray(rec.request.p_times).shape[0])
+        prior = _prior_progress_est(rec.checkpoint_path)
+        est = (est_mod.ProgressEstimator.from_list(prior,
+                                                   depth_hint=depth)
+               if prior is not None else None)
+        rec.estimator = est or est_mod.ProgressEstimator(
+            depth_hint=depth)
+
+    def _progress_rate(self, rec: RequestRecord) -> float | None:
+        """ETA fallback rate before the first live window: the tuner's
+        measured per-shape evals/s (memo/cache/defaults only — never a
+        probe on the heartbeat path); None when unknown."""
+        if self.tuner is None:
+            return None
+        try:
+            from .. import problems
+            p = np.asarray(rec.request.p_times)
+            prob = problems.get(rec.request.problem)
+            params = self.tuner.resolve(
+                prob.slots(p), p.shape[0], lb_kind=rec.request.lb_kind,
+                problem=rec.request.problem)
+            return params.evals_per_s
+        except Exception:  # noqa: BLE001 — a fallback must never break hb
+            return None
+
+    def _progress_update(self, rec: RequestRecord, rep) -> None:
+        """Heartbeat hook: fold one segment report into the request's
+        estimator, surface the estimate in the progress snapshot, and
+        publish the per-request gauges once past the warmup gate."""
+        est = rec.estimator
+        if est is None:
+            return
+        est.update(tree=rep.tree, pool=rep.pool_size,
+                   elapsed=rep.elapsed, telemetry=rep.telemetry)
+        snap = est.snapshot(self._progress_rate(rec))
+        rec.progress["estimate"] = snap
+        self._progress_publish(rec, snap)
+        self._portfolio_progress(rec)
+
+    def _progress_publish(self, rec: RequestRecord, snap: dict) -> None:
+        if snap.get("progress_ratio") is None:
+            return
+        labels = dict(request=rec.id, tag=rec.request.tag or rec.id,
+                      tenant=rec.request.tenant)
+        self.metrics.gauge(
+            "tts_progress_ratio",
+            "estimated fraction of the search tree explored").set(
+            snap["progress_ratio"], **labels)
+        self.metrics.gauge(
+            "tts_est_tree_size",
+            "estimated total search-tree size in nodes").set(
+            snap["est_tree_size"], **labels)
+        if snap.get("eta_s") is not None:
+            self.metrics.gauge(
+                "tts_eta_seconds",
+                "estimated execution seconds remaining").set(
+                snap["eta_s"], **labels)
+
+    def _portfolio_progress(self, rec: RequestRecord) -> None:
+        """A racing member's estimate rolls up to its parent: the race
+        resolves at the FIRST finisher, so the parent reports the best
+        member's view (furthest progress, its ETA)."""
+        pid = rec.portfolio_parent
+        if pid is None:
+            return
+        parent = self.records.get(pid)
+        if parent is None or parent.portfolio_members is None:
+            return
+        best = None
+        for mid in parent.portfolio_members:
+            m = self.records.get(mid)
+            est = (m.progress.get("estimate") or {}) if m else {}
+            p = est.get("progress_ratio")
+            if p is not None and (best is None
+                                  or p > best["progress_ratio"]):
+                best = {**est, "member": mid}
+        if best is not None:
+            parent.progress = {**parent.progress, "estimate": best}
 
     # ------------------------------------------------------------ internals
 
@@ -1937,6 +2062,13 @@ class SearchServer:
         rec.finished_t = time.monotonic()
         key = {DONE: "done", CANCELLED: "cancelled",
                DEADLINE: "deadline", FAILED: "failed"}[state]
+        if rec.estimator is not None and state == DONE:
+            # DONE makes the estimate exact: pin progress to 1.0 / ETA
+            # to 0 in the terminal snapshot (the other terminals keep
+            # the last honest estimate — an abandoned tree has no
+            # truthful "fraction complete")
+            rec.estimator.finalize()
+            rec.progress["estimate"] = rec.estimator.snapshot()
         if self.ledger is not None:
             # the full snapshot rides the terminal record: it is the
             # idempotent re-serve source for a duplicate tag after a
@@ -1957,6 +2089,12 @@ class SearchServer:
         # (engine/telemetry.publish, fed by the heartbeat below)
         from ..engine import telemetry as tele_mod
         for name in tele_mod.SERIES:
+            self.metrics.remove_matching(name, request=rec.id)
+        # ...and for the progress/ETA estimate family (obs/estimate):
+        # the estimate lives on in the terminal snapshot, never as a
+        # live series
+        for name in ("tts_progress_ratio", "tts_eta_seconds",
+                     "tts_est_tree_size"):
             self.metrics.remove_matching(name, request=rec.id)
         tracelog.event(f"request.{key}", request_id=rec.id,
                        tag=rec.request.tag or rec.id,
@@ -2261,6 +2399,7 @@ class SearchServer:
                     ("pruning_rate", "frontier_depth",
                      "pool_highwater", "steal_sent", "steal_recv",
                      "improvements")}
+            self._progress_update(rec, rep)
 
         def member_stop(b, rep):
             rec = recs[b]
@@ -2329,6 +2468,8 @@ class SearchServer:
                 checkpoint_meta_extra=(lambda rec=rec: {
                     **(rec.request.checkpoint_meta or {}),
                     **self._ckpt_fence_meta(),
+                    **({"progress_est": rec.estimator.to_list()}
+                       if rec.estimator is not None else {}),
                     "spent_s": round(rec.spent_s(), 2)}),
                 incumbent_key=ikey))
 
@@ -2551,6 +2692,7 @@ class SearchServer:
                     ("pruning_rate", "frontier_depth",
                      "pool_highwater", "steal_sent", "steal_recv",
                      "improvements")}
+            self._progress_update(rec, rep)
             if unit_costs is not None and rep.per_worker is not None:
                 self._publish_phases(rec, rep, unit_costs)
 
@@ -2616,6 +2758,11 @@ class SearchServer:
                             # land over the adopter's (vacuous outside
                             # fleet mode)
                             **self._ckpt_fence_meta(),
+                            # estimator continuity: the same rule as
+                            # spent_s — a resume seeds from this vector
+                            **({"progress_est":
+                                rec.estimator.to_list()}
+                               if rec.estimator is not None else {}),
                             "spent_s": round(rec.spent_s(), 2)})
                     ex_span.set(tree=res.explored_tree, best=res.best,
                                 complete=res.complete)

@@ -244,6 +244,19 @@ HEALTH_AUDIT_WINDOW_S_DEFAULT = 300.0     # TTS_HEALTH_AUDIT_WINDOW_S —
                                           # how long an audit failure
                                           # keeps the `audit` rule firing
 
+# Progress / ETA estimation (obs/estimate.py): online tree-size
+# estimates published per request behind a warmup gate — both minimums
+# must be met before the first gauge sample, so early wild estimates
+# (one segment's branching factors extrapolated over the whole tree)
+# never reach a dashboard. TTS_PROGRESS=0 removes the estimator layer
+# entirely: no gauges, no snapshot keys, no checkpoint-meta key, no
+# predictive rules — bit-identical to the pre-estimator server.
+PROGRESS_WARMUP_SEGMENTS_DEFAULT = 3      # TTS_PROGRESS_WARMUP_SEGMENTS
+PROGRESS_WARMUP_NODES_DEFAULT = 2000      # TTS_PROGRESS_WARMUP_NODES
+PROGRESS_EWMA_DEFAULT = 0.3               # TTS_PROGRESS_EWMA — weight
+                                          # of the newest segment's raw
+                                          # estimate in the smoothed one
+
 # Raw-speed flags (both STATIC: read once per search/server, bit-
 # identical node accounting on or off — see README's Performance
 # section and tests/test_overlap.py's parity suite):
@@ -603,6 +616,24 @@ KNOBS: dict[str, Knob] = _knob_table(
          "audit rule: how long a failure keeps the alert firing"),
     Knob("TTS_HEALTH_PERF_JSON", "str", None,
          "perf rule: path to a perf_sentry --json verdict file"),
+    Knob("TTS_HEALTH_TENANT_OVERRIDES", "str", None,
+         "per-tenant threshold overrides as JSON "
+         '({"tenant": {"slo_latency_target_s": 30}}); overridden '
+         "tenants get their own burn series and risk-rule judgment"),
+    # --- progress / ETA estimation (obs/estimate.py; semantics per
+    #     README "Progress & ETA")
+    Knob("TTS_PROGRESS", "flag", True,
+         "per-request online tree-size/progress/ETA estimation "
+         "(observation-only; 0 = estimator layer absent, "
+         "bit-identical)"),
+    Knob("TTS_PROGRESS_WARMUP_SEGMENTS", "int",
+         PROGRESS_WARMUP_SEGMENTS_DEFAULT,
+         "progress: segments observed before estimates publish"),
+    Knob("TTS_PROGRESS_WARMUP_NODES", "int",
+         PROGRESS_WARMUP_NODES_DEFAULT,
+         "progress: explored nodes required before estimates publish"),
+    Knob("TTS_PROGRESS_EWMA", "float", PROGRESS_EWMA_DEFAULT,
+         "progress: EWMA weight of the newest segment's raw estimate"),
     # --- crash-safe serving (service/ledger.py; semantics per README
     #     "Crash recovery & deployment")
     Knob("TTS_LEDGER", "str", None,
