@@ -1,0 +1,576 @@
+"""Fleet capacity & utilization observability (obs/capacity.py,
+``TTS_CAPACITY``): the lane-state ledger, the shape-class capacity
+model, and the saturation forecast.
+
+The load-bearing assertions:
+
+- **conservation exactness**: with injected clock stamps, per-lane
+  state seconds sum EXACTLY (==, not ~=) to lane lifetime through
+  transition/flush/open-interval paths; live servers stay within float
+  addition error through preempt->resume, quarantine->readmit, and
+  mid-batch member stop;
+- **replay**: a second server lifetime on the same durable store seeds
+  the ledger from the resumed ``tts_lane_seconds_total`` counters, and
+  conservation stays statable (lifetime includes replayed seconds);
+- **capacity math**: λ from the admission window, E[S] from tuner
+  seed / observed-throughput EWMA / direct measured fallback, ρ,
+  headroom, Little's-law W_q, and the partition-invariant what-if
+  table — all pinned against hand-computed values;
+- **saturation forecast**: the health rule fires from the snapshot's
+  overall ρ, and is absent when ``TTS_CAPACITY=0``;
+- **off-path bit-identity**: ``TTS_CAPACITY=0`` serves the exact
+  standalone totals with no capacity object, snapshot key, metric
+  series, or rule — the whole subsystem unplugs.
+"""
+
+import json
+import pathlib
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tpu_tree_search.engine import distributed
+from tpu_tree_search.obs import capacity, health, metrics, tracelog
+from tpu_tree_search.obs.capacity import CapacityModel, LaneLedger
+from tpu_tree_search.obs.httpd import start_http_server
+from tpu_tree_search.problems.pfsp import PFSPInstance
+from tpu_tree_search.service import SearchRequest, SearchServer
+
+sys.path.insert(0, str(pathlib.Path(__file__).parent.parent / "tools"))
+
+KW = dict(chunk=8, capacity=1 << 12, min_seed=4)
+
+
+def small(seed, jobs=7):
+    return PFSPInstance.synthetic(jobs=jobs, machines=3, seed=seed)
+
+
+@pytest.fixture
+def fresh_obs(tmp_path):
+    log = tracelog.TraceLog(capacity=1 << 16,
+                            sink_path=tmp_path / "trace.jsonl")
+    prev_log = tracelog.install(log)
+    reg = metrics.Registry()
+    prev_reg = metrics.install(reg)
+    try:
+        yield log, reg
+    finally:
+        tracelog.install(prev_log)
+        metrics.install(prev_reg)
+
+
+def wait_state(srv, rid, state, timeout=120.0):
+    from tpu_tree_search.service import TERMINAL_STATES
+
+    t0 = time.monotonic()
+    while True:
+        now = srv.status(rid)["state"]
+        if now == state:
+            return
+        assert now not in TERMINAL_STATES, (
+            f"{rid} reached terminal {now} waiting for {state}")
+        assert time.monotonic() - t0 < timeout, (
+            f"{rid} never reached {state}: {srv.status(rid)}")
+        time.sleep(0.02)
+
+
+# ------------------------------------------------- lane ledger (units)
+
+
+def test_lane_ledger_conservation_is_exact(fresh_obs):
+    """With injected stamps the invariant holds with ==: every second
+    of [born, now] lands in exactly one state's accumulator."""
+    log, reg = fresh_obs
+    led = LaneLedger(reg, lanes=[0, 1], now=100.0)
+    led.transition(0, "compiling", now=101.0)     # closes idle 1.0s
+    led.transition(0, "executing", now=103.0)     # compiling 2.0s
+    led.transition(0, "idle", now=106.5)          # executing 3.5s
+    led.flush(now=108.0)                          # idle +1.5s, no change
+    snap = {r["lane"]: r for r in led.snapshot(now=110.0)}
+    r0 = snap[0]
+    assert r0["seconds"] == {"compiling": 2.0, "executing": 3.5,
+                             "idle": 1.0 + 1.5 + 2.0}
+    assert r0["lifetime_s"] == 10.0
+    assert r0["conservation_error_s"] == 0.0      # exact, not approx
+    assert r0["utilization"] == 3.5 / 10.0
+    assert r0["state"] == "idle"
+    # the untouched lane conserves too: flush closed 8.0s, open adds 2.0
+    r1 = snap[1]
+    assert r1["seconds"] == {"idle": 10.0}
+    assert r1["conservation_error_s"] == 0.0
+    assert led.conservation_errors(now=110.0) == {0: 0.0, 1: 0.0}
+    # the counter carries CLOSED intervals (flush() keeps it current)
+    c = reg.counter(capacity.LANE_SECONDS_METRIC)
+    assert c.value(lane=0, state="compiling") == 2.0
+    assert c.value(lane=0, state="executing") == 3.5
+    assert c.value(lane=0, state="idle") == 2.5
+    assert c.value(lane=1, state="idle") == 8.0
+    # each transition emitted a lane.state event carrying the FULL
+    # duration of the state being left (the retrospective slice)
+    evs = [r for r in log.records() if r["name"] == "lane.state"]
+    assert [(e["prev"], e["seconds"]) for e in evs] == [
+        ("idle", 1.0), ("compiling", 2.0), ("executing", 3.5)]
+
+
+def test_lane_ledger_same_state_transition_is_noop(fresh_obs):
+    log, reg = fresh_obs
+    led = LaneLedger(reg, lanes=[0], now=0.0)
+    led.transition(0, "executing", now=1.0)
+    led.transition(0, "executing", now=5.0)       # no-op: no event
+    evs = [r for r in log.records() if r["name"] == "lane.state"]
+    assert len(evs) == 1
+    (r,) = led.snapshot(now=6.0)
+    assert r["seconds"] == {"idle": 1.0, "executing": 5.0}
+    assert r["conservation_error_s"] == 0.0
+
+
+def test_lane_ledger_seed_replays_without_counter_inc(fresh_obs):
+    """seed() adopts prior-lifetime seconds: accumulator and replayed
+    move, the counter does NOT (resume_counters already restored it),
+    and conservation stays exact with lifetime including the replay."""
+    log, reg = fresh_obs
+    led = LaneLedger(reg, lanes=[0], now=50.0)
+    led.seed(0, "executing", 5.0)
+    led.seed(0, "idle", 2.5)
+    (r,) = led.snapshot(now=51.0)
+    assert r["replayed_s"] == 7.5
+    assert r["lifetime_s"] == 1.0 + 7.5
+    assert r["seconds"] == {"executing": 5.0, "idle": 2.5 + 1.0}
+    assert r["conservation_error_s"] == 0.0
+    c = reg.counter(capacity.LANE_SECONDS_METRIC)
+    assert c.value_matching(lane=0) == 0.0        # seed never incs
+
+
+# --------------------------------------------- capacity model (units)
+
+
+def test_capacity_model_math_pinned(fresh_obs):
+    """λ / E[S] / ρ / headroom / W_q / what-if against hand-computed
+    values with injected stamps."""
+    _, reg = fresh_obs
+    m = CapacityModel(reg, window_s=10.0, ewma=0.5, now=0.0)
+    for t in (1.0, 2.0, 3.0, 4.0, 5.0):
+        m.on_admit("7x3", "acme", now=t)
+    m.seed_rate("7x3", 1000.0)
+    m.on_terminal("7x3", 500, service_s=0.5)      # E[S] = 500/1000
+    doc = m.snapshot(healthy_lanes=2, total_lanes=2, total_devices=8,
+                     now=6.0)
+    lam = 5 / 6.0                                 # window = now - born
+    s = 0.5
+    rho = lam * s / 2
+    assert doc["window_s"] == 6.0
+    assert doc["arrival_per_s"] == pytest.approx(lam)
+    (row,) = doc["classes"]
+    assert (row["shape"], row["tenant"]) == ("7x3", "acme")
+    assert row["service_s"] == pytest.approx(s)
+    assert row["utilization"] == pytest.approx(rho)
+    assert row["headroom"] == pytest.approx(1 - rho)
+    assert row["predicted_wait_s"] == pytest.approx(
+        s * rho / (2 * (1 - rho)))
+    assert doc["utilization"] == pytest.approx(rho)
+    assert doc["predicted_req_per_s"] == pytest.approx(2 / s)
+    # what-if: every n | devices partition, throughput invariant under
+    # linear per-device scaling, current partition flagged
+    wi = doc["what_if"]
+    assert [w["lanes"] for w in wi] == [1, 2, 4, 8]
+    assert all(w["predicted_req_per_s"] == pytest.approx(2 / s)
+               for w in wi)
+    assert [w["current"] for w in wi] == [False, True, False, False]
+    # fatter lanes wait less at equal throughput (the tradeoff the
+    # advisor quantifies)
+    waits = [w["predicted_wait_s"] for w in wi]
+    assert waits == sorted(waits)
+    # gauges published from the snapshot; close() retires them
+    text = reg.to_prometheus()
+    assert 'tts_capacity_utilization{shape="7x3",tenant="acme"}' in text
+    assert "tts_capacity_headroom" in text
+    m.close()
+    text = reg.to_prometheus()
+    assert "tts_capacity_utilization{" not in text   # series retired
+
+
+def test_capacity_model_saturated_wait_is_none(fresh_obs):
+    _, reg = fresh_obs
+    m = CapacityModel(reg, window_s=10.0, ewma=0.5, now=0.0)
+    for i in range(100):
+        m.on_admit("7x3", "-", now=1.0 + i * 0.01)
+    m.on_terminal("7x3", 0, service_s=1.0)        # E[S] via fallback
+    doc = m.snapshot(healthy_lanes=1, total_lanes=1, total_devices=8,
+                     now=2.0)
+    assert doc["utilization"] > 1.0
+    assert doc["predicted_wait_s"] is None        # unbounded queue
+    assert doc["classes"][0]["predicted_wait_s"] is None
+
+
+def test_capacity_model_rate_sources_and_fallback(fresh_obs):
+    """E[S] source precedence: observed-throughput EWMA beats the
+    tuner seed; the direct measured-E[S] EWMA is the fallback when
+    neither rate nor evals/request exists."""
+    _, reg = fresh_obs
+    m = CapacityModel(reg, window_s=60.0, ewma=0.5, now=0.0)
+    # observed EWMA over the seed
+    m.seed_rate("a", 1000.0)
+    m.on_progress("a", 800.0)
+    m.on_progress("a", 400.0)                     # EWMA -> 600
+    m.on_terminal("a", 600)
+    st = m._shapes["a"]
+    assert st.rate_obs == pytest.approx(600.0)
+    assert m._service_s(st) == pytest.approx(1.0)  # 600 / 600
+    # fallback: no seed, no heartbeat (request finished inside its
+    # first segment) -> direct measured E[S]
+    m.on_terminal("b", 0, service_s=2.0)
+    m.on_terminal("b", 0, service_s=4.0)          # EWMA -> 3.0
+    assert m._service_s(m._shapes["b"]) == pytest.approx(3.0)
+    # tenant wait EWMA rides the snapshot
+    m.on_queue_wait("acme", 1.0)
+    m.on_queue_wait("acme", 3.0)
+    doc = m.snapshot(healthy_lanes=1, total_lanes=1, total_devices=8,
+                     now=1.0)
+    assert doc["tenants"]["acme"]["waits"] == 2
+    assert doc["tenants"]["acme"]["observed_wait_s"] == \
+        pytest.approx(2.0)
+
+
+def test_histogram_snapshot_matching_merges_tenant_series(fresh_obs):
+    """Satellite: the tenant label on tts_queue_wait_seconds must not
+    blind the all-tenants view the queue_wait health rule judges."""
+    _, reg = fresh_obs
+    h = reg.histogram("tts_queue_wait_seconds", "t")
+    h.observe(0.1, tenant="acme")
+    h.observe(0.3, tenant="acme")
+    h.observe(0.5, tenant="-")
+    assert h.snapshot(tenant="acme")["count"] == 2
+    merged = h.snapshot_matching()
+    assert merged["count"] == 3
+    assert merged["sum"] == pytest.approx(0.9)
+    assert h.snapshot_matching(tenant="acme")["sum"] == \
+        pytest.approx(0.4)
+
+
+# --------------------------------------------------- saturation rule
+
+
+def _cap_stub(rho):
+    class _Srv:
+        def status_snapshot(self):
+            return {"capacity": {
+                "utilization": rho, "arrival_per_s": 4.0,
+                "healthy_lanes": 2, "predicted_wait_s": 1.5,
+                "classes": [{"shape": "7x3", "tenant": "acme",
+                             "utilization": rho}],
+            }}
+    return _Srv()
+
+
+def test_saturation_rule_fires_on_sustained_rho(fresh_obs):
+    """The forecast: ρ over threshold fires (after its dwell) from the
+    capacity snapshot alone — no queue_wait observation needed."""
+    _, reg = fresh_obs
+    th = health.Thresholds(saturation=0.85, saturation_for_s=0.0)
+    rules = [r for r in health.default_rules(th)
+             if r.name == "saturation"]
+    assert len(rules) == 1, "saturation rule missing from defaults"
+    mon = health.HealthMonitor(server=_cap_stub(0.95), rules=rules,
+                               registry=reg, interval_s=0)
+    snap = mon.evaluate_now()
+    (a,) = snap["alerts"]
+    assert a["state"] == "firing"
+    assert a["detail"]["utilization"] == 0.95
+    assert a["detail"]["worst_class"] == "7x3/acme"
+    # below threshold: quiet; unmeasured (rho None): quiet
+    for rho in (0.5, None):
+        mon2 = health.HealthMonitor(server=_cap_stub(rho), rules=rules,
+                                    registry=metrics.Registry(),
+                                    interval_s=0)
+        assert mon2.evaluate_now()["firing"] == 0
+
+
+def test_saturation_rule_absent_when_capacity_off(monkeypatch):
+    monkeypatch.setenv("TTS_CAPACITY", "0")
+    rules = health.default_rules(health.Thresholds())
+    assert all(r.name != "saturation" for r in rules)
+    monkeypatch.setenv("TTS_CAPACITY", "1")
+    rules = health.default_rules(health.Thresholds())
+    assert any(r.name == "saturation" for r in rules)
+
+
+# -------------------------------------------- trace & report tooling
+
+
+def test_chrome_trace_renders_lane_state_slices(fresh_obs):
+    from tpu_tree_search.obs import chrome_trace
+
+    log, reg = fresh_obs
+    led = LaneLedger(reg, lanes=[0], now=10.0)
+    led.transition(0, "executing", now=12.0)
+    led.transition(0, "idle", now=15.5)
+    doc = chrome_trace.to_chrome(log.records())
+    lanes = {e["name"]: e for e in doc["traceEvents"]
+             if e.get("ph") == "X" and e["name"] in capacity.LANE_STATES}
+    # each transition became a retrospective slice named for the state
+    # LEFT, carrying its full duration
+    assert lanes["idle"]["dur"] == pytest.approx(2.0e6)
+    assert lanes["executing"]["dur"] == pytest.approx(3.5e6)
+    # ...on a dedicated per-lane state track
+    tracks = [e["args"]["name"] for e in doc["traceEvents"]
+              if e.get("name") == "thread_name"]
+    assert "lane-0-state" in tracks
+
+
+def test_capacity_report_reads_trace_and_store(fresh_obs, tmp_path):
+    """Satellite: the offline report accepts both artifact formats —
+    the JSONL event log and the durable store directory."""
+    import capacity_report
+
+    log, reg = fresh_obs
+    led = LaneLedger(reg, lanes=[0], now=0.0)
+    led.transition(0, "executing", now=2.0)
+    led.transition(0, "idle", now=5.0)
+    log.set_sink(None)                            # flush the sink file
+    ev_lanes = capacity_report.lane_seconds_from_events(
+        capacity_report.load(str(tmp_path / "trace.jsonl"))[0])
+    assert ev_lanes[0]["seconds"] == {"idle": 2.0, "executing": 3.0}
+    assert ev_lanes[0]["transitions"] == 2
+    assert ev_lanes[0]["last_state"] == "idle"
+
+    from tpu_tree_search.obs.store import ObsStore
+    store_dir = tmp_path / "store"
+    s = ObsStore(store_dir, "w1", fsync=False)
+    s.append("event", name="lane.state", submesh=0, state="idle",
+             prev="executing", seconds=3.0)
+    s.append("sample", counters=[
+        ["tts_lane_seconds_total", {"lane": "0", "state": "executing"},
+         3.0],
+        ["tts_lane_seconds_total", {"lane": "0", "state": "idle"}, 2.0],
+    ], gauges=[["tts_capacity_utilization",
+                {"shape": "7x3", "tenant": "-"}, 0.4]])
+    s.flush()
+    s.close()
+    events, samples = capacity_report.load(str(store_dir))
+    assert capacity_report.lane_seconds_from_events(events)[0][
+        "seconds"] == {"executing": 3.0}
+    assert capacity_report.lane_seconds_from_samples(samples) == {
+        "0": {"executing": 3.0, "idle": 2.0}}
+    assert capacity_report.class_utilization(samples) == {
+        ("7x3", "-"): 0.4}
+    out = capacity_report.report(str(store_dir))
+    assert "tts_lane_seconds_total" in out and "rho=0.400" in out
+    parsed = json.loads(capacity_report.report(str(store_dir),
+                                               as_json=True))
+    assert parsed["lane_counters"]["0"]["idle"] == 2.0
+
+
+# --------------------------------------------- served integration
+
+
+@pytest.fixture(scope="module")
+def baseline7():
+    inst = PFSPInstance.synthetic(jobs=7, machines=3, seed=6)
+    got = distributed.search(inst.p_times, lb_kind=1, init_ub=None,
+                             n_devices=8, **KW)
+    return inst, (got.explored_tree, got.explored_sol, got.best)
+
+
+def test_serve_capacity_conservation_preempt_and_quarantine(
+        fresh_obs, tmp_path):
+    """The live drill: preempt->resume then quarantine->readmit on one
+    lane; conservation holds within float addition error, the expected
+    states were all visited, and the /capacity document + tenant-
+    labeled queue wait are live."""
+    slow, fast = small(5, jobs=8), small(6)
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                       share_incumbent=False)
+    try:
+        assert srv.lane_ledger is not None and srv.capacity is not None
+        lo = srv.submit(SearchRequest(
+            p_times=slow.p_times, lb_kind=1, priority=0,
+            segment_iters=32, checkpoint_every=1, tenant="bulk",
+            faults="delay_every=0.15", **KW))
+        wait_state(srv, lo, "RUNNING")
+        hi = srv.submit(SearchRequest(p_times=fast.p_times, lb_kind=1,
+                                      priority=10, segment_iters=256,
+                                      tenant="acme", **KW))
+        rec_hi = srv.result(hi, timeout=300)
+        assert rec_hi.state == "DONE", (rec_hi.state, rec_hi.error)
+        assert srv.counters["preemptions"] >= 1
+        rec_lo = srv.result(lo, timeout=600)
+        assert rec_lo.state == "DONE", (rec_lo.state, rec_lo.error)
+
+        srv.quarantine_submesh(0, "capacity-test")
+        time.sleep(0.05)
+        assert srv.lane_ledger.state_of(0) == "quarantined"
+        srv.readmit_submesh(0)
+        assert srv.lane_ledger.state_of(0) == "idle"
+
+        (row,) = srv.lane_ledger.snapshot()
+        assert abs(row["conservation_error_s"]) < 1e-6
+        for state in ("compiling", "executing", "quarantined", "idle"):
+            assert row["seconds"].get(state, 0.0) > 0.0, (
+                state, row["seconds"])
+        assert 0.0 < row["utilization"] < 1.0
+
+        # capacity document: classes measured, what-if table populated
+        doc = srv.capacity_snapshot()
+        assert doc["healthy_lanes"] == 1 and doc["devices"] == 8
+        shapes = {(c["shape"], c["tenant"]) for c in doc["classes"]}
+        assert ("8x3", "bulk") in shapes and ("7x3", "acme") in shapes
+        assert any(c["service_s"] for c in doc["classes"])
+        assert doc["predicted_req_per_s"] is not None
+        assert [w["lanes"] for w in doc["what_if"]] == [1, 2, 4, 8]
+        assert doc["lanes_detail"][0]["lane"] == 0
+        assert doc["tenants"]["acme"]["waits"] >= 1
+        assert srv.status_snapshot()["capacity"]["utilization"] \
+            is not None
+
+        # satellite: per-tenant queue-wait series, merged view intact
+        qh = srv._m_queue_wait
+        assert qh.snapshot_matching(tenant="acme")["count"] >= 1
+        assert qh.snapshot_matching(tenant="bulk")["count"] >= 1
+        assert qh.snapshot_matching()["count"] >= 2
+        text = srv.metrics.to_prometheus()
+        assert 'tenant="acme"' in text.split("tts_queue_wait_seconds",
+                                             1)[1]
+        assert "tts_lane_seconds_total" in text
+        assert "tts_capacity_utilization" in text
+
+        # GET /capacity serves the same document
+        httpd = start_http_server(srv)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.port}/capacity",
+                timeout=10).read())
+            assert body["enabled"] is True
+            assert body["healthy_lanes"] == 1
+            assert {(c["shape"], c["tenant"])
+                    for c in body["classes"]} >= {("7x3", "acme")}
+        finally:
+            httpd.close()
+    finally:
+        srv.close()
+    # close flushed the final interval: counters sum to the accumulators
+    c = srv.metrics.counter(capacity.LANE_SECONDS_METRIC)
+    assert c.value_matching(lane=0) > 0.0
+
+
+@pytest.mark.slow
+def test_mid_batch_member_stop_freezes_lane_and_counts_drain_idle():
+    """A cancelled batch member finalizes at its next boundary while
+    the batchmate drains: the lane ledger visits batch-frozen and the
+    frozen tail lands in tts_batch_drain_idle_seconds."""
+    tables = [PFSPInstance.synthetic(10, 5, seed=s).p_times
+              for s in (21, 22)]
+    kw = dict(chunk=16, capacity=1 << 12, min_seed=8, segment_iters=16)
+    srv = SearchServer(n_submeshes=1, megabatch=True, batch_max=2,
+                       batch_age_s=0.05, autostart=False)
+    try:
+        ids = [srv.submit(SearchRequest(p_times=t, lb_kind=1, **kw))
+               for t in tables]
+        srv.start()
+        # cancel only after the batch is PAST compile and heartbeating
+        # (a cancel inside the compile window would stop the member at
+        # its first boundary and the lane would never read executing)
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            sts = [srv.status(r) for r in ids]
+            if all(s["state"] == "RUNNING"
+                   and (s["progress"] or {}).get("segment")
+                   for s in sts):
+                break
+            time.sleep(0.005)
+        assert srv.cancel(ids[0])
+        rec0 = srv.result(ids[0], timeout=120)
+        assert rec0.state == "CANCELLED"
+        rec1 = srv.result(ids[1], timeout=600)
+        assert rec1.state == "DONE", (rec1.state, rec1.error)
+        # result() unblocks at the member's finalize; the drain-idle
+        # observation lands in the batch thread's tail — wait for the
+        # slot to release before reading it
+        deadline = time.time() + 60
+        while time.time() < deadline and srv.slots[0].record is not None:
+            time.sleep(0.01)
+        (row,) = srv.lane_ledger.snapshot()
+        assert row["seconds"].get("batch-frozen", 0.0) > 0.0, \
+            row["seconds"]
+        assert abs(row["conservation_error_s"]) < 1e-6
+        hist = srv.metrics.to_json().get("tts_batch_drain_idle_seconds")
+        assert hist and hist["count"] >= 1 and hist["sum"] > 0.0
+    finally:
+        srv.close()
+
+
+def test_capacity_off_is_bit_identical_and_series_free(
+        fresh_obs, baseline7, tmp_path, monkeypatch):
+    """TTS_CAPACITY=0: exact standalone totals, no ledger/model object,
+    no snapshot key, no tts_lane/tts_capacity series, no /capacity
+    body, no saturation rule."""
+    inst, base = baseline7
+    monkeypatch.setenv("TTS_CAPACITY", "0")
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd")
+    try:
+        assert srv.lane_ledger is None and srv.capacity is None
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        out = srv.result(rid, timeout=300)
+        assert out.state == "DONE"
+        res = out.result
+        assert (res.explored_tree, res.explored_sol, res.best) == base
+        snap = srv.status_snapshot()
+        assert "capacity" not in snap
+        assert srv.capacity_snapshot() is None
+        text = srv.metrics.to_prometheus()
+        assert "tts_lane_seconds_total" not in text
+        assert "tts_capacity_" not in text
+        assert all(r.name != "saturation" for r in srv.health.rules)
+        httpd = start_http_server(srv)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{httpd.port}/capacity",
+                timeout=10).read())
+            assert body == {"enabled": False}
+        finally:
+            httpd.close()
+    finally:
+        srv.close()
+
+
+def test_restart_replays_lane_seconds_from_store(fresh_obs, tmp_path,
+                                                 monkeypatch):
+    """kill-and-return drill (in-process twin of the CI hard-kill):
+    lifetime 2 on the same store seeds the ledger from the resumed
+    tts_lane_seconds_total counters — utilization history survives and
+    conservation stays exact including the replayed seconds."""
+    inst = small(3)
+    store_dir = tmp_path / "store"
+    monkeypatch.setenv("TTS_OBS_STORE", str(store_dir))
+    srv = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                       ledger_dir=str(tmp_path / "led"))
+    try:
+        assert srv.obs_store is not None
+        rid = srv.submit(SearchRequest(p_times=inst.p_times, lb_kind=1,
+                                       **KW))
+        assert srv.result(rid, timeout=300).state == "DONE"
+    finally:
+        srv.close()
+    served = srv.metrics.counter(capacity.LANE_SECONDS_METRIC) \
+        .value_matching(lane=0)
+    assert served > 0.0
+
+    srv2 = SearchServer(n_submeshes=1, workdir=tmp_path / "wd",
+                        ledger_dir=str(tmp_path / "led"))
+    try:
+        (row,) = srv2.lane_ledger.snapshot()
+        assert row["replayed_s"] == pytest.approx(served)
+        assert row["seconds"].get("executing", 0.0) > 0.0
+        assert abs(row["conservation_error_s"]) < 1e-6
+        # the resumed counter continues, never restarts
+        assert srv2.metrics.counter(capacity.LANE_SECONDS_METRIC) \
+            .value_matching(lane=0) >= served
+        # and the offline report reads the persisted story
+        import capacity_report
+        _, samples = capacity_report.load(str(store_dir))
+        lanes = capacity_report.lane_seconds_from_samples(samples)
+        assert sum(lanes.get("0", {}).values()) > 0.0
+    finally:
+        srv2.close()

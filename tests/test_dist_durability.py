@@ -191,7 +191,7 @@ def _campaign_env(tmp_path, out, **over):
            "TTS_WORKDIR": str(tmp_path),
            "TTS_LB": "2", "TTS_CHUNK": "32", "TTS_SEG": "600",
            "TTS_CKPT_EVERY": "1", "TTS_BUDGET_S": "600",
-           "TTS_CAPACITY": "65536"}
+           "TTS_POOL_ROWS": "65536"}
     env.pop("XLA_FLAGS", None)   # no need for the 8-device split here
     env.update(over)
     return env

@@ -1,0 +1,222 @@
+"""Lane utilization & capacity report from a flight-recorder artifact.
+
+The offline twin of ``GET /capacity`` / the ``capacity`` CLI: where
+those read a LIVE server, this reads what the observability layer left
+behind — so a post-mortem answers "was the fleet saturated?" without a
+process to scrape. Accepts every trace artifact the layer produces
+(same detection rules as tools/trace_summary.py):
+
+- the JSONL event log (obs/tracelog's file sink, TTS_TRACE_FILE),
+- the Chrome trace-event JSON (obs/chrome_trace, ``/trace``) — the
+  lane-state story rides the retrospective slices' ``lane.state``
+  instants and ``X`` events,
+- the DURABLE store (obs/store; TTS_OBS_STORE): a directory or one
+  ``obs-*.jsonl`` CRC segment. Unlike trace_summary, ``sample``
+  records are KEPT — the persisted ``tts_lane_seconds_total``
+  counters and ``tts_capacity_utilization`` gauges ride them, and
+  they are the only cross-restart (kill -9 surviving) source.
+
+Prints per-lane state-seconds tables (from ``lane.state`` transition
+events), the persisted per-lane counters with each lane's executing
+fraction, and the last-known per-shape-class utilization gauges.
+
+    python tools/capacity_report.py /tmp/tts-trace.jsonl
+    python tools/capacity_report.py /tmp/tts-trace.chrome.json
+    python tools/capacity_report.py /tmp/tts-store/          # store dir
+"""
+
+import argparse
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+LANE_EVENT = "lane.state"
+LANE_COUNTER = "tts_lane_seconds_total"
+UTIL_GAUGE = "tts_capacity_utilization"
+
+
+def load(path: str):
+    """(events, samples): tracelog-shaped records and raw store
+    ``sample`` records. Non-store formats have no samples."""
+    if os.path.isdir(path):
+        from tpu_tree_search.obs.store import read_store
+        return _split_store(read_store(path))
+    with open(path) as f:
+        head = f.read(4096).lstrip()
+    first = None
+    if head.startswith("{"):
+        try:
+            first = json.loads(head.splitlines()[0])
+        except (json.JSONDecodeError, IndexError):
+            first = None
+    if isinstance(first, dict) and set(first) == {"c", "r"}:
+        from tpu_tree_search.obs.store import _scan_segment
+        recs = []
+        with open(path, "rb") as f:
+            for rec, _end in _scan_segment(f.read()):
+                if rec is None:
+                    break
+                recs.append(rec)
+        return _split_store(recs)
+    if head.startswith("{") and '"traceEvents"' in head:
+        with open(path) as f:
+            doc = json.load(f)
+        out = []
+        for e in doc.get("traceEvents", []):
+            if e.get("ph") not in ("X", "i"):
+                continue
+            rec = {"name": e.get("name", "?"),
+                   "ts": float(e.get("ts", 0.0)) / 1e6,
+                   **(e.get("args") or {})}
+            out.append(rec)
+        return out, []
+    from tpu_tree_search.obs.chrome_trace import read_jsonl
+    return read_jsonl(path), []
+
+
+def _split_store(store_recs: list) -> tuple:
+    events, samples = [], []
+    for r in store_recs:
+        kind = r.get("k")
+        if kind == "event":
+            rec = {key: v for key, v in r.items()
+                   if key not in ("k", "t", "w")}
+            rec.setdefault("name", "?")
+            rec["ts"] = float(r.get("t", 0.0))
+            rec["writer"] = r.get("w", "?")
+            events.append(rec)
+        elif kind == "sample":
+            samples.append(r)
+    return events, samples
+
+
+def lane_seconds_from_events(events: list) -> dict:
+    """lane -> {state: seconds, ...} summed from ``lane.state``
+    transition events (each carries the full duration of the state
+    being LEFT), plus a transition count."""
+    lanes = collections.defaultdict(lambda: {
+        "seconds": collections.Counter(), "transitions": 0,
+        "last_state": None})
+    for rec in events:
+        if rec.get("name") != LANE_EVENT:
+            continue
+        lane = rec.get("submesh")
+        if lane is None:
+            continue
+        row = lanes[lane]
+        row["seconds"][str(rec.get("prev", "?"))] += float(
+            rec.get("seconds", 0.0) or 0.0)
+        row["transitions"] += 1
+        row["last_state"] = rec.get("state")
+    return {k: {"seconds": dict(v["seconds"]),
+                "transitions": v["transitions"],
+                "last_state": v["last_state"]}
+            for k, v in sorted(lanes.items(), key=lambda kv: str(kv[0]))}
+
+
+def lane_seconds_from_samples(samples: list) -> dict:
+    """lane -> {state: seconds} from the LAST persisted
+    ``tts_lane_seconds_total`` counters per writer (counters are
+    cumulative; the final sample of a lifetime carries its total).
+    Multiple writers (a fleet store / restarts resuming the counter)
+    take the per-(writer, lane, state) max, then the max across
+    writers — a resumed counter already includes its predecessor."""
+    per = {}     # (writer, lane, state) -> value (last wins)
+    for s in samples:
+        w = s.get("w", "?")
+        for name, labels, value in s.get("counters") or []:
+            if name != LANE_COUNTER or not isinstance(labels, dict):
+                continue
+            key = (w, labels.get("lane"), labels.get("state"))
+            per[key] = float(value)
+    out = collections.defaultdict(dict)
+    for (_w, lane, state), v in per.items():
+        cur = out[lane].get(state)
+        if cur is None or v > cur:
+            out[lane][state] = v
+    return {k: out[k] for k in sorted(out, key=str)}
+
+
+def class_utilization(samples: list) -> dict:
+    """(shape, tenant) -> last-known ρ gauge value."""
+    out = {}
+    for s in samples:
+        for name, labels, value in s.get("gauges") or []:
+            if name != UTIL_GAUGE or not isinstance(labels, dict):
+                continue
+            out[(labels.get("shape", "?"),
+                 labels.get("tenant", "?"))] = float(value)
+    return out
+
+
+def _lane_table(title: str, lanes: dict) -> list:
+    lines = [title]
+    for lane, row in lanes.items():
+        secs = row.get("seconds", row)
+        total = sum(secs.values())
+        ex = secs.get("executing", 0.0)
+        states = "  ".join(f"{k}={secs[k]:.2f}s"
+                           for k in sorted(secs, key=lambda k: -secs[k]))
+        extra = ""
+        if isinstance(row, dict) and "transitions" in row:
+            extra = (f"  transitions={row['transitions']}"
+                     f"  last={row['last_state']}")
+        frac = (ex / total * 100.0) if total > 0 else 0.0
+        lines.append(f"  lane {lane}: exec={frac:5.1f}% "
+                     f"total={total:.2f}s  [{states}]{extra}")
+    if len(lines) == 1:
+        lines.append("  (none)")
+    return lines
+
+
+def report(path: str, as_json: bool = False) -> str:
+    events, samples = load(path)
+    ev_lanes = lane_seconds_from_events(events)
+    ct_lanes = lane_seconds_from_samples(samples)
+    classes = class_utilization(samples)
+    if as_json:
+        return json.dumps({
+            "path": path,
+            "lane_events": ev_lanes,
+            "lane_counters": ct_lanes,
+            "class_utilization": {
+                f"{shape}/{tenant}": v
+                for (shape, tenant), v in sorted(classes.items())},
+        }, indent=1)
+    lines = [f"# capacity report: {path}",
+             f"# {len(events)} event(s), {len(samples)} sample(s)"]
+    lines += _lane_table("lane state seconds (from lane.state "
+                         "transitions — closed intervals only):",
+                         ev_lanes)
+    if samples:
+        lines += _lane_table(
+            "persisted lane counters (tts_lane_seconds_total, "
+            "survives kill -9):",
+            {k: {"seconds": v} for k, v in ct_lanes.items()})
+        lines.append("last-known shape-class utilization "
+                     "(tts_capacity_utilization):")
+        for (shape, tenant), v in sorted(classes.items()):
+            lines.append(f"  {shape} tenant={tenant}: rho={v:.3f}")
+        if not classes:
+            lines.append("  (none)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lane utilization & capacity report from a trace "
+                    "artifact (JSONL / Chrome JSON / durable store)")
+    ap.add_argument("path", help="trace file or store directory")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    print(report(args.path, as_json=args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -212,7 +212,7 @@ def worker_main(inst: int) -> None:
     ub = taillard.optimal_makespan(inst) if UB_MODE == "opt" else None
     m, jobs = p.shape
     tables = batched.make_tables(p)
-    capacity = _cfg.env_int("TTS_CAPACITY") or \
+    capacity = _cfg.env_int("TTS_POOL_ROWS") or \
         max(device.default_capacity(jobs, m), 4 * CHUNK * jobs)
     grows = 0
     spent_before = 0.0
@@ -610,7 +610,7 @@ def serve_main(insts: list[int], n_submeshes: int) -> None:
             # above the class default); the distributed driver still
             # grows losslessly on overflow, this just avoids paying the
             # grow+recompile on instances the floor was tuned for
-            capacity = _cfg.env_int("TTS_CAPACITY") or \
+            capacity = _cfg.env_int("TTS_POOL_ROWS") or \
                 max(device.default_capacity(p.shape[1], p.shape[0]),
                     4 * CHUNK * p.shape[1])
             rids[inst] = srv.submit(SearchRequest(

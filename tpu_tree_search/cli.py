@@ -942,6 +942,12 @@ def run_doctor(args) -> int:
                 eta_col = f" progress={s['progress_mean'] * 100:.1f}%"
             if s.get("eta_max_s") is not None:
                 eta_col += f" eta_s={s['eta_max_s']:g}"
+            # the capacity columns (obs/capacity): absent with
+            # TTS_CAPACITY=0 or before a service-time estimate exists
+            cap_col = ""
+            if s.get("utilization") is not None:
+                cap_col = (f" rho={s['utilization']:.2f}"
+                           f" headroom={s['capacity_headroom']:.2f}")
             fo_col = ""
             if s.get("failover_mode") is not None or s.get("fenced"):
                 fo_col = (f" failover={s.get('failover_mode')}"
@@ -953,8 +959,8 @@ def run_doctor(args) -> int:
                   f"firing={s.get('firing')} "
                   f"queue={s.get('queue_depth')} "
                   f"busy={s.get('submeshes_busy')}/{s.get('submeshes')} "
-                  f"requests={s.get('requests')}{eta_col}{aot_col}"
-                  f"{rem_col}{pf_col}{led_col}{fo_col}")
+                  f"requests={s.get('requests')}{eta_col}{cap_col}"
+                  f"{aot_col}{rem_col}{pf_col}{led_col}{fo_col}")
         for r in lease_report or []:
             state = ("released" if r["released"] else
                      "EXPIRED" if r["expired"] else "live")
@@ -968,6 +974,88 @@ def run_doctor(args) -> int:
     if lease_report and aggregate.needs_takeover(lease_report):
         return DOCTOR_TAKEOVER_EXIT_CODE
     return 1
+
+
+def _capacity_parser(sub):
+    p = sub.add_parser(
+        "capacity",
+        help="fleet capacity & utilization report (obs/capacity): "
+             "scrape N servers' GET /capacity and print per-lane "
+             "state/utilization, per-shape-class demand vs capacity "
+             "(ρ, headroom, predicted queue wait) and the what-if "
+             "submesh-partition advisor")
+    p.add_argument("urls", nargs="+", metavar="URL",
+                   help="server base URLs (http://host:port)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable documents instead of the "
+                        "human tables")
+    p.add_argument("--timeout", type=float, default=5.0,
+                   help="per-endpoint scrape timeout in seconds")
+
+
+def run_capacity(args) -> int:
+    import json
+
+    from .obs import aggregate
+
+    docs, rc = [], 0
+    for url in args.urls:
+        base = url.rstrip("/")
+        origin = base.split("://", 1)[-1]
+        try:
+            _, body = aggregate._get(base + "/capacity", args.timeout)
+            docs.append({"origin": origin, **json.loads(body)})
+        except (OSError, ValueError) as e:
+            docs.append({"origin": origin, "error": str(e)})
+            rc = 1
+    if args.json:
+        print(json.dumps(docs, indent=1))
+        return rc
+    for doc in docs:
+        if doc.get("error"):
+            print(f"{doc['origin']}: UNREACHABLE ({doc['error']})")
+            continue
+        if not doc.get("enabled"):
+            print(f"{doc['origin']}: capacity layer off "
+                  "(TTS_CAPACITY=0)")
+            continue
+        rho = doc.get("utilization")
+        print(f"{doc['origin']}: lanes={doc.get('healthy_lanes')}"
+              f"/{doc.get('lanes')} devices={doc.get('devices')} "
+              f"arrivals={doc.get('arrival_per_s', 0):.3f}/s "
+              + (f"rho={rho:.2f} headroom={doc.get('headroom'):.2f}"
+                 if rho is not None else "rho=— (no service estimate)")
+              + (f" pred_wait_s={doc['predicted_wait_s']:.3f}"
+                 if doc.get("predicted_wait_s") is not None else "")
+              + (f" pred_req_per_s={doc['predicted_req_per_s']:.3f}"
+                 if doc.get("predicted_req_per_s") is not None else ""))
+        for ln in doc.get("lanes_detail") or []:
+            secs = ln.get("seconds") or {}
+            top = ", ".join(f"{k}={secs[k]:.1f}s" for k in sorted(
+                secs, key=lambda k: -secs[k])[:3])
+            print(f"  lane {ln.get('lane')}: {ln.get('state'):<13} "
+                  f"exec={ln.get('utilization', 0) * 100:5.1f}%  "
+                  f"[{top}]  conservation_err="
+                  f"{ln.get('conservation_error_s'):.2e}s")
+        for c in doc.get("classes") or []:
+            srv_s = c.get("service_s")
+            print(f"  class {c.get('shape')} tenant={c.get('tenant')}: "
+                  f"lambda={c.get('arrival_per_s', 0):.3f}/s "
+                  + (f"E[S]={srv_s:.3f}s rho={c.get('utilization'):.2f}"
+                     if srv_s is not None else "E[S]=— (warming up)"))
+        wi = doc.get("what_if") or []
+        if wi:
+            print("  what-if (same devices, n equal lanes):")
+            for row in wi:
+                cur = "  <- current" if row.get("current") else ""
+                wait = row.get("predicted_wait_s")
+                print(f"    {row['lanes']} lane(s) x "
+                      f"{row['devices_per_lane']} dev: "
+                      f"req/s={row['predicted_req_per_s']:.3f} "
+                      f"rho={row['utilization']:.2f} "
+                      + (f"wait_s={wait:.3f}" if wait is not None
+                         else "wait_s=inf (saturated)") + cur)
+    return rc
 
 
 def _journey_parser(sub):
@@ -1464,6 +1552,7 @@ def main(argv=None) -> int:
     _client_parser(sub)
     _profile_parser(sub)
     _doctor_parser(sub)
+    _capacity_parser(sub)
     _journey_parser(sub)
     sub.add_parser("devices",
                    help="describe attached devices (the reference's "
@@ -1481,6 +1570,9 @@ def main(argv=None) -> int:
         # pure scraper: skip the compile cache / backend bootstrap —
         # the doctor must never touch (or wait for) an accelerator
         return run_doctor(args)
+    if args.cmd == "capacity":
+        # pure scraper, same stance as doctor
+        return run_capacity(args)
     if args.cmd == "journey":
         # pure storage reader (stdlib-only, same stance as doctor)
         return run_journey(args)

@@ -184,7 +184,11 @@ def merge(fleet: dict) -> dict:
                # progress/ETA estimation (obs/estimate): None when no
                # request carries a published estimate (warmup or
                # TTS_PROGRESS=0 — snapshot parity)
-               "progress_mean": None, "eta_max_s": None}
+               "progress_mean": None, "eta_max_s": None,
+               # capacity model (obs/capacity): overall ρ and headroom;
+               # None with TTS_CAPACITY=0 or before the model has a
+               # service-time estimate (snapshot parity)
+               "utilization": None, "capacity_headroom": None}
         st = s.get("status")
         if st:
             row["uptime_s"] = st.get("uptime_s")
@@ -230,6 +234,12 @@ def merge(fleet: dict) -> dict:
             # doctor's portfolio column; per-race winner configs ride
             # each parent request snapshot's `portfolio` block below
             row["portfolio"] = st.get("portfolio")
+            # the capacity columns: demand over healthy-lane capacity
+            # and what is left — the doctor's saturation forecast input
+            cap = st.get("capacity")
+            if cap:
+                row["utilization"] = cap.get("utilization")
+                row["capacity_headroom"] = cap.get("headroom")
             reqs = st.get("requests") or {}
             row["requests"] = len(reqs)
             # the predictive columns: mean published progress over the

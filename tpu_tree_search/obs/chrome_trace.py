@@ -60,6 +60,31 @@ COUNTER_KEYS = ("pruning_rate", "frontier_depth", "pool",
 # device, so an HBM ramp lines up with the pool growth that caused it
 RESOURCE_EVENT = "resource.sample"
 
+# lane-state transitions (obs/capacity.LaneLedger) render as
+# RETROSPECTIVE state slices on a dedicated per-lane track: the event
+# fires when a state is LEFT and carries the full duration just spent
+# in it, so the slice is drawn backwards from the transition timestamp
+LANE_STATE_EVENT = "lane.state"
+
+
+def _lane_state_slice(rec: dict) -> dict | None:
+    """The ``X`` slice a ``lane.state`` transition contributes to its
+    ``lane-<submesh>-state`` track: name = the state being left,
+    spanning [ts − seconds, ts]. Zero-duration flickers are kept (dur
+    0) — Perfetto renders them as ticks, and dropping them would hide
+    real scheduler churn."""
+    if rec.get("name") != LANE_STATE_EVENT or rec.get("submesh") is None:
+        return None
+    try:
+        dur = max(float(rec.get("seconds", 0.0)), 0.0)
+        ts = float(rec.get("ts", 0.0))
+    except (TypeError, ValueError):
+        return None
+    return {"name": str(rec.get("prev", "?")),
+            "ts": round((ts - dur) * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
+            "track": f"lane-{rec['submesh']}-state"}
+
 
 def _lifeline_of(rec: dict) -> str | None:
     """The per-request LIFELINE lane a record also lands on: every
@@ -142,6 +167,13 @@ def to_chrome(records: list[dict]) -> dict:
                 lf_tid = tids.setdefault(lifeline, len(tids))
                 events.append({**base, "tid": lf_tid,
                                "ph": "i", "s": "t"})
+            sl = _lane_state_slice(rec)
+            if sl is not None:
+                st_tid = tids.setdefault(sl["track"], len(tids))
+                events.append({"name": sl["name"], "ph": "X",
+                               "pid": 0, "tid": st_tid,
+                               "ts": sl["ts"], "dur": sl["dur"],
+                               "args": {"state": sl["name"]}})
     meta = [{"ph": "M", "name": "thread_name", "pid": 0, "tid": tid,
              "args": {"name": track}} for track, tid in tids.items()]
     # sorted lanes first, then events in timestamp order: Perfetto does

@@ -66,6 +66,15 @@ td.num { font-variant-numeric: tabular-nums; }
 .spark .v { font-weight: 600; margin-left: 8px; }
 .ok { color: var(--good); } .err { color: var(--critical); }
 .mono { font-family: ui-monospace, monospace; font-size: 12px; }
+.stripe { display: flex; height: 14px; width: 320px;
+  border-radius: 3px; overflow: hidden; background: var(--surface-2); }
+.stripe span { display: block; height: 100%; }
+.st-idle { background: var(--grid); }
+.st-compiling { background: var(--warning); }
+.st-executing { background: var(--good); }
+.st-draining { background: var(--serious); }
+.st-quarantined { background: var(--critical); }
+.st-batch-frozen { background: var(--series-1); }
 footer { margin-top: 32px; color: var(--text-secondary);
   font-size: 12px; }
 """
@@ -179,6 +188,42 @@ def _request_rows(reqs: list[dict], with_origin: bool = False) -> str:
     return "".join(rows)
 
 
+def _lane_rows(cap: dict | None) -> str:
+    """Per-lane utilization stripes from the capacity snapshot's
+    ``lanes_detail`` (obs/capacity.LaneLedger): one horizontal stripe
+    per lane, segment width = fraction of lifetime in each state (the
+    reserved status palette carries the state; the title attribute and
+    the utilization cell carry the numbers)."""
+    lanes = (cap or {}).get("lanes_detail") or []
+    if not lanes:
+        return ""
+    rows = []
+    for ln in lanes:
+        life = ln.get("lifetime_s") or 0.0
+        segs = []
+        for state, secs in sorted((ln.get("seconds") or {}).items()):
+            frac = (secs / life * 100.0) if life > 0 else 0.0
+            if frac < 0.05:
+                continue
+            segs.append(
+                f'<span class="st-{_esc(state)}" '
+                f'style="width:{frac:.2f}%" '
+                f'title="{_esc(state)} {secs:.1f}s '
+                f'({frac:.1f}%)"></span>')
+        util = ln.get("utilization")
+        util_cell = f"{util * 100:.1f}%" if util is not None else "—"
+        rows.append(
+            f'<tr><td class="num">{_esc(ln.get("lane"))}</td>'
+            f"<td>{_esc(ln.get('state'))}</td>"
+            f'<td><div class="stripe">{"".join(segs)}</div></td>'
+            f'<td class="num">{util_cell}</td>'
+            f'<td class="num">{life:.1f}</td></tr>')
+    return (
+        "<h2>Lanes</h2><table><tr><th>lane</th><th>state</th>"
+        "<th>time in state</th><th>executing</th><th>lifetime s</th>"
+        f"</tr>{''.join(rows)}</table>")
+
+
 def _page(title: str, sub: str, body: str) -> str:
     return (
         "<!doctype html><html><head><meta charset='utf-8'>"
@@ -286,6 +331,7 @@ def render_server(snapshot: dict | None, alerts: dict | None,
         f"<th>detail</th></tr>{_remediation_rows(rem)}</table>"
         + (f"<h2>Trends</h2><div class='sparks'>{''.join(sparks)}</div>"
            if sparks else "")
+        + _lane_rows(snapshot.get("capacity"))
         + "<h2>Requests</h2><table><tr><th>id</th><th>state</th>"
           "<th>submesh</th><th>disp</th><th>preempt</th>"
           "<th>spent s</th><th>progress</th><th>eta s</th>"
@@ -343,12 +389,15 @@ def render_fleet(merged: dict) -> str:
             if s.get("fenced"):
                 # icon + word, never color alone (the palette rule)
                 fo_cell = "✗ FENCED · " + fo_cell
+        util = s.get("utilization")
+        util_cell = f"{util * 100:.0f}%" if util is not None else "—"
         srv_rows.append(
             f"<tr><td>{_esc(s['origin'])}</td><td>{mark}</td>"
             f'<td class="num">{_esc(s.get("firing", "-"))}</td>'
             f'<td class="num">{_esc(s.get("queue_depth", "-"))}</td>'
             f'<td class="num">{_esc(s.get("submeshes_busy", "-"))}/'
             f"{_esc(s.get('submeshes', '-'))}</td>"
+            f'<td class="num">{_esc(util_cell)}</td>'
             f"<td>{_esc(rem or '—')}</td>"
             f"<td>{_esc(led)}</td>"
             f"<td>{_esc(fo_cell)}</td>"
@@ -357,7 +406,7 @@ def render_fleet(merged: dict) -> str:
     body = (
         f'<div class="tiles">{tiles}</div>'
         "<h2>Servers</h2><table><tr><th>origin</th><th>health</th>"
-        "<th>firing</th><th>queue</th><th>busy</th>"
+        "<th>firing</th><th>queue</th><th>busy</th><th>ρ</th>"
         "<th>remediation</th><th>ledger</th><th>failover</th>"
         "<th>requests</th>"
         f"<th>uptime s</th></tr>{''.join(srv_rows)}</table>"

@@ -89,6 +89,10 @@ class Thresholds:
     pruning_min_nodes: float = cfg.HEALTH_PRUNING_MIN_NODES_DEFAULT
     audit_window_s: float = cfg.HEALTH_AUDIT_WINDOW_S_DEFAULT
     perf_json: str | None = None
+    # saturation rule (obs/capacity.py's overall ρ; fires on sustained
+    # demand over capacity BEFORE the reactive queue_wait p99 can)
+    saturation: float = cfg.HEALTH_SATURATION_DEFAULT
+    saturation_for_s: float = cfg.HEALTH_SATURATION_FOR_S_DEFAULT
     # SLO burn-rate rules (durable-store terminal history; see the
     # config module's SLO_* block for the window semantics)
     slo_error_budget: float = cfg.SLO_ERROR_BUDGET_DEFAULT
@@ -144,6 +148,9 @@ class Thresholds:
                 "TTS_HEALTH_PRUNING_MIN_NODES"),
             audit_window_s=cfg.env_float("TTS_HEALTH_AUDIT_WINDOW_S"),
             perf_json=cfg.env_str("TTS_HEALTH_PERF_JSON"),
+            saturation=cfg.env_float("TTS_HEALTH_SATURATION"),
+            saturation_for_s=cfg.env_float(
+                "TTS_HEALTH_SATURATION_FOR_S"),
             slo_error_budget=cfg.env_float("TTS_SLO_ERROR_BUDGET"),
             slo_latency_target_s=cfg.env_float(
                 "TTS_SLO_LATENCY_TARGET_S"),
@@ -246,7 +253,10 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
         if srv is None or getattr(srv, "metrics", None) is None:
             return False, {}
         h = srv.metrics.histogram("tts_queue_wait_seconds")
-        snap = h.snapshot()
+        # matching, not exact: the family carries a tenant label, and
+        # the flat rule judges the all-tenants window (an unlabeled
+        # snapshot() of a labeled family is the empty series)
+        snap = h.snapshot_matching()
         p99, n = _hist_delta_quantile(state["qw_prev"], snap, 0.99)
         state["qw_prev"] = snap
         if p99 is None:
@@ -564,6 +574,40 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
             return False, {}
         return True, {**worst, "at_risk": at_risk}
 
+    def saturation(ctx):
+        """Sustained demand over capacity (obs/capacity's overall ρ) —
+        the forecast that fires BEFORE the reactive queue_wait p99 can:
+        ρ moves with admissions and measured service rates, while the
+        p99 needs a window of already-late dispatches to breach. Reads
+        the shared snapshot, so the health cadence also drives the
+        tts_capacity_* gauge refresh."""
+        cap = (ctx.snapshot or {}).get("capacity")
+        if not cap:
+            return False, {}
+        rho = cap.get("utilization")
+        if rho is None:        # no terminal yet: demand unmeasurable
+            return False, {}
+        if rho <= th.saturation:
+            return False, {}
+        worst = None
+        for row in cap.get("classes") or []:
+            u = row.get("utilization")
+            if u is not None and (worst is None
+                                  or u > worst["utilization"]):
+                worst = row
+        detail = {"utilization": round(rho, 4),
+                  "threshold": th.saturation,
+                  "arrival_per_s": round(cap.get("arrival_per_s", 0.0),
+                                         4),
+                  "healthy_lanes": cap.get("healthy_lanes")}
+        if cap.get("predicted_wait_s") is not None:
+            detail["predicted_wait_s"] = round(
+                cap["predicted_wait_s"], 3)
+        if worst is not None:
+            detail["worst_class"] = (f"{worst['shape']}/"
+                                     f"{worst['tenant']}")
+        return True, detail
+
     def perf(ctx):
         path = th.perf_json
         if not path or not os.path.exists(path):
@@ -612,6 +656,16 @@ def default_rules(thresholds: Thresholds) -> list[Rule]:
                          "windows (spent_s over the target counts "
                          "against the budget)"),
     ] + ([
+        # exists only while the capacity layer is on: with
+        # TTS_CAPACITY=0 the rule LIST itself is the pre-capacity one
+        # (the /alerts rules block stays bit-identical). Sits BEFORE
+        # the progress pair — their end-of-list position is pinned.
+        Rule("saturation", saturation, severity="warn",
+             for_s=th.saturation_for_s,
+             description="sustained shape-class demand over healthy-"
+                         "lane capacity (predictive — fires before the "
+                         "queue_wait p99 breaches)"),
+    ] if cfg.env_flag("TTS_CAPACITY") else []) + ([
         # the predictive pair exists only while progress estimation is
         # on: with TTS_PROGRESS=0 the rule LIST itself is the pre-
         # estimator one (the /alerts rules block stays bit-identical)
@@ -893,6 +947,20 @@ class HealthMonitor:
             if vals:
                 push("progress_mean",
                      round(sum(vals) / len(vals), 4))
+            # overall ρ + mean lane-executing fraction (the dashboard's
+            # utilization sparklines). Data-driven like progress_mean:
+            # with the capacity layer off the snapshot never carries
+            # the key, so the rings never exist — bit-identical history
+            cap = (ctx.snapshot or {}).get("capacity")
+            if cap:
+                rho = cap.get("utilization")
+                if rho is not None:
+                    push("capacity_utilization", round(rho, 4))
+                lanes = cap.get("lanes_detail") or []
+                if lanes:
+                    push("lane_executing_frac", round(
+                        sum(r.get("utilization", 0.0) for r in lanes)
+                        / len(lanes), 4))
         use = ctx.gauge_samples("tts_device_bytes_in_use")
         if use:
             push("device_bytes_in_use", sum(v for _, v in use))

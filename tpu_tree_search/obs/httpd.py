@@ -17,6 +17,10 @@ FRONT of a running :class:`~tpu_tree_search.service.SearchServer`:
   trace-event JSON (save it, open in Perfetto);
 - ``GET /alerts``   — the health rules engine's alert lifecycle
   snapshot (obs/health; the ``doctor`` CLI's verdict input);
+- ``GET /capacity`` — the lane-state ledger + shape-class capacity
+  model document (obs/capacity; per-lane state seconds, per-class
+  ρ/headroom/predicted wait, and the what-if partition advisor);
+  empty-but-valid with ``TTS_CAPACITY=0``;
 - ``GET /dashboard`` — self-contained HTML operational dashboard
   (obs/dashboard; stdlib only, zero external assets);
 - ``GET /journey?tag=`` — the flight recorder's request journeys
@@ -79,7 +83,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     GET_PATHS = ("/healthz", "/metrics", "/status", "/trace", "/alerts",
-                 "/dashboard", "/journey", "/")
+                 "/capacity", "/dashboard", "/journey", "/")
     POST_PATHS = ("/submit", "/cancel", "/profile")
 
     def _query(self) -> dict:
@@ -91,7 +95,8 @@ class _Handler(BaseHTTPRequestHandler):
         obs: "ObsHttpd" = self.server.obs  # type: ignore[attr-defined]
         self._route({"/healthz": obs.healthz, "/metrics": obs.metrics,
                      "/status": obs.status, "/trace": obs.trace,
-                     "/alerts": obs.alerts, "/dashboard": obs.dashboard,
+                     "/alerts": obs.alerts, "/capacity": obs.capacity,
+                     "/dashboard": obs.dashboard,
                      "/journey": lambda: obs.journey(self._query()),
                      "/": obs.index}, other_method=self.POST_PATHS)
 
@@ -127,9 +132,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(404, json.dumps(
                     {"error": f"unknown path {path!r}",
                      "endpoints": ["/healthz", "/metrics", "/status",
-                                   "/trace", "/alerts", "/dashboard",
-                                   "/journey", "/submit", "/cancel",
-                                   "/profile"]})
+                                   "/trace", "/alerts", "/capacity",
+                                   "/dashboard", "/journey", "/submit",
+                                   "/cancel", "/profile"]})
                     + "\n", "application/json")
                 return
             obs.http_requests.inc(path=path)
@@ -201,8 +206,9 @@ class ObsHttpd:
         return 200, json.dumps(
             {"service": "tpu_tree_search",
              "endpoints": ["/healthz", "/metrics", "/status", "/trace",
-                           "/alerts", "/dashboard", "/journey",
-                           "/submit", "/cancel", "/profile"]}) + "\n", \
+                           "/alerts", "/capacity", "/dashboard",
+                           "/journey", "/submit", "/cancel",
+                           "/profile"]}) + "\n", \
             "application/json"
 
     def healthz(self):
@@ -246,6 +252,22 @@ class ObsHttpd:
             body = {"enabled": False, "firing": 0, "alerts": []}
         else:
             body = {"enabled": True, **mon.alerts_snapshot()}
+        return 200, json.dumps(body) + "\n", "application/json"
+
+    def capacity(self):
+        """GET /capacity: the lane-state ledger + shape-class capacity
+        model document (obs/capacity), with the what-if partition
+        advisor. A server without the capacity layer (TTS_CAPACITY=0,
+        or no server attached) answers an empty-but-valid document so
+        fleet scrapers need no special case."""
+        srv = self.server
+        snap = (srv.capacity_snapshot()
+                if srv is not None and hasattr(srv, "capacity_snapshot")
+                else None)
+        if snap is None:
+            body = {"enabled": False}
+        else:
+            body = {"enabled": True, **snap}
         return 200, json.dumps(body) + "\n", "application/json"
 
     def journey(self, query: dict):

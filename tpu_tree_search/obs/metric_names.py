@@ -56,9 +56,10 @@ REGISTRY: dict[str, Metric] = _table(
            "re-dispatches after a submesh failure"),
     Metric("tts_request_spent_seconds", "histogram", "",
            "per-request accumulated execution time"),
-    Metric("tts_queue_wait_seconds", "histogram", "",
-           "admission-to-dispatch wait (under megabatching: observed "
-           "at batch-close, so held batch members are counted)"),
+    Metric("tts_queue_wait_seconds", "histogram", "tenant",
+           "admission-to-dispatch wait by accounting tenant (under "
+           "megabatching: observed at batch-close, so held batch "
+           "members are counted)"),
     # --- request megabatching (engine/megabatch + the batch-former)
     Metric("tts_batches_formed_total", "counter", "reason",
            "batches closed by the former (reason=size|age)"),
@@ -66,6 +67,10 @@ REGISTRY: dict[str, Metric] = _table(
            "requests per closed batch"),
     Metric("tts_batch_requests_total", "counter", "",
            "requests dispatched through a multi-request batch"),
+    Metric("tts_batch_drain_idle_seconds", "histogram", "",
+           "per closed megabatch: lane-seconds members sat frozen "
+           "waiting for batchmates to drain (the continuous-batching "
+           "motivation number)"),
     # --- bound-portfolio racing (service/portfolio)
     Metric("tts_portfolio_races_total", "counter", "outcome",
            "portfolio races by outcome (won/deadline/cancelled/"
@@ -240,6 +245,18 @@ REGISTRY: dict[str, Metric] = _table(
     Metric("tts_est_tree_size", "gauge", "request,tag,tenant",
            "estimated total search-tree size in nodes (Knuth-family "
            "online estimate from depth-bucket branching/pruning)"),
+    # --- fleet capacity & utilization (obs/capacity.py, TTS_CAPACITY)
+    Metric("tts_lane_seconds_total", "counter", "lane,state",
+           "wall-clock seconds each submesh lane spent per scheduler "
+           "state (idle/compiling/executing/draining/quarantined/"
+           "batch-frozen; conserved — states sum to lane lifetime)"),
+    Metric("tts_capacity_utilization", "gauge", "shape,tenant",
+           "per-shape-class ρ = arrival demand over healthy-lane "
+           "capacity (1.0 = saturated)"),
+    Metric("tts_capacity_headroom", "gauge", "shape,tenant",
+           "per-shape-class spare capacity fraction (1 − ρ)"),
+    Metric("tts_capacity_predicted_wait_s", "gauge", "shape,tenant",
+           "Little's-law predicted queue wait per shape class"),
     # --- health / audit / meta
     Metric("tts_alerts", "gauge", "rule,severity",
            "alert state by rule (0 inactive, 0.5 pending, 1 firing)"),

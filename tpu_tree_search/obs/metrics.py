@@ -249,6 +249,28 @@ class Histogram(_Metric):
                     "buckets": dict(zip(map(str, self.buckets),
                                         s.counts))}
 
+    def snapshot_matching(self, **labels) -> dict:
+        """Merged snapshot over every series whose labels include these
+        pairs — the histogram counterpart of ``Counter.value_matching``
+        for a family that grew an extra label
+        (tts_queue_wait_seconds{tenant}: ``snapshot_matching()`` still
+        answers the all-tenants p99 the health rule judges)."""
+        want = {(str(k), str(v)) for k, v in labels.items()}
+        counts = [0] * len(self.buckets)
+        total, count = 0.0, 0
+        with self._lock:
+            for k, s in self._series.items():
+                if not want <= set(k):
+                    continue
+                for i, n in enumerate(s.counts):
+                    counts[i] += n
+                total += s.sum
+                count += s.count
+        if count == 0:
+            return {"count": 0, "sum": 0.0}
+        return {"count": count, "sum": total,
+                "buckets": dict(zip(map(str, self.buckets), counts))}
+
     def to_json(self):
         with self._lock:
             keys = sorted(self._series)

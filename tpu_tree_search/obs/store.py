@@ -75,6 +75,11 @@ DRAIN_POLL_S = 0.2       # writer-thread wakeup when the queue is idle
 EVENT_PREFIXES = (
     "request.", "alert.", "remediation.", "failover.", "portfolio.",
     "batch.", "server.", "takeover", "ledger.replay", "journey.",
+    # lane-state transitions (obs/capacity.py, TTS_CAPACITY): bounded
+    # by scheduler transitions, not per-segment — and only emitted at
+    # all when the capacity layer is on, so the off-path store content
+    # is unchanged
+    "lane.",
 )
 
 # request terminal-state events (server._finalize) — the SLO burn
@@ -104,6 +109,11 @@ RESUME_COUNTERS = (
     "tts_portfolio_members_total",
     "tts_alerts_fired_total",
     "tts_takeovers_total",
+    # lane-state seconds (obs/capacity.py): the utilization history
+    # that must survive kill -9 — the LaneLedger re-seeds its per-state
+    # accumulators from the replayed series at boot (replayed seconds
+    # tracked separately so conservation stays exact per lifetime)
+    "tts_lane_seconds_total",
 )
 
 # gauges snapshotted into every sample record — the health monitor's
@@ -113,6 +123,9 @@ SAMPLE_GAUGES = (
     "tts_submeshes_busy",
     "tts_device_bytes_in_use",
     "tts_host_rss_bytes",
+    # per-shape-class ρ (obs/capacity.py): exists only with the
+    # capacity layer on, so off-path samples are unchanged
+    "tts_capacity_utilization",
 )
 
 

@@ -63,6 +63,7 @@ import time
 
 import numpy as np
 
+from ..obs import capacity as obs_capacity
 from ..obs import health as obs_health
 from ..obs import metrics as obs_metrics
 from ..obs import resource as obs_resource
@@ -220,8 +221,14 @@ class SearchServer:
             "accumulated execution time of terminal requests")
         self._m_queue_wait = self.metrics.histogram(
             "tts_queue_wait_seconds",
-            "admit/requeue -> dispatch wait (the health layer's "
-            "queue_wait SLO reads its windowed p99)")
+            "admit/requeue -> dispatch wait by accounting tenant (the "
+            "health layer's queue_wait SLO reads its windowed "
+            "all-tenants p99)")
+        self._m_drain_idle = self.metrics.histogram(
+            "tts_batch_drain_idle_seconds",
+            "per closed megabatch: lane-seconds members sat frozen "
+            "waiting for batchmates to drain (the continuous-batching "
+            "motivation number)")
         # under megabatching, requests waiting in the batch-former are
         # still WAITING — the depth gauge (and the admission bound in
         # submit()) must count them, or an overloaded megabatch server
@@ -392,6 +399,15 @@ class SearchServer:
         # checkpoint-meta keys or predictive rules — bit-identical to
         # the pre-estimator server
         self.progress_enabled = cfg.env_flag("TTS_PROGRESS")
+        # fleet capacity & utilization (obs/capacity; static, read
+        # once): off = NO lane ledger, capacity model, lane events/
+        # counters, capacity gauges, snapshot key or saturation rule —
+        # bit-identical to the pre-capacity server. Constructed after
+        # the obs store resume below so a restarted server seeds lane
+        # history from the replayed counters.
+        self.capacity_enabled = cfg.env_flag("TTS_CAPACITY")
+        self.lane_ledger = None
+        self.capacity = None
         self.records: dict[str, RequestRecord] = {}  # guarded-by: self._lock
         self._lock = threading.RLock()
         self._seq = itertools.count()
@@ -542,6 +558,25 @@ class SearchServer:
                     replayed=self.obs_store.replayed,
                     truncated=self.obs_store.truncated,
                     counters_seeded=seeded)
+        if self.capacity_enabled:
+            # AFTER the obs-store resume above: the lane ledger seeds
+            # its per-state accumulators from the replayed
+            # tts_lane_seconds_total series (store unset/fenced = a
+            # fresh ledger, same construction)
+            self.lane_ledger = obs_capacity.LaneLedger(
+                self.metrics, [s.index for s in self.slots])
+            for _, key, val in self.metrics.counter(
+                    obs_capacity.LANE_SECONDS_METRIC,
+                    obs_capacity.LANE_SECONDS_DOC).samples():
+                labels = dict(key)
+                if "lane" in labels and "state" in labels:
+                    try:
+                        self.lane_ledger.seed(int(labels["lane"]),
+                                              labels["state"],
+                                              float(val))
+                    except (TypeError, ValueError):
+                        pass    # a foreign writer's malformed series
+            self.capacity = obs_capacity.CapacityModel(self.metrics)
         tracelog.event("server.start", submeshes=len(self.slots),
                        devices_per_submesh=self.slots[0].mesh.devices.size,
                        workdir=str(self.workdir),
@@ -624,6 +659,15 @@ class SearchServer:
         self.resources.close()
         # same valve for the health daemon and its tts_alerts series
         self.health.close()
+        # close the lane ledger's final open intervals into the counter
+        # (BEFORE the obs store's last sample below, so the persisted
+        # lane seconds include them) and retire the capacity gauges
+        if self.lane_ledger is not None:
+            for slot in self.slots:
+                self._lane_sync(slot)
+            self.lane_ledger.flush()
+        if self.capacity is not None:
+            self.capacity.close()
         # and the remediation worker (its journal stays readable)
         self.remediation.close()
         # flush the AOT-cache writer so every compile paid this
@@ -649,6 +693,13 @@ class SearchServer:
         # (server.close, lease.released) are on disk for the next
         # lifetime's replay
         if self.obs_store is not None:
+            if self.lane_ledger is not None:
+                # one final sample so the just-flushed lane counters
+                # land on disk for the next lifetime's ledger seed (a
+                # kill -9 keeps the last periodic sample instead —
+                # conservation then counts the lost tail as replayed
+                # time it never saw, which is exactly the truth)
+                self.obs_store.sample_now(self._obs_sample)
             tracelog.get().remove_listener(self.obs_store.on_trace_event)
             self.obs_store.flush()
             self.obs_store.close()
@@ -658,6 +709,10 @@ class SearchServer:
         whitelisted counters (the resume set), the history-ring gauge
         signals, and the health rings' latest values."""
         counters, gauges = [], []
+        if self.lane_ledger is not None:
+            # close open lane intervals into the counter first, so the
+            # persisted lane seconds are current as of this sample
+            self.lane_ledger.flush()
         for m in self.metrics.metrics():
             if m.kind == "counter" \
                     and m.name in obs_store_mod.RESUME_COUNTERS:
@@ -848,6 +903,9 @@ class SearchServer:
                            deadline_s=request.deadline_s,
                            tenant=request.tenant,
                            resumable=rec.spent_prev_s > 0)
+            if self.capacity is not None:
+                self.capacity.on_admit(self._shape_class(request),
+                                       request.tenant)
             return rid
 
     def _submit_portfolio(self, request: SearchRequest, k: int, *,
@@ -1347,6 +1405,7 @@ class SearchServer:
             if self.ledger is not None:
                 self.ledger.journal("quarantine", submesh=int(index),
                                    reason=reason)
+            self._lane_sync(slot)
 
     def readmit_submesh(self, index: int) -> None:
         """Clear a slot's quarantine (the canary probe passed)."""
@@ -1356,6 +1415,7 @@ class SearchServer:
             slot.quarantine_reason = None
             if self.ledger is not None:
                 self.ledger.journal("readmit", submesh=int(index))
+            self._lane_sync(slot)
 
     def heartbeat_ages(self) -> dict:
         """Seconds since each RUNNING request's last engine heartbeat —
@@ -1368,6 +1428,96 @@ class SearchServer:
                     for rec in slot.records
                     if rec.state == RUNNING
                     and rec.last_heartbeat_t is not None}
+
+    # --------------------------------------------- capacity (TTS_CAPACITY)
+
+    def _lane_state(self, slot: _Slot) -> str:
+        """Resolve a slot's lane state from existing scheduler state —
+        no new bookkeeping, so the resolver cannot drift from the
+        transitions it observes. Priority order matters: a quarantined
+        lane is quarantined whatever it still runs, a stop in flight is
+        draining even if some member already froze."""
+        if slot.quarantined:
+            return "quarantined"
+        recs = slot.records
+        if not recs:
+            return "idle"
+        if all(r.dispatch_heartbeats == 0 for r in recs):
+            return "compiling"      # dispatched, no heartbeat yet:
+            #                         the XLA trace+compile window
+        if ((slot.stop_event is not None and slot.stop_event.is_set())
+                or any(r.stop_reason is not None
+                       and r.state not in TERMINAL_STATES
+                       for r in recs)):
+            return "draining"   # a stop is in flight only until the
+            #                     stopped member finalizes
+        if slot.batch is not None \
+                and any(r.state != RUNNING for r in recs):
+            return "batch-frozen"   # a member finished; the rest run
+            #                         the batch out (ROADMAP item 2)
+        return "executing"
+
+    def _lane_sync(self, slot: _Slot) -> None:
+        """Fold `slot`'s current resolved state into the lane ledger (a
+        no-op when unchanged, and entirely absent with TTS_CAPACITY=0).
+        Callable with OR without the server lock: the ledger locks
+        itself, and a racing resolve can at worst label a sliver of
+        time with the neighboring state — conservation is untouched."""
+        if self.lane_ledger is not None:
+            self.lane_ledger.transition(slot.index,
+                                        self._lane_state(slot))
+
+    def _shape_class(self, request: SearchRequest) -> str:
+        """The tune/defaults shape-class label of a request — the key
+        the capacity model's demand and service-rate tables join on."""
+        from .. import problems
+        from ..tune import defaults as tune_defaults
+        p = np.asarray(request.p_times)
+        return tune_defaults.shape_class(
+            problems.get(request.problem).slots(p), p.shape[0],
+            problem=request.problem)
+
+    def _capacity_seed(self, shape: str, p: np.ndarray,
+                       lb_kind: int) -> None:
+        """Seed the capacity model's service rate for `shape` from the
+        same tuning tier the dispatch itself resolves through (cached
+        eval's evals/s when present, the defaults table otherwise) —
+        the model corrects it with observed throughput as heartbeats
+        arrive, but a fresh class gets a non-degenerate E[S] from the
+        very first admit."""
+        if self.capacity is None:
+            return
+        params = None
+        if self.tuner is not None:
+            try:
+                params = self.tuner.resolve(
+                    p.shape[1], p.shape[0], lb_kind,
+                    n_workers=self.slots[0].mesh.devices.size)
+            except Exception:   # noqa: BLE001 — seeding is best-effort
+                params = None
+        if params is None:
+            from ..tune import defaults as tune_defaults
+            try:
+                params = tune_defaults.params_for(
+                    "serving", p.shape[1], p.shape[0])
+            except Exception:   # noqa: BLE001
+                return
+        rate = getattr(params, "evals_per_s", None)
+        if rate:
+            self.capacity.seed_rate(shape, float(rate))
+
+    def capacity_snapshot(self) -> dict | None:
+        """The ``GET /capacity`` document (and status_snapshot's
+        ``capacity`` key): lane-state ledger detail + the shape-class
+        demand/capacity model with its what-if partition table. None
+        with the capacity layer off."""
+        if self.capacity is None or self.lane_ledger is None:
+            return None
+        healthy = sum(1 for s in self.slots if not s.quarantined)
+        devices = sum(len(s.device_ids) for s in self.slots)
+        doc = self.capacity.snapshot(healthy, len(self.slots), devices)
+        doc["lanes_detail"] = self.lane_ledger.snapshot()
+        return doc
 
     def status_snapshot(self) -> dict:
         """One JSON-safe dict describing the whole server: queue depth
@@ -1416,6 +1566,10 @@ class SearchServer:
                 "metrics": self.metrics.to_json(),
                 "requests": {rid: rec.snapshot()
                              for rid, rec in self.records.items()},
+                # ABSENT (not None) with the capacity layer off: the
+                # off-path snapshot is bit-identical, test-pinned
+                **({"capacity": self.capacity_snapshot()}
+                   if self.capacity is not None else {}),
             }
 
     def _portfolio_snapshot(self) -> dict | None:
@@ -2102,6 +2256,13 @@ class SearchServer:
                        spent_s=round(rec.spent_s(), 3),
                        dispatches=rec.dispatches,
                        preemptions=rec.preemptions, error=rec.error)
+        if self.capacity is not None and rec.result is not None:
+            # a finished tree is a measured service demand: explored
+            # nodes feed the shape class's evals-per-request EWMA
+            self.capacity.on_terminal(
+                self._shape_class(rec.request),
+                getattr(rec.result, "explored_tree", None),
+                service_s=rec.spent_s())
         if state == DONE:
             # retire the checkpoint family: a DONE snapshot left behind
             # would make a tag-reusing resubmission instantly "resume"
@@ -2162,6 +2323,11 @@ class SearchServer:
                         rec.stop_reason = "deadline"
                         if slot.batch is None:
                             slot.stop_event.set()
+                # the lane ledger's periodic sweep: catches transitions
+                # with no dedicated sync site (deadline/cancel stops
+                # turning a lane draining, a canceled queue emptying a
+                # lane) at scheduler-tick resolution
+                self._lane_sync(slot)
             if self.megabatch:
                 self._tick_megabatch(now)
                 return
@@ -2314,7 +2480,12 @@ class SearchServer:
                 # in snapshots as dispatch_wait_s)
                 r.batch_closed_t = close_t
                 if r.queued_t:
-                    self._m_queue_wait.observe(close_t - r.queued_t)
+                    wait = close_t - r.queued_t
+                    self._m_queue_wait.observe(
+                        wait, tenant=r.request.tenant)
+                    if self.capacity is not None:
+                        self.capacity.on_queue_wait(r.request.tenant,
+                                                    wait)
             self._m_batches.inc(reason=reason)
             self._m_batch_size.observe(len(batch))
             if self.ledger is not None:
@@ -2366,6 +2537,7 @@ class SearchServer:
             target=self._execute_batch, args=(slot, list(recs)),
             daemon=True, name=f"tts-service-exec-{slot.index}")
         slot.thread.start()
+        self._lane_sync(slot)       # -> compiling
 
     def _execute_batch(self, slot: _Slot, recs: list) -> None:
         from ..engine import checkpoint, megabatch
@@ -2377,11 +2549,21 @@ class SearchServer:
         capacity = req0.capacity or prob.default_capacity(p0)
         evt = slot.stop_event
         bid = recs[0].batch_id
+        # the batch key guarantees one shape class for every member
+        cap_shape = (self._shape_class(req0)
+                     if self.capacity is not None else None)
+        if cap_shape is not None:
+            self._capacity_seed(cap_shape, p0, req0.lb_kind)
 
         def hb(b, rep):
             rec = recs[b]
             rec.last_heartbeat_t = time.monotonic()
             rec.dispatch_heartbeats += 1
+            if rec.dispatch_heartbeats == 1:
+                self._lane_sync(slot)       # compiling -> executing
+            if self.capacity is not None and rep.elapsed > 0:
+                self.capacity.on_progress(cap_shape,
+                                          rep.tree / rep.elapsed)
             self._ledger_budget(rec)
             rec.progress = {
                 "segment": rep.segment, "iters": rep.iters,
@@ -2411,6 +2593,11 @@ class SearchServer:
             return False
 
         handled: set = set()
+        # member -> monotonic stamp of its mid-batch freeze: the time
+        # from here to batch return is lane time the member's slice of
+        # the submesh sat idle waiting for batchmates to drain —
+        # tts_batch_drain_idle_seconds, ROADMAP item 2's motivation
+        frozen: dict[int, float] = {}
 
         def on_member_done(b, res):
             # a drained member turns DONE the moment the engine sees
@@ -2419,11 +2606,13 @@ class SearchServer:
             rec = recs[b]
             with self._lock:
                 handled.add(b)
+                frozen[b] = time.monotonic()
                 rec.spent_prev_s = rec.spent_s()
                 rec.started_t = None
                 rec.result = res
                 rec.error = None
                 self._finalize(rec, DONE)
+            self._lane_sync(slot)           # -> batch-frozen
 
         def on_member_stopped(b, res):
             # a stopped member (cancel / deadline / member preempt)
@@ -2438,6 +2627,7 @@ class SearchServer:
                 if rec.state in TERMINAL_STATES:
                     return
                 handled.add(b)
+                frozen[b] = time.monotonic()
                 rec.spent_prev_s = rec.spent_s()
                 rec.started_t = None
                 reason = rec.stop_reason
@@ -2449,6 +2639,7 @@ class SearchServer:
                     self._finalize(rec, CANCELLED)
                 else:          # preempt / shutdown / whole-batch stop
                     requeue = self._record_preempt(rec, reason)
+            self._lane_sync(slot)   # -> batch-frozen (or draining)
             if requeue:
                 self.queue.requeue(rec)
 
@@ -2539,6 +2730,7 @@ class SearchServer:
                     slot.batch = None
                     slot.stop_event = None
                     slot.thread = None
+                    self._lane_sync(slot)   # -> idle
                 self._self_fence(f"{type(e).__name__}: {e}")
                 return
             except checkpoint.TRANSIENT_ERRORS as e:
@@ -2547,6 +2739,14 @@ class SearchServer:
             except Exception as e:  # noqa: BLE001 — FAILED terminal
                 error = f"{type(e).__name__}: {e}"
                 no_retry = True
+            # the measured cost of run-to-drain batching: every
+            # mid-batch freeze pays (batch return − freeze) seconds of
+            # idle lane share. Observed once per closed batch, before
+            # the per-member bookkeeping releases the slot.
+            end_t = time.monotonic()
+            idle = sum(end_t - t for t in frozen.values())
+            if idle > 0:
+                self._m_drain_idle.observe(idle)
             self._on_batch_finished(slot, recs, results, error,
                                     handled, no_retry)
 
@@ -2602,6 +2802,7 @@ class SearchServer:
             slot.batch = None
             slot.stop_event = None
             slot.thread = None
+            self._lane_sync(slot)   # -> idle
 
     def _dispatch(self, slot: _Slot, rec: RequestRecord) -> None:
         """Start one executor thread for `rec` on `slot` (lock held)."""
@@ -2616,7 +2817,10 @@ class SearchServer:
         # batch-close (batch_closed_t set) — observing again would
         # double-count the member
         if rec.queued_t and rec.batch_closed_t is None:
-            self._m_queue_wait.observe(rec.started_t - rec.queued_t)
+            wait = rec.started_t - rec.queued_t
+            self._m_queue_wait.observe(wait, tenant=rec.request.tenant)
+            if self.capacity is not None:
+                self.capacity.on_queue_wait(rec.request.tenant, wait)
         rec.last_heartbeat_t = rec.started_t
         rec.dispatch_heartbeats = 0     # this dispatch warms afresh
         # (stall judges it against the warmup threshold until the
@@ -2644,6 +2848,7 @@ class SearchServer:
             target=self._execute, args=(slot, rec), daemon=True,
             name=f"tts-service-exec-{slot.index}")
         slot.thread.start()
+        self._lane_sync(slot)       # -> compiling
 
     # ----------------------------------------------------------- executor
 
@@ -2663,10 +2868,19 @@ class SearchServer:
         unit_costs = (self._unit_costs(req)
                       if self.phase_profile is not None
                       and req.problem == "pfsp" else None)
+        cap_shape = None
+        if self.capacity is not None:
+            cap_shape = self._shape_class(req)
+            self._capacity_seed(cap_shape, p, req.lb_kind)
 
         def hb(rep):
             rec.last_heartbeat_t = time.monotonic()
             rec.dispatch_heartbeats += 1
+            if rec.dispatch_heartbeats == 1:
+                self._lane_sync(slot)   # compiling -> executing
+            if self.capacity is not None and rep.elapsed > 0:
+                self.capacity.on_progress(cap_shape,
+                                          rep.tree / rep.elapsed)
             # durable budget clock: throttled inside (a hard kill loses
             # at most LEDGER_BUDGET_EVERY_S of spent_s, never the
             # request — the checkpoint meta is the second witness)
@@ -2780,6 +2994,7 @@ class SearchServer:
                     slot.record = None
                     slot.stop_event = None
                     slot.thread = None
+                    self._lane_sync(slot)   # -> idle
                 self._self_fence(f"{type(e).__name__}: {e}")
                 return
             except checkpoint.TRANSIENT_ERRORS as e:
@@ -2901,6 +3116,7 @@ class SearchServer:
             slot.record = None
             slot.stop_event = None
             slot.thread = None
+            self._lane_sync(slot)   # -> idle
 
 
 class _ReplayedResult:

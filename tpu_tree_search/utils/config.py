@@ -257,6 +257,24 @@ PROGRESS_EWMA_DEFAULT = 0.3               # TTS_PROGRESS_EWMA — weight
                                           # of the newest segment's raw
                                           # estimate in the smoothed one
 
+# Fleet capacity & utilization observability (obs/capacity.py): the
+# lane-state ledger + shape-class demand/capacity model behind
+# TTS_CAPACITY. TTS_CAPACITY=0 removes the layer entirely — no lane
+# events/counters, no capacity gauges, no snapshot key, no saturation
+# rule: bit-identical to the pre-capacity server.
+CAPACITY_WINDOW_S_DEFAULT = 300.0         # TTS_CAPACITY_WINDOW_S —
+                                          # arrival-rate sliding window
+CAPACITY_EWMA_DEFAULT = 0.3               # TTS_CAPACITY_EWMA — weight
+                                          # of the newest observation in
+                                          # service-rate / demand EWMAs
+HEALTH_SATURATION_DEFAULT = 0.85          # TTS_HEALTH_SATURATION —
+                                          # sustained ρ above this fires
+                                          # `saturation` (before the
+                                          # queue_wait p99 rule can)
+HEALTH_SATURATION_FOR_S_DEFAULT = 6.0     # TTS_HEALTH_SATURATION_FOR_S
+                                          # — dwell before pending
+                                          # becomes firing
+
 # Raw-speed flags (both STATIC: read once per search/server, bit-
 # identical node accounting on or off — see README's Performance
 # section and tests/test_overlap.py's parity suite):
@@ -634,6 +652,22 @@ KNOBS: dict[str, Knob] = _knob_table(
          "progress: explored nodes required before estimates publish"),
     Knob("TTS_PROGRESS_EWMA", "float", PROGRESS_EWMA_DEFAULT,
          "progress: EWMA weight of the newest segment's raw estimate"),
+    # --- fleet capacity & utilization (obs/capacity.py; semantics per
+    #     README "Capacity & utilization")
+    Knob("TTS_CAPACITY", "flag", True,
+         "lane-state ledger + shape-class capacity model + saturation "
+         "rule (observation-only; 0 = capacity layer absent, "
+         "bit-identical)"),
+    Knob("TTS_CAPACITY_WINDOW_S", "float", CAPACITY_WINDOW_S_DEFAULT,
+         "capacity: sliding window for per-class arrival rates"),
+    Knob("TTS_CAPACITY_EWMA", "float", CAPACITY_EWMA_DEFAULT,
+         "capacity: EWMA weight of the newest service-rate/demand "
+         "observation"),
+    Knob("TTS_HEALTH_SATURATION", "float", HEALTH_SATURATION_DEFAULT,
+         "saturation rule: sustained overall ρ firing threshold"),
+    Knob("TTS_HEALTH_SATURATION_FOR_S", "float",
+         HEALTH_SATURATION_FOR_S_DEFAULT,
+         "saturation rule: dwell seconds before pending -> firing"),
     # --- crash-safe serving (service/ledger.py; semantics per README
     #     "Crash recovery & deployment")
     Knob("TTS_LEDGER", "str", None,
@@ -755,8 +789,10 @@ KNOBS: dict[str, Knob] = _knob_table(
          "run_campaign: checkpoint/workdir root", "tool"),
     Knob("TTS_LB", "int", 2, "run_campaign: bound kind", "tool"),
     Knob("TTS_CHUNK", "int", 32768, "run_campaign: pop chunk", "tool"),
-    Knob("TTS_CAPACITY", "int", 0,
-         "run_campaign: pool rows (0 = sized from the instance)",
+    Knob("TTS_POOL_ROWS", "int", 0,
+         "run_campaign: pool rows (0 = sized from the instance; "
+         "formerly TTS_CAPACITY, renamed when the capacity "
+         "observability layer claimed that name)",
          "tool"),
     Knob("TTS_BUDGET_S", "float", 7200.0,
          "run_campaign: per-instance execution budget", "tool"),
